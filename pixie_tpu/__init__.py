@@ -1,0 +1,29 @@
+"""pixie_tpu — a TPU-native observability query engine.
+
+A ground-up rebuild of the capabilities of Pixie's Carnot query engine
+(reference: deprov447/pixie, ``src/carnot``), designed TPU-first:
+
+- Columnar tables live in HBM as fixed-capacity column blocks with validity
+  masks (reference: ``src/table_store/schema/row_batch.h:40``).
+- Whole plan fragments (Map/Filter/BlockingAgg/Join) compile to a single
+  jitted XLA program instead of a push-based exec-node graph
+  (reference: ``src/carnot/exec/exec_graph.cc:295``).
+- The PEM×N → Kelvin distributed reduction becomes ``shard_map`` over a
+  ``jax.sharding.Mesh`` with ``psum``/``all_gather`` collectives over ICI
+  (reference: ``src/carnot/planner/distributed/splitter/splitter.h:75``).
+- Sketch aggregates (t-digest quantiles, HLL count-distinct) are mergeable
+  carry pytrees with Pallas kernels on the hot path
+  (reference: ``src/carnot/funcs/builtins/math_sketches.h:34``).
+
+Strings are dictionary-encoded at staging time; regex/JSON UDFs run host-side
+as staging transforms (the "host UDF" escape hatch).
+"""
+
+# Int64 timestamps (TIME64NS) and counts require 64-bit semantics end to end.
+# TPUs emulate i64 adds cheaply; f64 is avoided on the hot path via the
+# compute-dtype knob in pixie_tpu.types.dtypes.
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,20 @@
+from .dtypes import DataType, device_dtypes, host_dtypes, is_numeric, n_planes, pad_values
+from .relation import Relation
+from .strings import NULL_ID, StringDictionary
+from .batch import MIN_CAPACITY, DeviceBatch, HostBatch, bucket_capacity
+
+__all__ = [
+    "DataType",
+    "Relation",
+    "StringDictionary",
+    "NULL_ID",
+    "DeviceBatch",
+    "HostBatch",
+    "MIN_CAPACITY",
+    "bucket_capacity",
+    "device_dtypes",
+    "host_dtypes",
+    "is_numeric",
+    "n_planes",
+    "pad_values",
+]

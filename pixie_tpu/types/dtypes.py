@@ -1,0 +1,118 @@
+"""Logical data types and their device representations.
+
+Reference parity: the six Carnot data types
+(``src/shared/types/typespb/types.proto:28-33``): BOOLEAN, INT64, UINT128,
+FLOAT64, STRING, TIME64NS.
+
+TPU-first mapping:
+
+- BOOLEAN   -> bool_
+- INT64     -> int64 (XLA emulates i64 on TPU; fine for adds/compares)
+- UINT128   -> two uint64 planes (hi, lo) — no native u128 in XLA. UPIDs
+  (``src/shared/upid``) are the main user; hash/compare are defined on the
+  pair.
+- FLOAT64   -> float64 logically; the exec engine may compute in float32
+  on TPU (``compute_dtype``) since f64 is software-emulated there.
+- STRING    -> int32 dictionary ids. Encoding happens host-side at staging
+  time (see pixie_tpu.types.strings). Equality/group-by/join on strings are
+  id ops inside XLA; regex & friends run host-side on the dictionary.
+- TIME64NS  -> int64 nanoseconds since epoch.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    BOOLEAN = "boolean"
+    INT64 = "int64"
+    UINT128 = "uint128"
+    FLOAT64 = "float64"
+    STRING = "string"
+    TIME64NS = "time64ns"
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"DataType.{self.name}"
+
+
+# Number of physical device planes a logical column occupies.
+_N_PLANES = {
+    DataType.BOOLEAN: 1,
+    DataType.INT64: 1,
+    DataType.UINT128: 2,
+    DataType.FLOAT64: 1,
+    DataType.STRING: 1,
+    DataType.TIME64NS: 1,
+}
+
+# Device dtypes per plane.
+_DEVICE_DTYPES = {
+    DataType.BOOLEAN: (jnp.bool_,),
+    DataType.INT64: (jnp.int64,),
+    DataType.UINT128: (jnp.uint64, jnp.uint64),
+    DataType.FLOAT64: (jnp.float64,),
+    DataType.STRING: (jnp.int32,),
+    DataType.TIME64NS: (jnp.int64,),
+}
+
+# Host (numpy) dtypes per plane, used by the staging path and the hot store.
+_HOST_DTYPES = {
+    DataType.BOOLEAN: (np.bool_,),
+    DataType.INT64: (np.int64,),
+    DataType.UINT128: (np.uint64, np.uint64),
+    DataType.FLOAT64: (np.float64,),
+    DataType.STRING: (np.int32,),
+    DataType.TIME64NS: (np.int64,),
+}
+
+# Neutral pad value per plane for invalid (masked) rows.
+_PAD_VALUES = {
+    DataType.BOOLEAN: (False,),
+    DataType.INT64: (0,),
+    DataType.UINT128: (0, 0),
+    DataType.FLOAT64: (0.0,),
+    DataType.STRING: (-1,),
+    DataType.TIME64NS: (0,),
+}
+
+_NUMERIC = frozenset({DataType.INT64, DataType.FLOAT64, DataType.TIME64NS})
+
+
+def n_planes(dt: DataType) -> int:
+    return _N_PLANES[dt]
+
+
+def device_dtypes(dt: DataType) -> tuple:
+    return _DEVICE_DTYPES[dt]
+
+
+def host_dtypes(dt: DataType) -> tuple:
+    return _HOST_DTYPES[dt]
+
+
+def pad_values(dt: DataType) -> tuple:
+    return _PAD_VALUES[dt]
+
+
+def is_numeric(dt: DataType) -> bool:
+    return dt in _NUMERIC
+
+
+def from_numpy_dtype(np_dtype, *, is_time: bool = False) -> DataType:
+    """Infer a logical DataType from a numpy dtype (strings -> STRING)."""
+    np_dtype = np.dtype(np_dtype) if not np.issubdtype(type(np_dtype), np.generic) else np_dtype
+    if np_dtype == np.bool_:
+        return DataType.BOOLEAN
+    if np.issubdtype(np_dtype, np.integer):
+        return DataType.TIME64NS if is_time else DataType.INT64
+    if np.issubdtype(np_dtype, np.floating):
+        return DataType.FLOAT64
+    if np_dtype.kind in ("U", "S", "O"):
+        return DataType.STRING
+    if np_dtype.kind == "M":  # datetime64
+        return DataType.TIME64NS
+    raise TypeError(f"no DataType mapping for numpy dtype {np_dtype}")

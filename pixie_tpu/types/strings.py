@@ -1,0 +1,89 @@
+"""Dictionary encoding for STRING columns.
+
+TPU-first design: strings never reach the device. At staging time each
+string column is encoded into int32 dictionary ids; all device-side ops
+(equality filters, group-by keys, join keys) are id ops. Host-side UDFs
+(regex, json, normalization) transform the *dictionary*, not the rows —
+a dictionary with K distinct values is transformed in O(K) instead of
+O(rows).
+
+Reference contrast: Carnot ships raw strings through Arrow StringArrays
+and hashes them per-row in agg/join maps (``src/carnot/exec/row_tuple.h``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+NULL_ID = -1
+
+
+class StringDictionary:
+    """Append-only string <-> int32 id mapping."""
+
+    __slots__ = ("_str_to_id", "_strings")
+
+    def __init__(self, strings: Iterable[str] = ()):
+        self._strings: list[str] = []
+        self._str_to_id: dict[str, int] = {}
+        for s in strings:
+            self.get_or_add(s)
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+    def get_or_add(self, s: str) -> int:
+        sid = self._str_to_id.get(s)
+        if sid is None:
+            sid = len(self._strings)
+            self._str_to_id[s] = sid
+            self._strings.append(s)
+        return sid
+
+    def lookup(self, s: str) -> int:
+        """Id for ``s`` or NULL_ID if unseen (for filter literals)."""
+        return self._str_to_id.get(s, NULL_ID)
+
+    def encode(self, values: Iterable[str]) -> np.ndarray:
+        vals = list(values)
+        return np.fromiter((self.get_or_add(v) for v in vals), dtype=np.int32, count=len(vals))
+
+    def decode(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids)
+        table = np.empty(len(self._strings) + 1, dtype=object)
+        table[:-1] = self._strings
+        table[-1] = None  # slot for out-of-range / NULL_ID
+        safe = np.where((ids >= 0) & (ids < len(self._strings)), ids, len(self._strings))
+        return table[safe]
+
+    def decode_one(self, sid: int) -> str | None:
+        return self._strings[sid] if 0 <= sid < len(self._strings) else None
+
+    @property
+    def strings(self) -> list[str]:
+        return self._strings
+
+    def transform(self, fn) -> tuple["StringDictionary", np.ndarray]:
+        """Host UDF escape hatch: apply ``fn`` to every distinct string.
+
+        Returns (new_dict, remap) where ``remap[old_id] -> new_id``; device
+        side applies the remap as a gather. O(K distinct), not O(rows).
+        """
+        new = StringDictionary()
+        remap = np.empty(len(self._strings), dtype=np.int32)
+        for i, s in enumerate(self._strings):
+            remap[i] = new.get_or_add(fn(s))
+        return new, remap
+
+    def union(self, other: "StringDictionary") -> tuple["StringDictionary", np.ndarray, np.ndarray]:
+        """Merged dict + id remaps for self and other (join/union alignment)."""
+        merged = StringDictionary(self._strings)
+        remap_self = np.arange(len(self._strings), dtype=np.int32)
+        remap_other = np.fromiter(
+            (merged.get_or_add(s) for s in other._strings),
+            dtype=np.int32,
+            count=len(other._strings),
+        )
+        return merged, remap_self, remap_other

@@ -1,0 +1,34 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's "fake the distributed system without a cluster"
+strategy (SURVEY.md §4): instead of LocalResultSinkServer + synthetic
+DistributedState, we stand up 8 XLA host-platform devices so shard_map
+programs compile and run without TPU hardware. Hardware-tagged tests use
+@pytest.mark.requires_tpu (the reference's ``requires_bpf`` pattern).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "requires_tpu: needs real TPU hardware (excluded by default)"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("PIXIE_TPU_RUN_TPU_TESTS"):
+        return
+    skip = pytest.mark.skip(reason="requires real TPU (set PIXIE_TPU_RUN_TPU_TESTS=1)")
+    for item in items:
+        if "requires_tpu" in item.keywords:
+            item.add_marker(skip)
