@@ -1,0 +1,1 @@
+"""Device-side kernel library: sketches, segment ops, (later) Pallas kernels."""

@@ -1,0 +1,141 @@
+"""Batched t-digest quantile sketch over [num_groups, K] centroid arrays.
+
+Reference parity: ``src/carnot/funcs/builtins/math_sketches.h:34``
+(QuantilesUDA wrapping the sequential-insertion tdigest library).
+
+TPU-first redesign: sequential insertion is hostile to XLA, so digests are
+built by **sorted quantile-binning** — a whole batch of values is sorted
+within each group, each value's within-group quantile position is mapped
+through the t-digest k1 scale function k(q) = asin(2q-1) to one of K bins,
+and bins are reduced with segment sums. Merging two digests (the partial-agg
+path across devices) concatenates centroid sets and re-compresses with the
+same binning. Everything is static-shape: [G groups, K centroids].
+
+The carry is (means f32[G,K], weights f32[G,K]) — a pytree, trivially
+shippable through shard_map/psum-style collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_K = 128
+_BIG = jnp.inf
+
+
+def _knorm(q):
+    """t-digest k1 scale normalized to [0, 1): concentrates bins at tails."""
+    q = jnp.clip(q, 0.0, 1.0)
+    return (jnp.arcsin(2.0 * q - 1.0) / jnp.pi) + 0.5
+
+
+def digest_init(num_groups: int, k: int = DEFAULT_K):
+    return (
+        jnp.zeros((num_groups, k), dtype=jnp.float32),
+        jnp.zeros((num_groups, k), dtype=jnp.float32),
+    )
+
+
+def _compress(means, weights, k: int):
+    """Re-bin [G, M] centroids to [G, k] by cumulative-weight position."""
+    g, m = means.shape
+    # Sort centroids by mean within each group; empty slots (w==0) last.
+    sort_key = jnp.where(weights > 0, means, _BIG)
+    order = jnp.argsort(sort_key, axis=-1, stable=True)
+    means_s = jnp.take_along_axis(means, order, axis=-1)
+    weights_s = jnp.take_along_axis(weights, order, axis=-1)
+
+    total = jnp.sum(weights_s, axis=-1, keepdims=True)
+    cumw = jnp.cumsum(weights_s, axis=-1)
+    qmid = jnp.where(total > 0, (cumw - weights_s * 0.5) / total, 0.0)
+    bins = jnp.clip(jnp.floor(_knorm(qmid) * k).astype(jnp.int32), 0, k - 1)
+
+    gid = jnp.broadcast_to(jnp.arange(g, dtype=jnp.int32)[:, None], (g, m))
+    flat = jnp.where(weights_s > 0, gid * k + bins, g * k).reshape(-1)
+    w_flat = weights_s.reshape(-1)
+    mw_flat = (means_s * weights_s).reshape(-1)
+
+    new_w = jax.ops.segment_sum(w_flat, flat, num_segments=g * k + 1)[:-1]
+    new_mw = jax.ops.segment_sum(mw_flat, flat, num_segments=g * k + 1)[:-1]
+    new_w = new_w.reshape(g, k)
+    new_means = jnp.where(new_w > 0, new_mw.reshape(g, k) / jnp.maximum(new_w, 1e-30), 0.0)
+    return new_means, new_w
+
+
+def digest_merge(a, b):
+    """Associative merge of two [G, K] digests (cross-device finalize path)."""
+    means = jnp.concatenate([a[0], b[0]], axis=-1)
+    weights = jnp.concatenate([a[1], b[1]], axis=-1)
+    return _compress(means, weights, a[0].shape[-1])
+
+
+def batch_to_digest(values, group_ids, mask, num_groups: int, k: int = DEFAULT_K):
+    """Build a [G, K] digest from one batch of (value, group) rows."""
+    n = values.shape[0]
+    values = values.astype(jnp.float32)
+    gids = jnp.where(mask, group_ids.astype(jnp.int32), num_groups)
+    vals_m = jnp.where(mask, values, _BIG)
+
+    # Rows sorted by (group, value): stable sort by value, then by group.
+    idx1 = jnp.argsort(vals_m, stable=True)
+    idx2 = jnp.argsort(gids[idx1], stable=True)
+    order = idx1[idx2]
+    s_gid = gids[order]
+    s_val = values[order]
+    s_mask = mask[order]
+
+    ones = mask.astype(jnp.float32)
+    counts = jax.ops.segment_sum(ones, gids, num_segments=num_groups + 1)
+    starts = jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]])
+    rank = jnp.arange(n, dtype=jnp.float32) - starts[s_gid]
+    group_n = jnp.maximum(counts[s_gid], 1.0)
+    q = (rank + 0.5) / group_n
+    bins = jnp.clip(jnp.floor(_knorm(q) * k).astype(jnp.int32), 0, k - 1)
+
+    flat = jnp.where(s_mask & (s_gid < num_groups), s_gid * k + bins, num_groups * k)
+    w_flat = s_mask.astype(jnp.float32)
+    w = jax.ops.segment_sum(w_flat, flat, num_segments=num_groups * k + 1)[:-1]
+    mw = jax.ops.segment_sum(
+        jnp.where(s_mask, s_val, 0.0), flat, num_segments=num_groups * k + 1
+    )[:-1]
+    w = w.reshape(num_groups, k)
+    means = jnp.where(w > 0, mw.reshape(num_groups, k) / jnp.maximum(w, 1e-30), 0.0)
+    return means, w
+
+
+def digest_update(carry, group_ids, mask, values, *, num_groups: int | None = None):
+    """UDA update: fold a batch into the digest carry."""
+    g, k = carry[0].shape
+    fresh = batch_to_digest(values, group_ids, mask, g if num_groups is None else num_groups, k)
+    return digest_merge(carry, fresh)
+
+
+def digest_quantile(carry, qs):
+    """Estimate quantiles per group: [G, len(qs)] (NaN for empty groups).
+
+    Linear interpolation of centroid means over cumulative-weight midpoints
+    (the standard t-digest estimator).
+    """
+    means, weights = carry
+    qs_arr = jnp.asarray(qs, dtype=jnp.float32)
+
+    sort_key = jnp.where(weights > 0, means, _BIG)
+    order = jnp.argsort(sort_key, axis=-1, stable=True)
+    means_s = jnp.take_along_axis(means, order, axis=-1)
+    weights_s = jnp.take_along_axis(weights, order, axis=-1)
+
+    total = jnp.sum(weights_s, axis=-1)
+    cumw = jnp.cumsum(weights_s, axis=-1)
+    cmid = cumw - weights_s * 0.5
+
+    # Fill empty (w==0, sorted to the end) slots so interp clamps to the
+    # last real centroid instead of walking into garbage.
+    filled_mean = jax.lax.cummax(jnp.where(weights_s > 0, means_s, -_BIG), axis=1)
+    filled_cmid = jnp.where(weights_s > 0, cmid, total[:, None])
+
+    def one_group(m, c, t):
+        return jnp.interp(qs_arr * t, c, m)
+
+    out = jax.vmap(one_group)(filled_mean, filled_cmid, total)
+    return jnp.where(total[:, None] > 0, out, jnp.nan)

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .dtypes import DataType, from_numpy_dtype, host_dtypes, pad_values
+from .dtypes import DataType, device_dtypes, from_numpy_dtype, host_dtypes, pad_values
 from .relation import Relation
 from .strings import StringDictionary
 
@@ -123,9 +123,10 @@ class HostBatch:
         cols: dict[str, Planes] = {}
         for name, dt in self.relation.items():
             pads = pad_values(dt)
+            ddts = device_dtypes(dt)
             planes = []
-            for plane, pad in zip(self.cols[name], pads):
-                padded = np.full(cap, pad, dtype=plane.dtype)
+            for plane, pad, ddt in zip(self.cols[name], pads, ddts):
+                padded = np.full(cap, pad, dtype=np.dtype(ddt))
                 padded[: self.length] = plane
                 planes.append(jnp.asarray(padded))
             cols[name] = tuple(planes)
@@ -187,8 +188,11 @@ class DeviceBatch:
         valid = np.asarray(self.valid)
         idx = np.nonzero(valid)[0]
         cols: dict[str, Planes] = {}
-        for name, _ in self.relation.items():
-            cols[name] = tuple(np.asarray(p)[idx] for p in self.cols[name])
+        for name, dt in self.relation.items():
+            hdts = host_dtypes(dt)
+            cols[name] = tuple(
+                np.asarray(p)[idx].astype(hdt) for p, hdt in zip(self.cols[name], hdts)
+            )
         return HostBatch(
             relation=self.relation,
             cols=cols,

@@ -11,8 +11,14 @@ TPU-first mapping:
 - UINT128   -> two uint64 planes (hi, lo) — no native u128 in XLA. UPIDs
   (``src/shared/upid``) are the main user; hash/compare are defined on the
   pair.
-- FLOAT64   -> float64 logically; the exec engine may compute in float32
-  on TPU (``compute_dtype``) since f64 is software-emulated there.
+- FLOAT64   -> logically f64; **physically float32 on device**. Two reasons:
+  (a) TPU emulates f64 in software — f32 keeps the VPU/MXU fast paths;
+  (b) XLA:CPU exhibits a ~100x compile-time blowup fusing f64 multi-operand
+  sorts with downstream arithmetic (measured 107s vs 0.66s for the t-digest
+  compress kernel), so f64 never enters sorted/fused device code. Exact
+  accumulation still happens: UDA carries (sum/mean) are f64 — they are
+  [num_groups]-sized, sort-free, and finalize returns them to the host at
+  full precision.
 - STRING    -> int32 dictionary ids. Encoding happens host-side at staging
   time (see pixie_tpu.types.strings). Equality/group-by/join on strings are
   id ops inside XLA; regex & friends run host-side on the dictionary.
@@ -54,7 +60,7 @@ _DEVICE_DTYPES = {
     DataType.BOOLEAN: (jnp.bool_,),
     DataType.INT64: (jnp.int64,),
     DataType.UINT128: (jnp.uint64, jnp.uint64),
-    DataType.FLOAT64: (jnp.float64,),
+    DataType.FLOAT64: (jnp.float32,),
     DataType.STRING: (jnp.int32,),
     DataType.TIME64NS: (jnp.int64,),
 }

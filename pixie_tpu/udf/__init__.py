@@ -1,0 +1,14 @@
+from .registry import Registry, default_registry
+from .udf import Executor, ScalarUDFDef, SignatureError, UDADef, apply_cast, cast_cost, resolve_overload
+
+__all__ = [
+    "Registry",
+    "default_registry",
+    "Executor",
+    "ScalarUDFDef",
+    "UDADef",
+    "SignatureError",
+    "apply_cast",
+    "cast_cost",
+    "resolve_overload",
+]

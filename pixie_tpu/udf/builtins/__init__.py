@@ -1,0 +1,17 @@
+"""Builtin function registration root.
+
+Reference parity: ``src/carnot/funcs/funcs.cc:30`` RegisterFuncsOrDie.
+"""
+
+from . import collections, conditionals, json_ops, math_ops, math_sketches, regex_ops, sql_ops, string_ops
+
+
+def register_all(reg):
+    math_ops.register(reg)
+    math_sketches.register(reg)
+    conditionals.register(reg)
+    collections.register(reg)
+    string_ops.register(reg)
+    json_ops.register(reg)
+    regex_ops.register(reg)
+    sql_ops.register(reg)

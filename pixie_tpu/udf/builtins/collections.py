@@ -1,0 +1,41 @@
+"""Collection UDAs.
+
+Reference parity: ``src/carnot/funcs/builtins/collections.cc`` —
+AnyUDA("any", :33): returns an arbitrary member of the group. Implemented
+as a segment-max (any deterministic pick works; max is collective-friendly).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..udf import BOOLEAN, FLOAT64, INT64, STRING, TIME64NS
+
+_NEUTRAL = {
+    INT64: jnp.iinfo(jnp.int64).min,
+    TIME64NS: jnp.iinfo(jnp.int64).min,
+    FLOAT64: -jnp.inf,
+    STRING: -(2**31),  # ids are int32; NULL decode for empty groups
+    BOOLEAN: False,
+}
+
+
+def register(reg):
+    def _update(c, gids, mask, v, lo):
+        g = c.shape[0]
+        contrib = jnp.where(mask, v, jnp.full((), lo, v.dtype))
+        upd = jax.ops.segment_max(contrib, jnp.where(mask, gids, g), num_segments=g + 1)[:-1]
+        return jnp.maximum(c, upd)
+
+    for dt, lo in _NEUTRAL.items():
+        reg.uda(
+            "any", (dt,), dt,
+            init=lambda g, _dt=dt, _lo=lo: jnp.full(
+                g, _lo, dtype={BOOLEAN: jnp.bool_, STRING: jnp.int32, FLOAT64: jnp.float64}.get(_dt, jnp.int64)
+            ),
+            update=lambda c, gids, mask, v, _lo=lo: _update(c, gids, mask, v, _lo),
+            merge=jnp.maximum,
+            finalize=lambda c: c,
+            doc="An arbitrary value from the group.",
+        )

@@ -1,0 +1,22 @@
+"""Conditional scalar UDFs.
+
+Reference parity: ``src/carnot/funcs/builtins/conditionals.cc`` —
+SelectUDF("select", cond, then, else). Device-side jnp.where; string
+branches operate on ids (the plan binder aligns both branches to one
+dictionary before tracing).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..udf import BOOLEAN, FLOAT64, INT64, STRING, TIME64NS
+
+
+def register(reg):
+    for dt in (INT64, FLOAT64, STRING, BOOLEAN, TIME64NS):
+        reg.scalar(
+            "select", (BOOLEAN, dt, dt), dt,
+            lambda c, a, b: jnp.where(c, a, b),
+            doc="Elementwise: a where cond else b.",
+        )

@@ -1,0 +1,184 @@
+"""Math scalar UDFs and numeric UDAs.
+
+Reference parity: ``src/carnot/funcs/builtins/math_ops.h:34-744`` — binary
+arith (add/subtract/multiply/divide/modulo), comparisons
+(equal/notEqual/lessThan/greaterThan/...), logical ops, unary
+(abs/ceil/floor/round/sqrt/exp/ln/log2/log10/negate/invert), ``bin``, time
+conversions, and the UDAs MeanUDA(:584)/SumUDA(:630)/MaxUDA(:661)/
+MinUDA(:703)/CountUDA(:744).
+
+TPU-first: scalars are whole-column jnp expressions XLA fuses; UDAs are
+segment reductions into [G] carries with associative merges.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..udf import BOOLEAN, FLOAT64, INT64, STRING, TIME64NS
+
+
+def _num(t):  # numeric overload families
+    return [(INT64, jnp.int64), (FLOAT64, jnp.float64)][t]
+
+
+_I64_MAX = jnp.iinfo(jnp.int64).max
+_I64_MIN = jnp.iinfo(jnp.int64).min
+
+
+def register(reg):
+    # -- binary arithmetic ---------------------------------------------------
+    for dt in (INT64, FLOAT64):
+        reg.scalar("add", (dt, dt), dt, lambda a, b: a + b)
+        reg.scalar("subtract", (dt, dt), dt, lambda a, b: a - b)
+        reg.scalar("multiply", (dt, dt), dt, lambda a, b: a * b)
+    # Time arithmetic keeps TIME64NS (duration treated as INT64 input).
+    reg.scalar("add", (TIME64NS, TIME64NS), TIME64NS, lambda a, b: a + b)
+    reg.scalar("subtract", (TIME64NS, TIME64NS), TIME64NS, lambda a, b: a - b)
+    # divide always yields float (Carnot: DivideUDF -> FLOAT64).
+    reg.scalar(
+        "divide",
+        (FLOAT64, FLOAT64),
+        FLOAT64,
+        lambda a, b: a / b,
+        doc="Arithmetic division; inf/nan on zero divisors.",
+    )
+    reg.scalar("modulo", (INT64, INT64), INT64, lambda a, b: jnp.where(b != 0, a % jnp.where(b == 0, 1, b), 0))
+    reg.scalar("pow", (FLOAT64, FLOAT64), FLOAT64, lambda a, b: jnp.power(a, b))
+
+    # -- comparisons ---------------------------------------------------------
+    for dt in (INT64, FLOAT64, TIME64NS, BOOLEAN, STRING):
+        reg.scalar("equal", (dt, dt), BOOLEAN, lambda a, b: a == b)
+        reg.scalar("notEqual", (dt, dt), BOOLEAN, lambda a, b: a != b)
+    for dt in (INT64, FLOAT64, TIME64NS):
+        reg.scalar("lessThan", (dt, dt), BOOLEAN, lambda a, b: a < b)
+        reg.scalar("lessThanEqual", (dt, dt), BOOLEAN, lambda a, b: a <= b)
+        reg.scalar("greaterThan", (dt, dt), BOOLEAN, lambda a, b: a > b)
+        reg.scalar("greaterThanEqual", (dt, dt), BOOLEAN, lambda a, b: a >= b)
+    # Tolerance sized for f32 planes (one ULP at magnitude 1 is ~1.2e-7).
+    reg.scalar("approxEqual", (FLOAT64, FLOAT64), BOOLEAN, lambda a, b: jnp.abs(a - b) < 1e-4)
+
+    # -- logical -------------------------------------------------------------
+    reg.scalar("logicalAnd", (BOOLEAN, BOOLEAN), BOOLEAN, lambda a, b: a & b)
+    reg.scalar("logicalOr", (BOOLEAN, BOOLEAN), BOOLEAN, lambda a, b: a | b)
+    reg.scalar("logicalNot", (BOOLEAN,), BOOLEAN, lambda a: ~a)
+    reg.scalar("invert", (BOOLEAN,), BOOLEAN, lambda a: ~a)
+
+    # -- unary math ----------------------------------------------------------
+    for dt in (INT64, FLOAT64):
+        reg.scalar("abs", (dt,), dt, jnp.abs)
+        reg.scalar("negate", (dt,), dt, jnp.negative)
+    reg.scalar("ceil", (FLOAT64,), FLOAT64, jnp.ceil)
+    reg.scalar("floor", (FLOAT64,), FLOAT64, jnp.floor)
+    reg.scalar("round", (FLOAT64,), FLOAT64, jnp.round)
+    reg.scalar("sqrt", (FLOAT64,), FLOAT64, jnp.sqrt)
+    reg.scalar("exp", (FLOAT64,), FLOAT64, jnp.exp)
+    reg.scalar("ln", (FLOAT64,), FLOAT64, jnp.log)
+    reg.scalar("log2", (FLOAT64,), FLOAT64, jnp.log2)
+    reg.scalar("log10", (FLOAT64,), FLOAT64, jnp.log10)
+    reg.scalar("log", (FLOAT64, FLOAT64), FLOAT64, lambda b, x: jnp.log(x) / jnp.log(b))
+
+    # -- bin + time conversions ----------------------------------------------
+    reg.scalar(
+        "bin",
+        (INT64, INT64),
+        INT64,
+        lambda v, s: v - v % jnp.where(s == 0, 1, s),
+        doc="Round v down to the nearest multiple of s (px.bin).",
+    )
+    reg.scalar("bin", (TIME64NS, INT64), TIME64NS, lambda v, s: v - v % jnp.where(s == 0, 1, s))
+    reg.scalar("time_to_int64", (TIME64NS,), INT64, lambda t: t)
+    reg.scalar("int64_to_time", (INT64,), TIME64NS, lambda t: t)
+
+    # -- UDAs ----------------------------------------------------------------
+    # Float carries are f64 even though column planes are f32: [G]-sized,
+    # sort-free accumulators keep billions-row sums exact without tripping
+    # the f64-sort compile blowup (see types/dtypes.py).
+    def _seg_sum(carry, gids, mask, v):
+        g = carry.shape[0]
+        v = v.astype(carry.dtype)
+        contrib = jnp.where(mask, v, jnp.zeros((), v.dtype))
+        return carry + jax.ops.segment_sum(contrib, jnp.where(mask, gids, g), num_segments=g + 1)[:-1]
+
+    for dt, zdtype in ((INT64, jnp.int64), (FLOAT64, jnp.float64)):
+        reg.uda(
+            "sum",
+            (dt,),
+            dt,
+            init=lambda g, _z=zdtype: jnp.zeros(g, dtype=_z),
+            update=lambda c, gids, mask, v: _seg_sum(c, gids, mask, v),
+            merge=lambda a, b: a + b,
+            finalize=lambda c: c,
+            doc="Sum of the group.",
+        )
+    reg.uda(
+        "sum",
+        (BOOLEAN,),
+        INT64,
+        init=lambda g: jnp.zeros(g, dtype=jnp.int64),
+        update=lambda c, gids, mask, v: _seg_sum(c, gids, mask, v.astype(jnp.int64)),
+        merge=lambda a, b: a + b,
+        finalize=lambda c: c,
+    )
+
+    reg.uda(
+        "count",
+        (FLOAT64,),
+        INT64,
+        init=lambda g: jnp.zeros(g, dtype=jnp.int64),
+        update=lambda c, gids, mask, v: _seg_sum(c, gids, mask, jnp.ones_like(v, dtype=jnp.int64)),
+        merge=lambda a, b: a + b,
+        finalize=lambda c: c,
+        doc="Number of rows in the group.",
+    )
+
+    reg.uda(
+        "mean",
+        (FLOAT64,),
+        FLOAT64,
+        init=lambda g: (jnp.zeros(g, dtype=jnp.float64), jnp.zeros(g, dtype=jnp.float64)),
+        update=lambda c, gids, mask, v: (
+            _seg_sum(c[0], gids, mask, v),
+            _seg_sum(c[1], gids, mask, jnp.ones_like(v)),
+        ),
+        merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        finalize=lambda c: jnp.where(c[1] > 0, c[0] / jnp.maximum(c[1], 1.0), jnp.nan),
+        doc="Arithmetic mean of the group (sum/count carry; merges exactly).",
+    )
+
+    def _seg_min(carry, gids, mask, v, neutral):
+        g = carry.shape[0]
+        contrib = jnp.where(mask, v, jnp.full((), neutral, v.dtype))
+        upd = jax.ops.segment_min(contrib, jnp.where(mask, gids, g), num_segments=g + 1)[:-1]
+        return jnp.minimum(carry, upd)
+
+    def _seg_max(carry, gids, mask, v, neutral):
+        g = carry.shape[0]
+        contrib = jnp.where(mask, v, jnp.full((), neutral, v.dtype))
+        upd = jax.ops.segment_max(contrib, jnp.where(mask, gids, g), num_segments=g + 1)[:-1]
+        return jnp.maximum(carry, upd)
+
+    for dt, zd, lo, hi in (
+        (INT64, jnp.int64, _I64_MIN, _I64_MAX),
+        (FLOAT64, jnp.float64, -jnp.inf, jnp.inf),
+        (TIME64NS, jnp.int64, _I64_MIN, _I64_MAX),
+    ):
+        reg.uda(
+            "min",
+            (dt,),
+            dt,
+            init=lambda g, _z=zd, _hi=hi: jnp.full(g, _hi, dtype=_z),
+            update=lambda c, gids, mask, v, _hi=hi: _seg_min(c, gids, mask, v, _hi),
+            merge=jnp.minimum,
+            finalize=lambda c: c,
+        )
+        reg.uda(
+            "max",
+            (dt,),
+            dt,
+            init=lambda g, _z=zd, _lo=lo: jnp.full(g, _lo, dtype=_z),
+            update=lambda c, gids, mask, v, _lo=lo: _seg_max(c, gids, mask, v, _lo),
+            merge=jnp.maximum,
+            finalize=lambda c: c,
+        )

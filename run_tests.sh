@@ -1,0 +1,6 @@
+#!/bin/bash
+# Run the test suite on CPU with the axon TPU-tunnel plugin disabled.
+# PALLAS_AXON_POOL_IPS must be cleared BEFORE the interpreter starts
+# (sitecustomize registers the plugin at boot); conftest.py alone is too
+# late. See .claude/skills/verify/SKILL.md.
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest "$@"

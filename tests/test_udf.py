@@ -1,0 +1,220 @@
+"""Tests for the UDF/UDA registry, overload resolution, and builtins."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pixie_tpu.types import DataType
+from pixie_tpu.udf import SignatureError, default_registry
+
+I64 = DataType.INT64
+F64 = DataType.FLOAT64
+B = DataType.BOOLEAN
+S = DataType.STRING
+T = DataType.TIME64NS
+
+
+@pytest.fixture(scope="module")
+def reg():
+    return default_registry()
+
+
+class TestResolution:
+    def test_exact(self, reg):
+        udf = reg.get_scalar("add", (I64, I64))
+        assert udf.return_type == I64
+
+    def test_widening(self, reg):
+        udf = reg.get_scalar("add", (I64, F64))
+        assert udf.arg_types == (F64, F64)
+        assert udf.return_type == F64
+
+    def test_bool_to_int(self, reg):
+        udf = reg.get_scalar("sum", (B,)) if reg.has_scalar("sum") else reg.get_uda("sum", (B,))
+        assert udf.return_type == I64
+
+    def test_no_match(self, reg):
+        with pytest.raises(SignatureError):
+            reg.get_scalar("add", (S, I64))
+
+    def test_unknown_name(self, reg):
+        with pytest.raises(SignatureError):
+            reg.get_scalar("definitely_not_a_udf", (I64,))
+
+    def test_time_arith_stays_time(self, reg):
+        udf = reg.get_scalar("subtract", (T, T))
+        assert udf.return_type == T
+
+    def test_reference_parity_names(self, reg):
+        # Inventory check against src/carnot/funcs/builtins registrations.
+        for name in ["add", "subtract", "multiply", "divide", "modulo", "equal",
+                     "notEqual", "lessThan", "greaterThan", "bin", "select",
+                     "contains", "length", "find", "substring", "tolower",
+                     "toupper", "trim", "strip_prefix", "atoi", "pluck",
+                     "pluck_int64", "pluck_float64", "regex_match", "replace",
+                     "normalize_mysql", "normalize_pgsql", "time_to_int64",
+                     "int64_to_time", "ceil", "floor", "round", "abs", "sqrt"]:
+            assert reg.has_scalar(name), name
+        for name in ["sum", "mean", "min", "max", "count", "any", "quantiles",
+                     "count_distinct"]:
+            assert reg.has_uda(name), name
+
+
+class TestScalarSemantics:
+    def test_device_exec(self, reg):
+        udf = reg.get_scalar("bin", (I64, I64))
+        out = udf.fn(jnp.array([7, 13, 20]), jnp.array([5, 5, 5]))
+        np.testing.assert_array_equal(np.asarray(out), [5, 10, 20])
+
+    def test_divide_by_zero_is_inf(self, reg):
+        udf = reg.get_scalar("divide", (F64, F64))
+        out = udf.fn(jnp.array([1.0]), jnp.array([0.0]))
+        assert np.isinf(np.asarray(out))[0]
+
+    def test_host_dict_contains(self, reg):
+        udf = reg.get_scalar("contains", (S, S))
+        assert udf.fn("/api/users", "users") is True
+        assert udf.fn("/health", "users") is False
+
+    def test_normalize_sql(self, reg):
+        udf = reg.get_scalar("normalize_mysql", (S,))
+        q = "SELECT * FROM t WHERE id = 42 AND name = 'bob' AND x IN (1, 2, 3)"
+        assert udf.fn(q) == "SELECT * FROM t WHERE id = ? AND name = ? AND x IN (?)"
+
+    def test_pluck(self, reg):
+        udf = reg.get_scalar("pluck_float64", (S, S))
+        assert udf.fn('{"p50": 1.5}', "p50") == 1.5
+        assert np.isnan(udf.fn("not json", "p50"))
+
+    def test_regex(self, reg):
+        udf = reg.get_scalar("regex_match", (S, S))
+        assert udf.fn(r"/api/.*", "/api/v1") is True
+        assert udf.fn(r"/api/.*", "/health") is False
+        assert udf.fn(r"([bad", "/x") is False  # invalid pattern -> no match
+
+
+import jax
+
+
+def run_uda(uda, values, gids, num_groups, mask=None, split=None):
+    """Drive a UDA through update(+optional split/merge) and finalize.
+
+    Everything runs under one jit: eager per-op dispatch is pathologically
+    slow in this environment, and the real engine only ever runs UDAs
+    inside compiled fragments anyway. Float columns are cast to f32 to
+    match the physical device plane dtype.
+    """
+    values = np.asarray(values)
+    if values.dtype == np.float64:
+        values = values.astype(np.float32)
+    values = jnp.asarray(values)
+    gids = jnp.asarray(np.asarray(gids), dtype=jnp.int32)
+    mask = jnp.ones(values.shape[0], dtype=bool) if mask is None else jnp.asarray(mask)
+    if split is None:
+
+        @jax.jit
+        def go(v, g, m):
+            return uda.finalize(uda.update(uda.init(num_groups), g, m, v))
+
+        return np.asarray(go(values, gids, mask))
+
+    @jax.jit
+    def go2(v1, g1, m1, v2, g2, m2):
+        c1 = uda.update(uda.init(num_groups), g1, m1, v1)
+        c2 = uda.update(uda.init(num_groups), g2, m2, v2)
+        return uda.finalize(uda.merge(c1, c2))
+
+    return np.asarray(
+        go2(values[:split], gids[:split], mask[:split], values[split:], gids[split:], mask[split:])
+    )
+
+
+class TestUDAs:
+    def test_sum_mean_count(self, reg):
+        vals = np.array([1.0, 2.0, 3.0, 10.0, 20.0])
+        gids = np.array([0, 0, 0, 1, 1])
+        np.testing.assert_allclose(run_uda(reg.get_uda("sum", (F64,)), vals, gids, 3), [6, 30, 0])
+        np.testing.assert_allclose(run_uda(reg.get_uda("mean", (F64,)), vals, gids, 3)[:2], [2, 15])
+        np.testing.assert_array_equal(run_uda(reg.get_uda("count", (F64,)), vals, gids, 3)[:2], [3, 2])
+
+    def test_mask_excluded(self, reg):
+        vals = np.array([1.0, 100.0, 2.0])
+        gids = np.array([0, 0, 0])
+        mask = np.array([True, False, True])
+        out = run_uda(reg.get_uda("sum", (F64,)), vals, gids, 1, mask=mask)
+        np.testing.assert_allclose(out, [3.0])
+
+    def test_min_max_merge(self, reg):
+        vals = np.array([5, 1, 9, -7], dtype=np.int64)
+        gids = np.array([0, 0, 1, 1])
+        assert list(run_uda(reg.get_uda("min", (I64,)), vals, gids, 2, split=2)) == [1, -7]
+        assert list(run_uda(reg.get_uda("max", (I64,)), vals, gids, 2, split=2)) == [5, 9]
+
+    def test_partial_agg_equals_full(self, reg):
+        """merge(update(a), update(b)) == update(a+b) — the PEM/Kelvin split."""
+        rng = np.random.default_rng(1)
+        vals = rng.normal(size=1000)
+        gids = rng.integers(0, 10, 1000)
+        full = run_uda(reg.get_uda("mean", (F64,)), vals, gids, 10)
+        split = run_uda(reg.get_uda("mean", (F64,)), vals, gids, 10, split=517)
+        np.testing.assert_allclose(full, split, rtol=1e-9)
+
+    def test_any(self, reg):
+        vals = np.array([3, 3, 7], dtype=np.int64)
+        gids = np.array([0, 0, 1])
+        out = run_uda(reg.get_uda("any", (I64,)), vals, gids, 2)
+        assert out[0] == 3 and out[1] == 7
+
+
+class TestSketches:
+    def test_quantiles_accuracy(self, reg):
+        rng = np.random.default_rng(2)
+        vals = rng.lognormal(mean=3.0, sigma=1.0, size=20000)
+        gids = np.zeros(20000, dtype=np.int32)
+        uda = reg.get_uda("quantiles", (F64,))
+        assert uda.struct_fields == ("p01", "p10", "p25", "p50", "p75", "p90", "p99")
+        out = run_uda(uda, vals, gids, 1)
+        truth = np.percentile(vals, [1, 10, 25, 50, 75, 90, 99])
+        rel_err = np.abs(out[0] - truth) / truth
+        assert np.all(rel_err < 0.05), (out[0], truth, rel_err)
+
+    def test_quantiles_merge_close_to_full(self, reg):
+        rng = np.random.default_rng(3)
+        vals = rng.normal(100.0, 15.0, size=8000)
+        gids = (np.arange(8000) % 2).astype(np.int32)
+        uda = reg.get_uda("quantiles", (F64,))
+        full = run_uda(uda, vals, gids, 2)
+        merged = run_uda(uda, vals, gids, 2, split=3000)
+        np.testing.assert_allclose(full, merged, rtol=0.05)
+        truth = np.percentile(vals[gids == 0], 50)
+        assert abs(full[0, 3] - truth) / truth < 0.03
+
+    def test_quantile_empty_group_nan(self, reg):
+        uda = reg.get_uda("quantiles", (F64,))
+        out = run_uda(uda, np.array([1.0]), np.array([0]), 2)
+        assert np.all(np.isnan(out[1]))
+
+    def test_count_distinct(self, reg):
+        rng = np.random.default_rng(4)
+        true_card = 5000
+        vals = rng.integers(0, true_card, size=50000)
+        # ensure all values present
+        vals[:true_card] = np.arange(true_card)
+        gids = np.zeros(50000, dtype=np.int32)
+        uda = reg.get_uda("count_distinct", (I64,))
+        est = run_uda(uda, vals.astype(np.int64), gids, 1)[0]
+        assert abs(est - true_card) / true_card < 0.10, est
+
+    def test_count_distinct_small_range(self, reg):
+        uda = reg.get_uda("count_distinct", (I64,))
+        vals = np.array([1, 2, 3, 1, 2, 3, 4], dtype=np.int64)
+        est = run_uda(uda, vals, np.zeros(7, dtype=np.int32), 1)[0]
+        assert est == 4
+
+    def test_count_distinct_merge(self, reg):
+        uda = reg.get_uda("count_distinct", (I64,))
+        vals = np.arange(2000, dtype=np.int64)
+        gids = np.zeros(2000, dtype=np.int32)
+        full = run_uda(uda, vals, gids, 1)[0]
+        split = run_uda(uda, vals, gids, 1, split=1000)[0]
+        assert full == split  # HLL merge is exact (register max)
