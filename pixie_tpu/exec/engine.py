@@ -1,0 +1,474 @@
+"""Query engine: plan DAG -> streamed, jit-compiled execution.
+
+Reference parity: the Carnot facade (``src/carnot/carnot.h:39-95``
+Carnot::ExecutePlan) + ExecutionGraph (``exec/exec_graph.cc:295``). The
+TPU execution model:
+
+- Each maximal linear chain of Map/Filter/Agg/Limit over one input
+  compiles to a single fragment program (see fragment.py).
+- Tables stream through in fixed-capacity windows (static shapes -> one
+  compile, reused every window; the Table::Cursor batch loop analog).
+- DAG joints (Join/Union) materialize their small (post-agg) inputs and
+  continue; joins run host-side on dense ids (N:1, right-unique).
+- Aggregation group state survives across windows via the regroup
+  machinery, so a billion-row table aggregates in O(windows) device
+  dispatches with O(G) memory.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..types.batch import HostBatch, bucket_capacity
+from ..types.dtypes import DataType, host_dtypes
+from ..types.relation import Relation
+from ..types.strings import NULL_ID, StringDictionary
+from ..udf.registry import Registry, default_registry
+from .fragment import compile_fragment
+from .plan import (
+    AggOp,
+    FilterOp,
+    JoinOp,
+    LimitOp,
+    MapOp,
+    MemorySourceOp,
+    Plan,
+    ResultSinkOp,
+    UnionOp,
+)
+
+
+class QueryError(Exception):
+    pass
+
+
+@dataclass
+class InMemoryTable:
+    """Minimal table: shared per-column dictionaries + row batches.
+
+    Stand-in for the full hot/cold Table (stage 6); the engine only needs
+    ``scan()`` -> HostBatch windows with shared dictionaries.
+    """
+
+    name: str
+    relation: Relation
+    dicts: dict = field(default_factory=dict)
+    batches: list = field(default_factory=list)
+
+    def append(self, data, time_cols=("time_",)) -> HostBatch:
+        hb = (
+            data
+            if isinstance(data, HostBatch)
+            else HostBatch.from_pydict(
+                data,
+                relation=self.relation if len(self.relation) else None,
+                time_cols=time_cols,
+                dicts=self.dicts,
+            )
+        )
+        if not len(self.relation):
+            self.relation = hb.relation
+        for col, d in hb.dicts.items():
+            self.dicts.setdefault(col, d)
+        self.batches.append(hb)
+        return hb
+
+    @property
+    def num_rows(self) -> int:
+        return sum(b.length for b in self.batches)
+
+    def scan(self, start_time=None, stop_time=None):
+        """Yield batches, time-bounded on the ``time_`` column."""
+        for b in self.batches:
+            if (start_time is None and stop_time is None) or not b.relation.has_column(
+                "time_"
+            ):
+                yield b
+                continue
+            t = b.cols["time_"][0]
+            keep = np.ones(b.length, dtype=bool)
+            if start_time is not None:
+                keep &= t >= start_time
+            if stop_time is not None:
+                keep &= t < stop_time
+            if keep.all():
+                yield b
+            elif keep.any():
+                idx = np.nonzero(keep)[0]
+                yield HostBatch(
+                    relation=b.relation,
+                    cols={n: tuple(p[idx] for p in ps) for n, ps in b.cols.items()},
+                    length=len(idx),
+                    dicts=b.dicts,
+                )
+
+
+@dataclass
+class _Stream:
+    relation: Relation
+    dicts: dict
+    chain: list
+    source: object  # InMemoryTable | HostBatch
+    source_op: Optional[MemorySourceOp] = None
+
+    def extend(self, op):
+        return _Stream(self.relation, self.dicts, self.chain + [op], self.source, self.source_op)
+
+
+class Engine:
+    """Owns tables + registry; executes plans. (EngineState analog,
+    ``src/carnot/engine_state.h``.)"""
+
+    def __init__(self, registry: Registry | None = None, window_rows: int = 1 << 17):
+        self.registry = registry or default_registry()
+        self.tables: dict[str, InMemoryTable] = {}
+        self.window_rows = window_rows
+
+    # -- table management ----------------------------------------------------
+    def create_table(self, name: str, relation: Relation | None = None) -> InMemoryTable:
+        t = InMemoryTable(name=name, relation=relation or Relation())
+        self.tables[name] = t
+        return t
+
+    def append_data(self, name: str, data, time_cols=("time_",)):
+        """Push path (Stirling's RegisterDataPushCallback analog)."""
+        if name not in self.tables:
+            self.create_table(name)
+        return self.tables[name].append(data, time_cols=time_cols)
+
+    # -- execution -----------------------------------------------------------
+    def execute_plan(self, plan: Plan) -> dict:
+        results: dict[int, object] = {}
+        outputs: dict[str, HostBatch] = {}
+        consumers: dict[int, int] = {}
+        for n in plan.nodes.values():
+            for i in n.inputs:
+                consumers[i] = consumers.get(i, 0) + 1
+
+        def mat_input(nid):
+            """Materialize a node's result once; cache for fan-out."""
+            r = results[nid]
+            if not isinstance(r, HostBatch):
+                r = self._materialize(r)
+                results[nid] = r
+            return r
+
+        for nid in plan.topo_order():
+            node = plan.nodes[nid]
+            op = node.op
+            if isinstance(op, MemorySourceOp):
+                if op.table not in self.tables:
+                    raise QueryError(f"no table named {op.table!r}")
+                table = self.tables[op.table]
+                rel = table.relation
+                chain = []
+                if op.columns is not None:
+                    chain.append(
+                        MapOp(exprs=tuple((c, _col(c)) for c in op.columns))
+                    )
+                results[nid] = _Stream(rel, dict(table.dicts), chain, table, op)
+            elif isinstance(op, (MapOp, FilterOp, AggOp, LimitOp)):
+                st = self._as_stream(results[node.inputs[0]])
+                if st.chain and isinstance(st.chain[-1], LimitOp):
+                    # A limit terminates its fragment: apply the cap at its
+                    # plan position, then keep chaining on the result.
+                    st = self._as_stream(self._materialize(st))
+                results[nid] = st.extend(op)
+            elif isinstance(op, JoinOp):
+                left = mat_input(node.inputs[0])
+                right = mat_input(node.inputs[1])
+                results[nid] = _join_host(left, right, op)
+            elif isinstance(op, UnionOp):
+                mats = [mat_input(i) for i in node.inputs]
+                results[nid] = _union_host(mats)
+            elif isinstance(op, ResultSinkOp):
+                outputs[op.name] = mat_input(node.inputs[0])
+            else:
+                raise QueryError(f"unsupported operator {op}")
+            # Fan-out of a stream: materialize once, share the batch.
+            if consumers.get(nid, 0) > 1 and isinstance(results[nid], _Stream):
+                results[nid] = self._materialize(results[nid])
+        return outputs
+
+    # -- internals -----------------------------------------------------------
+    def _as_stream(self, res) -> _Stream:
+        if isinstance(res, _Stream):
+            return res
+        hb: HostBatch = res
+        return _Stream(hb.relation, dict(hb.dicts), [], hb)
+
+    def _windows(self, stream: _Stream):
+        """Slice source batches into <= window_rows chunks."""
+        if isinstance(stream.source, HostBatch):
+            batches = [stream.source]
+        else:
+            sop = stream.source_op
+            batches = list(
+                stream.source.scan(
+                    sop.start_time if sop else None, sop.stop_time if sop else None
+                )
+            )
+        for b in batches:
+            for off in range(0, max(b.length, 1), self.window_rows):
+                if b.length == 0:
+                    yield b
+                    break
+                idx = slice(off, min(off + self.window_rows, b.length))
+                if idx.start == 0 and idx.stop == b.length:
+                    yield b
+                else:
+                    yield HostBatch(
+                        relation=b.relation,
+                        cols={
+                            n: tuple(p[idx] for p in ps) for n, ps in b.cols.items()
+                        },
+                        length=idx.stop - idx.start,
+                        dicts=b.dicts,
+                    )
+
+    def _materialize(self, res) -> HostBatch:
+        if isinstance(res, HostBatch):
+            return res
+        stream: _Stream = res
+        frag = compile_fragment(
+            stream.chain, stream.relation, stream.dicts, self.registry
+        )
+        capacity = bucket_capacity(self.window_rows)
+
+        if frag.is_agg:
+            state = frag.init_state()
+            for hb in self._windows(stream):
+                db = hb.to_device(max(capacity, bucket_capacity(hb.length)))
+                state = frag.update(state, db.cols, db.valid)
+            cols, valid, overflow = frag.finalize(state)
+            if bool(overflow):
+                raise QueryError(
+                    "group-by overflow: more distinct groups than max_groups; "
+                    "raise AggOp.max_groups"
+                )
+            out = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
+            return _apply_limit(out, frag.limit)
+
+        # Non-agg: stream windows, stop early once a limit is satisfied.
+        pieces, total = [], 0
+        for hb in self._windows(stream):
+            db = hb.to_device(max(capacity, bucket_capacity(hb.length)))
+            cols, valid = frag.update(db.cols, db.valid)
+            piece = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
+            pieces.append(piece)
+            total += piece.length
+            if frag.limit is not None and total >= frag.limit:
+                break
+        out = _concat_host(pieces, frag.relation)
+        return _apply_limit(out, frag.limit)
+
+
+def _col(name):
+    from .plan import ColumnRef
+
+    return ColumnRef(name)
+
+
+def _to_host_batch(meta_list, cols, valid) -> HostBatch:
+    idx = np.nonzero(valid)[0]
+    out_cols: dict = {}
+    dicts: dict = {}
+    rel_items = []
+    for m in meta_list:
+        if m.struct_fields is not None:
+            planes = np.asarray(cols[m.name][0])[idx]  # [rows, k] floats
+            d = StringDictionary()
+            ids = np.fromiter(
+                (
+                    d.get_or_add(
+                        json.dumps(
+                            {f: round(float(v), 6) for f, v in zip(m.struct_fields, row)}
+                        )
+                    )
+                    for row in planes
+                ),
+                dtype=np.int32,
+                count=len(planes),
+            )
+            out_cols[m.name] = (ids,)
+            dicts[m.name] = d
+            rel_items.append((m.name, DataType.STRING))
+            continue
+        hdts = host_dtypes(m.dtype)
+        out_cols[m.name] = tuple(
+            np.asarray(p)[idx].astype(h) for p, h in zip(cols[m.name], hdts)
+        )
+        if m.dict is not None:
+            dicts[m.name] = m.dict
+        rel_items.append((m.name, m.dtype))
+    return HostBatch(
+        relation=Relation(rel_items), cols=out_cols, length=len(idx), dicts=dicts
+    )
+
+
+def _empty_host_batch(relation, dicts=None) -> HostBatch:
+    cols = {
+        n: tuple(np.empty(0, dtype=h) for h in host_dtypes(t))
+        for n, t in relation.items()
+    }
+    return HostBatch(relation=relation, cols=cols, length=0, dicts=dict(dicts or {}))
+
+
+def _concat_host(pieces, relation) -> HostBatch:
+    nonempty = [p for p in pieces if p.length > 0]
+    if not nonempty:
+        dicts = pieces[0].dicts if pieces else {}
+        return _empty_host_batch(relation, dicts)
+    pieces = nonempty
+    first = pieces[0]
+    if len(pieces) == 1:
+        return first
+    cols = {
+        n: tuple(
+            np.concatenate([p.cols[n][i] for p in pieces])
+            for i in range(len(first.cols[n]))
+        )
+        for n in first.relation.column_names
+    }
+    return HostBatch(
+        relation=first.relation,
+        cols=cols,
+        length=sum(p.length for p in pieces),
+        dicts=first.dicts,
+    )
+
+
+def _apply_limit(hb: HostBatch, limit) -> HostBatch:
+    if limit is None or hb.length <= limit:
+        return hb
+    return HostBatch(
+        relation=hb.relation,
+        cols={n: tuple(p[:limit] for p in ps) for n, ps in hb.cols.items()},
+        length=limit,
+        dicts=hb.dicts,
+    )
+
+
+def _key_tuples(hb: HostBatch, on, remaps):
+    keys = []
+    for c in on:
+        ids = hb.cols[c][0]
+        if c in remaps:
+            ids = remaps[c][ids]
+        keys.append(ids)
+    extra = [hb.cols[c][1] for c in on if len(hb.cols[c]) > 1]
+    return list(zip(*(list(k) for k in (keys + extra)))) if keys else []
+
+
+def _join_host(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
+    """N:1 equijoin on host (post-agg inputs are small).
+
+    Reference: ``src/carnot/exec/equijoin_node.cc`` build+probe — here the
+    build side must be unique on the key.
+    """
+    if len(op.left_on) != len(op.right_on):
+        raise QueryError("join key arity mismatch")
+    # Align string dictionaries between sides for key columns.
+    l_remap: dict = {}
+    r_remap: dict = {}
+    for lc, rc in zip(op.left_on, op.right_on):
+        ld, rd = left.dicts.get(lc), right.dicts.get(rc)
+        if ld is not None and rd is not None and ld is not rd:
+            merged, rl, rr = ld.union(rd)
+            l_remap[lc], r_remap[rc] = rl, rr
+
+    lk = _key_tuples(left, op.left_on, l_remap)
+    rk = _key_tuples(right, op.right_on, r_remap)
+    lookup: dict = {}
+    for i, k in enumerate(rk):
+        if k in lookup:
+            raise QueryError(
+                f"join build side not unique on key {op.right_on} (dup {k})"
+            )
+        lookup[k] = i
+
+    match = np.fromiter((lookup.get(k, -1) for k in lk), dtype=np.int64, count=len(lk))
+    if op.how == "inner":
+        l_idx = np.nonzero(match >= 0)[0]
+    elif op.how == "left":
+        l_idx = np.arange(left.length)
+    else:
+        raise QueryError(f"unsupported join how={op.how!r}")
+    r_idx = match[l_idx]
+
+    out_rel = left.relation.merge(
+        right.relation.select(
+            [c for c in right.relation.column_names if c not in op.right_on]
+        ),
+        suffix=op.suffix,
+    )
+    out_cols: dict = {}
+    out_dicts: dict = {}
+    names = iter(out_rel.column_names)
+    for c in left.relation.column_names:
+        n = next(names)
+        out_cols[n] = tuple(p[l_idx] for p in left.cols[c])
+        if c in left.dicts:
+            out_dicts[n] = left.dicts[c]
+    for c in right.relation.column_names:
+        if c in op.right_on:
+            continue
+        n = next(names)
+        planes = []
+        nullv = NULL_ID if right.relation.col_type(c) == DataType.STRING else 0
+        for p in right.cols[c]:
+            if len(p) == 0:  # empty build side: all-null fill
+                taken = np.full(len(l_idx), nullv, dtype=p.dtype)
+            else:
+                taken = p[np.clip(r_idx, 0, None)]
+                if op.how == "left":
+                    taken = np.where(r_idx >= 0, taken, nullv).astype(p.dtype)
+            planes.append(taken)
+        out_cols[n] = tuple(planes)
+        if c in right.dicts:
+            out_dicts[n] = right.dicts[c]
+    return HostBatch(
+        relation=out_rel, cols=out_cols, length=len(l_idx), dicts=out_dicts
+    )
+
+
+def _union_host(mats) -> HostBatch:
+    """Schema-aligned concatenation with dictionary re-encoding."""
+    first = mats[0]
+    for m in mats[1:]:
+        if tuple(m.relation.column_names) != tuple(first.relation.column_names):
+            raise QueryError("union inputs must share a schema")
+    out_cols: dict = {}
+    out_dicts: dict = {}
+    for c, dt in first.relation.items():
+        if dt == DataType.STRING:
+            merged = StringDictionary()
+            planes = []
+            for m in mats:
+                d = m.dicts.get(c, StringDictionary())
+                # union preserves existing ids (append-only), so earlier
+                # planes stay valid as merged grows.
+                merged, _, remap = merged.union(d)
+                ids = m.cols[c][0]
+                planes.append(
+                    np.where(ids >= 0, remap[np.clip(ids, 0, None)], NULL_ID).astype(
+                        np.int32
+                    )
+                )
+            out_cols[c] = (np.concatenate(planes),)
+            out_dicts[c] = merged
+        else:
+            out_cols[c] = tuple(
+                np.concatenate([m.cols[c][i] for m in mats])
+                for i in range(len(first.cols[c]))
+            )
+    return HostBatch(
+        relation=first.relation,
+        cols=out_cols,
+        length=sum(m.length for m in mats),
+        dicts=out_dicts,
+    )

@@ -1,0 +1,206 @@
+"""Expression binder: Expr tree x Relation x dictionaries -> device closure.
+
+Reference parity: ``src/carnot/exec/expression_evaluator.{h,cc}`` — but
+where Carnot walks the expression tree per RowBatch (vector- or
+arrow-native, ``expression_evaluator.h:89-91``), here the whole tree is
+bound ONCE into a jnp closure that XLA fuses into the fragment program.
+
+Binding rules:
+- DEVICE UDFs: recursive bind, implicit casts from the lattice, traced.
+- HOST_DICT UDFs: the string argument's dictionary is transformed
+  host-side at bind time; the device sees an int32 gather (lookup table
+  for scalar returns, id-remap for string returns).
+- STRING literals are encoded against the sibling argument's dictionary
+  (equality filters on unseen literals become id==-1: always false).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..types.dtypes import DataType
+from ..types.strings import NULL_ID, StringDictionary
+from ..udf.registry import Registry
+from ..udf.udf import Executor, apply_cast
+from .plan import ColumnRef, Expr, FuncCall, Literal
+
+
+class BindError(TypeError):
+    pass
+
+
+@dataclass
+class BoundExpr:
+    """fn(cols: dict[str, planes-tuple]) -> plane array (broadcastable)."""
+
+    fn: Callable
+    dtype: DataType
+    # For STRING-typed results: the dictionary its int32 ids refer to.
+    dict: Optional[StringDictionary] = None
+
+
+def bind_expr(expr: Expr, relation, dicts, registry: Registry) -> BoundExpr:
+    if isinstance(expr, ColumnRef):
+        if not relation.has_column(expr.name):
+            raise BindError(f"unknown column {expr.name!r} in {relation}")
+        dt = relation.col_type(expr.name)
+        name = expr.name
+        if dt == DataType.UINT128:
+            fn = lambda cols: cols[name]  # (hi, lo) tuple
+        else:
+            fn = lambda cols: cols[name][0]
+        return BoundExpr(fn=fn, dtype=dt, dict=dicts.get(name))
+
+    if isinstance(expr, Literal):
+        if expr.dtype == DataType.STRING:
+            # Encoded later, in FuncCall context (needs a sibling dict).
+            raise BindError(
+                f"string literal {expr.value!r} outside a function context"
+            )
+        val = expr.value
+        return BoundExpr(fn=lambda cols: jnp.asarray(val), dtype=expr.dtype)
+
+    if isinstance(expr, FuncCall):
+        return _bind_func(expr, relation, dicts, registry)
+
+    raise BindError(f"cannot bind expression {expr!r}")
+
+
+def _bind_func(expr: FuncCall, relation, dicts, registry: Registry) -> BoundExpr:
+    # Bind non-string-literal args first to learn types and dictionaries.
+    bound: list = [None] * len(expr.args)
+    str_literals: list = []
+    for i, a in enumerate(expr.args):
+        if isinstance(a, Literal) and a.dtype == DataType.STRING:
+            str_literals.append(i)
+        else:
+            bound[i] = bind_expr(a, relation, dicts, registry)
+
+    arg_types = [
+        DataType.STRING if i in str_literals else bound[i].dtype
+        for i in range(len(expr.args))
+    ]
+    udf = registry.get_scalar(expr.name, arg_types)
+
+    if udf.executor == Executor.HOST_DICT:
+        return _bind_host_dict(expr, udf, bound, str_literals, relation, dicts, registry)
+
+    # DEVICE: ids from different dictionaries are not comparable — align
+    # every STRING arg onto one shared dictionary (id-preserving union;
+    # later args get a remap gather). The union snapshots the dictionaries
+    # at bind time: queries assume no concurrent appends to the source
+    # table while executing (the service shell serializes these).
+    sibling_dict = None
+    for i, b in enumerate(bound):
+        if b is None or b.dict is None:
+            continue
+        if sibling_dict is None:
+            sibling_dict = b.dict
+        elif b.dict is not sibling_dict:
+            merged, _, remap = sibling_dict.union(b.dict)
+            remap_j = jnp.asarray(remap)
+            prev_fn = b.fn
+            bound[i] = BoundExpr(
+                fn=(
+                    lambda _f, _r: (
+                        lambda cols: jnp.where(
+                            (ids := _f(cols)) >= 0, _r[jnp.clip(ids, 0)], NULL_ID
+                        )
+                    )
+                )(prev_fn, remap_j),
+                dtype=DataType.STRING,
+                dict=merged,
+            )
+            sibling_dict = merged
+
+    # Encode string literals against the shared dictionary.
+    for i in str_literals:
+        lit = expr.args[i]
+        if sibling_dict is None:
+            raise BindError(
+                f"string literal {lit.value!r} in {expr.name} has no sibling "
+                "dictionary to encode against"
+            )
+        lit_id = sibling_dict.lookup(lit.value)
+        bound[i] = BoundExpr(
+            fn=(lambda _id: (lambda cols: jnp.asarray(_id, dtype=jnp.int32)))(lit_id),
+            dtype=DataType.STRING,
+            dict=sibling_dict,
+        )
+
+    casts = list(zip(arg_types, udf.arg_types))
+    arg_fns = [b.fn for b in bound]
+    fn_udf = udf.fn
+
+    def fn(cols):
+        vals = [apply_cast(f(cols), have, want) for f, (have, want) in zip(arg_fns, casts)]
+        return fn_udf(*vals)
+
+    out_dict = sibling_dict if udf.return_type == DataType.STRING else None
+    return BoundExpr(fn=fn, dtype=udf.return_type, dict=out_dict)
+
+
+def _bind_host_dict(expr, udf, bound, str_literals, relation, dicts, registry) -> BoundExpr:
+    """Run the UDF over the dictionary host-side; device applies a gather."""
+    d_i = udf.dict_arg
+    if d_i in str_literals or bound[d_i] is None or bound[d_i].dict is None:
+        raise BindError(
+            f"{udf.name}: argument {d_i} must be a string column/expression "
+            "with a dictionary"
+        )
+    src = bound[d_i]
+    src_dict = src.dict
+
+    # All other args must be literals (reference: these are Init() args of
+    # the C++ UDFs — compile-time constants).
+    literal_vals: dict[int, object] = {}
+    for i, a in enumerate(expr.args):
+        if i == d_i:
+            continue
+        if not isinstance(a, Literal):
+            raise BindError(
+                f"{udf.name}: argument {i} must be a literal (host-dict UDF)"
+            )
+        literal_vals[i] = a.value
+
+    def call_one(s: str):
+        args = [literal_vals.get(i) if i != d_i else s for i in range(len(expr.args))]
+        return udf.fn(*args)
+
+    src_fn = src.fn
+    if udf.return_type == DataType.STRING:
+        new_dict, remap = src_dict.transform(call_one)
+        remap_j = jnp.asarray(remap)
+
+        def fn(cols):
+            ids = src_fn(cols)
+            return jnp.where(ids >= 0, remap_j[jnp.clip(ids, 0)], NULL_ID)
+
+        return BoundExpr(fn=fn, dtype=DataType.STRING, dict=new_dict)
+
+    null_value = {
+        DataType.BOOLEAN: False,
+        DataType.INT64: 0,
+        DataType.FLOAT64: float("nan"),
+        DataType.TIME64NS: 0,
+    }[udf.return_type]
+    np_dt = {
+        DataType.BOOLEAN: np.bool_,
+        DataType.INT64: np.int64,
+        DataType.FLOAT64: np.float32,
+        DataType.TIME64NS: np.int64,
+    }[udf.return_type]
+    table = np.asarray([call_one(s) for s in src_dict.strings] + [null_value], dtype=np_dt)
+    table_j = jnp.asarray(table)
+    k = len(src_dict.strings)
+
+    def fn(cols):
+        ids = src_fn(cols)
+        safe = jnp.where((ids >= 0) & (ids < k), ids, k)
+        return table_j[safe]
+
+    return BoundExpr(fn=fn, dtype=udf.return_type)

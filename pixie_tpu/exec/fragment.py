@@ -1,0 +1,287 @@
+"""Fragment compiler: a linear operator chain -> one jitted XLA program.
+
+Reference contrast: Carnot instantiates an ExecutionGraph of exec nodes and
+pushes RowBatches through virtual ConsumeNext calls
+(``src/carnot/exec/exec_graph.cc:295``). Here the whole chain
+{Map/Filter -> BlockingAgg -> Map/Filter/Limit} is traced into TWO
+functions:
+
+- ``update(state, cols, valid)``: folds one staged window into the group
+  state (or, for non-aggregating chains, produces the window's output
+  batch). Runs once per window under jit — XLA fuses projections, filter
+  masks, group-id sorts and UDA segment updates into one program.
+- ``finalize(state)``: UDA finalize + post-agg ops -> output columns.
+
+Group state is a pytree {keys, valid, carries, overflow}; windows merge
+via the regroup machinery (``pixie_tpu.ops.groupby``), the same path a
+multi-device partial-agg merge uses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.groupby import dense_group_ids, regroup_pair, scatter_carry
+from ..types.dtypes import DataType, device_dtypes, pad_values
+from ..types.relation import Relation
+from ..udf.registry import Registry
+from ..udf.udf import UDADef, apply_cast
+from .expr import BindError, BoundExpr, bind_expr
+from .plan import AggOp, FilterOp, LimitOp, MapOp
+
+
+@dataclass
+class ColumnMeta:
+    """Host-side metadata for one output column."""
+
+    name: str
+    dtype: DataType
+    dict: object = None  # StringDictionary for STRING columns
+    struct_fields: Optional[tuple] = None  # sketch JSON struct (quantiles)
+
+
+@dataclass
+class CompiledFragment:
+    relation: Relation  # device-visible output relation
+    out_meta: list  # list[ColumnMeta] incl. struct columns
+    is_agg: bool
+    update: object = None  # jitted
+    finalize: object = None  # jitted (agg only)
+    init_state: object = None  # callable -> state pytree (agg only)
+    limit: Optional[int] = None  # host-enforced row cap (non-agg chains)
+
+
+def _bind_pre_stage(ops, relation, dicts, registry):
+    """Bind leading Map/Filter ops; returns (apply_fn, relation, dicts)."""
+    steps = []  # ("map", [(name, BoundExpr)]) | ("filter", BoundExpr)
+    for op in ops:
+        if isinstance(op, MapOp):
+            bound = [(name, bind_expr(e, relation, dicts, registry)) for name, e in op.exprs]
+            steps.append(("map", bound))
+            relation = Relation([(n, b.dtype) for n, b in bound])
+            dicts = {n: b.dict for n, b in bound if b.dict is not None}
+        elif isinstance(op, FilterOp):
+            b = bind_expr(op.predicate, relation, dicts, registry)
+            if b.dtype != DataType.BOOLEAN:
+                raise BindError(f"filter predicate has type {b.dtype}, want BOOLEAN")
+            steps.append(("filter", b))
+        else:
+            raise AssertionError(op)
+
+    def apply(cols, valid):
+        for kind, payload in steps:
+            if kind == "map":
+                cols = {
+                    name: v if isinstance(v := b.fn(cols), tuple) else (v,)
+                    for name, b in payload
+                }
+            else:
+                valid = valid & jnp.broadcast_to(payload.fn(cols), valid.shape)
+        return cols, valid
+
+    return apply, relation, dicts
+
+
+def _split_chain(ops):
+    """[pre(map/filter)...] [agg]? [post(map/filter)...] [limit at end]?
+
+    A LimitOp may only terminate a fragment — the engine splits chains at
+    interior limits so the cap applies at its plan position (Carnot's
+    LimitNode aborts upstream sources the same way,
+    ``src/carnot/exec/limit_node.h``).
+    """
+    pre, agg, post, limit = [], None, [], None
+    for i, op in enumerate(ops):
+        if isinstance(op, LimitOp):
+            if i != len(ops) - 1:
+                raise BindError(
+                    "LimitOp must terminate a fragment (engine splits chains)"
+                )
+            limit = op.n
+        elif isinstance(op, AggOp):
+            if agg is not None:
+                raise BindError("multiple aggregates in one fragment")
+            agg = op
+        elif agg is None:
+            pre.append(op)
+        else:
+            post.append(op)
+    return pre, agg, post, limit
+
+
+def compile_fragment(ops, input_relation, input_dicts, registry: Registry) -> CompiledFragment:
+    pre, agg, post, limit = _split_chain(ops)
+    apply_pre, rel1, dicts1 = _bind_pre_stage(pre, input_relation, dict(input_dicts), registry)
+
+    if agg is None:
+        if post:
+            raise AssertionError("post ops without agg should be in pre")
+        out_meta = [
+            ColumnMeta(name=n, dtype=t, dict=dicts1.get(n)) for n, t in rel1.items()
+        ]
+
+        @jax.jit
+        def update(cols, valid):
+            return apply_pre(cols, valid)
+
+        return CompiledFragment(
+            relation=rel1, out_meta=out_meta, is_agg=False, update=update, limit=limit
+        )
+
+    return _compile_agg(agg, post, limit, apply_pre, rel1, dicts1, registry)
+
+
+def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
+    g = agg.max_groups
+    for c in agg.group_cols:
+        if not rel1.has_column(c):
+            raise BindError(f"group column {c!r} not in {rel1}")
+
+    # Bind aggregate input expressions and resolve UDAs.
+    aggs_bound = []  # (AggExpr, UDADef, [BoundExpr], [cast pairs])
+    for ae in agg.aggs:
+        arg_bound = [bind_expr(a, rel1, dicts1, registry) for a in ae.args]
+        uda: UDADef = registry.get_uda(ae.uda_name, [b.dtype for b in arg_bound])
+        casts = list(zip([b.dtype for b in arg_bound], uda.arg_types))
+        aggs_bound.append((ae, uda, arg_bound, casts))
+
+    group_cols = list(agg.group_cols)
+    key_plane_index = []  # (col, plane_i) per key plane
+    for c in group_cols:
+        for i in range(len(device_dtypes(rel1.col_type(c)))):
+            key_plane_index.append((c, i))
+
+    def init_state():
+        keys = tuple(
+            jnp.full(
+                g,
+                pad_values(rel1.col_type(c))[i],
+                dtype=device_dtypes(rel1.col_type(c))[i],
+            )
+            for c, i in key_plane_index
+        )
+        carries = {ae.out_name: uda.init(g) for ae, uda, _, _ in aggs_bound}
+        return {
+            "keys": keys,
+            "valid": jnp.zeros(g, dtype=jnp.bool_),
+            "carries": carries,
+            "overflow": jnp.zeros((), dtype=jnp.bool_),
+        }
+
+    init_carries = {ae.out_name: uda.init(g) for ae, uda, _, _ in aggs_bound}
+
+    @jax.jit
+    def update(state, cols, valid):
+        cols, valid = apply_pre(cols, valid)
+        key_planes = [cols[c][i] for c, i in key_plane_index]
+        gids, keys_w, valid_w, n_w = dense_group_ids(key_planes, valid, g)
+
+        carries_w = {}
+        for ae, uda, arg_bound, casts in aggs_bound:
+            args = [
+                apply_cast(b.fn(cols), have, want)
+                for b, (have, want) in zip(arg_bound, casts)
+            ]
+            args = [jnp.broadcast_to(a, valid.shape) for a in args]
+            carries_w[ae.out_name] = uda.update(uda.init(g), gids, valid, *args)
+
+        ids_a, ids_b, m_keys, m_valid, n_tot = regroup_pair(
+            state["keys"], state["valid"], tuple(keys_w), valid_w, g
+        )
+        carries = {}
+        for ae, uda, _, _ in aggs_bound:
+            ca = scatter_carry(
+                state["carries"][ae.out_name], ids_a, state["valid"], g,
+                init_carries[ae.out_name],
+            )
+            cb = scatter_carry(
+                carries_w[ae.out_name], ids_b, valid_w, g, init_carries[ae.out_name]
+            )
+            carries[ae.out_name] = uda.merge(ca, cb)
+        overflow = state["overflow"] | (n_w > g) | (n_tot > g)
+        return {
+            "keys": tuple(m_keys),
+            "valid": m_valid,
+            "carries": carries,
+            "overflow": overflow,
+        }
+
+    # Output relation: group cols then agg outputs (struct sketches keep a
+    # [G, k] plane; they are host-materialized and opaque to post ops).
+    out_items = [(c, rel1.col_type(c)) for c in group_cols]
+    out_meta = [
+        ColumnMeta(name=c, dtype=rel1.col_type(c), dict=dicts1.get(c))
+        for c in group_cols
+    ]
+    struct_cols = set()
+    for ae, uda, arg_bound, _ in aggs_bound:
+        out_items.append((ae.out_name, uda.return_type))
+        if uda.struct_fields:
+            struct_cols.add(ae.out_name)
+            out_meta.append(
+                ColumnMeta(
+                    name=ae.out_name, dtype=uda.return_type,
+                    struct_fields=uda.struct_fields,
+                )
+            )
+        else:
+            d = arg_bound[0].dict if (
+                uda.return_type == DataType.STRING and arg_bound
+            ) else None
+            out_meta.append(ColumnMeta(name=ae.out_name, dtype=uda.return_type, dict=d))
+    out_rel = Relation(out_items)
+
+    # Bind post-agg ops against the non-struct view of the output.
+    post_rel = Relation([(n, t) for n, t in out_items if n not in struct_cols])
+    post_dicts = {m.name: m.dict for m in out_meta if m.dict is not None}
+    apply_post, post_rel_out, post_dicts_out = _bind_pre_stage(
+        post, post_rel, post_dicts, registry
+    )
+    # Struct planes never flow through device post-ops (the planner fuses
+    # pluck(quantiles(...)) into _quantile_* UDAs instead). Post filters
+    # keep all columns, so struct columns survive them; a post MapOp is a
+    # full projection and cannot reference struct columns (binding against
+    # post_rel, which excludes them, raises).
+    post_has_map = any(isinstance(op, MapOp) for op in post)
+    if post:
+        final_meta = [
+            ColumnMeta(n, post_rel_out.col_type(n), dict=post_dicts_out.get(n))
+            for n in post_rel_out.column_names
+        ]
+        if not post_has_map:
+            final_meta += [m for m in out_meta if m.struct_fields is not None]
+        out_rel = post_rel_out
+    else:
+        final_meta = out_meta
+
+    @jax.jit
+    def finalize(state):
+        cols = {}
+        for c, _ in zip(group_cols, range(len(group_cols))):
+            planes = tuple(
+                kp for kp, (kc, _i) in zip(state["keys"], key_plane_index) if kc == c
+            )
+            cols[c] = planes
+        for ae, uda, _, _ in aggs_bound:
+            out = uda.finalize(state["carries"][ae.out_name])
+            cols[ae.out_name] = (out,)
+        valid = state["valid"]
+        device_cols = {n: p for n, p in cols.items() if n not in struct_cols}
+        device_cols, valid = apply_post(device_cols, valid)
+        for s in struct_cols:
+            device_cols[s] = cols[s]
+        return device_cols, valid, state["overflow"]
+
+    return CompiledFragment(
+        relation=out_rel,
+        out_meta=final_meta,
+        is_agg=True,
+        update=update,
+        finalize=finalize,
+        init_state=init_state,
+        limit=limit,
+    )

@@ -1,0 +1,186 @@
+"""Physical plan representation: operators + scalar expression trees.
+
+Reference parity: ``src/carnot/plan/operators.h:49`` (Operator hierarchy:
+MemorySource/Map/Filter/BlockingAgg/Join/Limit/MemorySink/GRPCSink...) and
+``src/carnot/plan/scalar_expression.h`` (ScalarValue/Column/ScalarFunc/
+AggregateExpression). The plan is a DAG of nodes; linear runs of
+Map/Filter/Agg compile into ONE jitted fragment program instead of a
+push-based exec-node chain.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..types.dtypes import DataType
+
+
+# -- scalar expressions ------------------------------------------------------
+class Expr:
+    pass
+
+
+@dataclass(frozen=True)
+class ColumnRef(Expr):
+    name: str
+
+    def __repr__(self):
+        return f"col({self.name})"
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: object
+    dtype: DataType
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+@dataclass(frozen=True)
+class FuncCall(Expr):
+    name: str
+    args: tuple
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+@dataclass(frozen=True)
+class AggExpr:
+    """One aggregate output: out_name = uda_name(*args)."""
+
+    out_name: str
+    uda_name: str
+    args: tuple  # tuple[Expr]; evaluated pre-aggregation
+
+
+# -- operators ---------------------------------------------------------------
+class Op:
+    pass
+
+
+@dataclass(frozen=True)
+class MemorySourceOp(Op):
+    """Stream a table out of the table store, time-bounded.
+
+    Reference: ``src/carnot/exec/memory_source_node.h:42``.
+    """
+
+    table: str
+    columns: Optional[tuple] = None  # None = all
+    start_time: Optional[int] = None
+    stop_time: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class MapOp(Op):
+    """Full projection: output columns are exactly ``exprs``.
+
+    Reference: ``src/carnot/exec/map_node.h``.
+    """
+
+    exprs: tuple  # tuple[(name, Expr)]
+
+
+@dataclass(frozen=True)
+class FilterOp(Op):
+    """Reference: ``src/carnot/exec/filter_node.h`` — here a mask &=, no copy."""
+
+    predicate: Expr
+
+
+@dataclass(frozen=True)
+class AggOp(Op):
+    """Group-by aggregate (blocking).
+
+    Reference: ``src/carnot/exec/agg_node.h:66``. ``partial``/``finalize``
+    mirror the distributed splitter's partial-op protocol
+    (``planner/distributed/splitter/partial_op_mgr``): a partial agg emits
+    carries; a finalize agg merges carries. The single-chip path runs both
+    fused.
+    """
+
+    group_cols: tuple  # tuple[str]
+    aggs: tuple  # tuple[AggExpr]
+    max_groups: int = 4096
+
+
+@dataclass(frozen=True)
+class JoinOp(Op):
+    """Equijoin; right side must be unique on the key (N:1).
+
+    Reference: ``src/carnot/exec/equijoin_node.h:48``. General N:M
+    fan-out joins need data-dependent output sizes; the observability
+    workload joins aggregated (unique-key) tables, which is what this
+    covers. how: 'inner' | 'left'.
+    """
+
+    left_on: tuple
+    right_on: tuple
+    how: str = "inner"
+    suffix: str = "_y"
+
+
+@dataclass(frozen=True)
+class LimitOp(Op):
+    """Reference: ``src/carnot/exec/limit_node.h`` (+ source abort signal)."""
+
+    n: int
+
+
+@dataclass(frozen=True)
+class UnionOp(Op):
+    """Concatenate inputs with identical schemas (k-way, time-ordered at
+    materialization). Reference: ``src/carnot/exec/union_node.h``."""
+
+
+@dataclass(frozen=True)
+class ResultSinkOp(Op):
+    """Terminal sink: materialize to the client result stream.
+
+    Reference: GRPCSinkNode/MemorySinkNode (``src/carnot/exec/grpc_sink_node.h:54``).
+    """
+
+    name: str = "output"
+
+
+@dataclass
+class PlanNode:
+    id: int
+    op: Op
+    inputs: list = field(default_factory=list)  # list[int]
+
+
+@dataclass
+class Plan:
+    """Operator DAG. Nodes are topologically ordered by construction."""
+
+    nodes: dict = field(default_factory=dict)  # id -> PlanNode
+    _counter: itertools.count = field(default_factory=itertools.count)
+
+    def add(self, op: Op, inputs: list | None = None) -> int:
+        nid = next(self._counter)
+        self.nodes[nid] = PlanNode(id=nid, op=op, inputs=list(inputs or []))
+        return nid
+
+    def sinks(self) -> list:
+        used = {i for n in self.nodes.values() for i in n.inputs}
+        return [nid for nid in self.nodes if nid not in used]
+
+    def topo_order(self) -> list:
+        seen, out = set(), []
+
+        def visit(nid):
+            if nid in seen:
+                return
+            seen.add(nid)
+            for i in self.nodes[nid].inputs:
+                visit(i)
+            out.append(nid)
+
+        for s in self.sinks():
+            visit(s)
+        return out

@@ -1,0 +1,123 @@
+"""Group-by machinery: exact dense group ids with static shapes.
+
+Reference parity: Carnot's BlockingAggNode builds an absl flat_hash_map
+keyed by RowTuple (``src/carnot/exec/agg_node.h:66``,
+``src/carnot/exec/row_tuple.h``). Hash maps are hostile to XLA, so groups
+are found by **multi-key lexicographic sort + first-occurrence cumsum**:
+exact (no hash collisions), fully static shapes, and the sort is the same
+machinery the t-digest uses.
+
+Two layers:
+
+- ``dense_group_ids``: rows -> dense ids in [0, max_groups), plus the
+  per-group key values and an overflow indicator (distinct groups beyond
+  the static capacity are clamped into the last slot and flagged).
+- ``scatter_group_state`` / regroup: align two group states (different
+  slot orders, e.g. accumulated-state x new-window, or per-device
+  partials) onto a shared dense id space so UDA carries can be merged
+  slot-wise. This is the TPU replacement for Carnot's
+  partial-agg-serialize -> GRPC -> finalize-agg pipeline
+  (``planner/distributed/splitter/partial_op_mgr``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _sortable(plane):
+    """Map a key plane to a sortable array (bools -> int8)."""
+    if plane.dtype == jnp.bool_:
+        return plane.astype(jnp.int8)
+    return plane
+
+
+def dense_group_ids(key_planes, mask, max_groups: int):
+    """Assign dense group ids by multi-key sort.
+
+    Args:
+      key_planes: list of [n] arrays (a UINT128 key contributes two).
+      mask: [n] bool; masked rows get id ``max_groups`` (trash slot).
+      max_groups: static group capacity G.
+
+    Returns:
+      gids: int32[n] in [0, G) for valid rows, G for invalid.
+      group_keys: list of [G] arrays — key values per dense id.
+      group_valid: bool[G] — slots actually occupied.
+      n_groups: int32 scalar — true distinct count (may exceed G; caller
+        checks ``n_groups > max_groups`` to detect overflow).
+    """
+    n = mask.shape[0]
+    planes = [_sortable(p) for p in key_planes]
+
+    # Lexicographic stable sort: secondary keys first, primary last, with
+    # invalid rows forced to the end via a final sort on ~mask.
+    order = jnp.arange(n, dtype=jnp.int32)
+    for p in reversed(planes):
+        order = order[jnp.argsort(p[order], stable=True)]
+    order = order[jnp.argsort(~mask[order], stable=True)]
+
+    sorted_mask = mask[order]
+    is_new = jnp.zeros(n, dtype=jnp.bool_)
+    for p in planes:
+        sp = p[order]
+        diff = jnp.concatenate([jnp.ones(1, jnp.bool_), sp[1:] != sp[:-1]])
+        is_new = is_new | diff
+    is_new = is_new & sorted_mask
+
+    sorted_gid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    n_groups = jnp.sum(is_new.astype(jnp.int32))
+    # Clamp overflowing groups into the last slot; invalid rows -> G.
+    sorted_gid_c = jnp.where(
+        sorted_mask, jnp.clip(sorted_gid, 0, max_groups - 1), max_groups
+    )
+    gids = jnp.zeros(n, dtype=jnp.int32).at[order].set(sorted_gid_c)
+
+    # First occurrence (in original row order) of each group -> key values.
+    first_idx = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32), gids, num_segments=max_groups + 1
+    )[:-1]
+    group_valid = first_idx < n
+    safe_idx = jnp.where(group_valid, first_idx, 0)
+    group_keys = [p[safe_idx] for p in key_planes]
+    return gids, group_keys, group_valid, n_groups
+
+
+def scatter_rows(arr, ids, valid, capacity: int, fill):
+    """Scatter [n]-leading arr rows to slots ``ids`` (unique among valid)."""
+    pad_shape = (capacity + 1,) + arr.shape[1:]
+    out = jnp.full(pad_shape, fill, dtype=arr.dtype)
+    out = out.at[jnp.where(valid, ids, capacity)].set(arr)
+    return out[:capacity]
+
+
+def regroup_pair(keys_a, valid_a, keys_b, valid_b, max_groups: int):
+    """Compute a shared dense-id space for two [G]-slot group states.
+
+    Returns (ids_a, ids_b, merged_keys, merged_valid, n_groups): slot i of
+    side A maps to merged slot ids_a[i], likewise for B; merged_keys/valid
+    describe the union. Carries are then aligned with ``scatter_rows`` /
+    ``scatter_carry`` and combined with the UDA's associative merge
+    (merge(init, x) == x makes empty slots neutral).
+    """
+    cat_keys = [jnp.concatenate([a, b]) for a, b in zip(keys_a, keys_b)]
+    cat_valid = jnp.concatenate([valid_a, valid_b])
+    ids, merged_keys, merged_valid, n_groups = dense_group_ids(
+        cat_keys, cat_valid, max_groups
+    )
+    g = valid_a.shape[0]
+    return ids[:g], ids[g:], merged_keys, merged_valid, n_groups
+
+
+def scatter_carry(carry, ids, valid, capacity: int, init_carry):
+    """Align a [G]-leading carry pytree onto merged slots (empty = init)."""
+    return jax.tree_util.tree_map(
+        lambda arr, init: jnp.concatenate(
+            [init, jnp.zeros((1,) + arr.shape[1:], arr.dtype)]
+        )
+        .at[jnp.where(valid, ids, capacity)]
+        .set(arr)[:capacity],
+        carry,
+        init_carry,
+    )
