@@ -358,7 +358,10 @@ def _key_tuples(hb: HostBatch, on, remaps):
     for c in on:
         ids = hb.cols[c][0]
         if c in remaps:
-            ids = remaps[c][ids]
+            # Null string ids (-1) must stay null, not wrap to the last entry.
+            ids = np.where(
+                ids >= 0, remaps[c][np.clip(ids, 0, None)], NULL_ID
+            ).astype(ids.dtype)
         keys.append(ids)
     extra = [hb.cols[c][1] for c in on if len(hb.cols[c]) > 1]
     return list(zip(*(list(k) for k in (keys + extra)))) if keys else []

@@ -53,6 +53,11 @@ class CompiledFragment:
     finalize: object = None  # jitted (agg only)
     init_state: object = None  # callable -> state pytree (agg only)
     limit: Optional[int] = None  # host-enforced row cap (non-agg chains)
+    # Unjitted building blocks, traceable inside shard_map (the distributed
+    # partial-agg path, ``pixie_tpu.parallel``):
+    window_state: object = None  # (cols, valid) -> per-window group state
+    merge_states: object = None  # (state_a, state_b) -> merged state
+    apply_rows: object = None  # (cols, valid) -> (cols, valid), non-agg chain
 
 
 def _bind_pre_stage(ops, relation, dicts, registry):
@@ -75,10 +80,15 @@ def _bind_pre_stage(ops, relation, dicts, registry):
     def apply(cols, valid):
         for kind, payload in steps:
             if kind == "map":
-                cols = {
-                    name: v if isinstance(v := b.fn(cols), tuple) else (v,)
-                    for name, b in payload
-                }
+                # Broadcast so literal-only expressions yield full planes.
+                new_cols = {}
+                for name, b in payload:
+                    v = b.fn(cols)
+                    planes = v if isinstance(v, tuple) else (v,)
+                    new_cols[name] = tuple(
+                        jnp.broadcast_to(p, valid.shape) for p in planes
+                    )
+                cols = new_cols
             else:
                 valid = valid & jnp.broadcast_to(payload.fn(cols), valid.shape)
         return cols, valid
@@ -129,7 +139,8 @@ def compile_fragment(ops, input_relation, input_dicts, registry: Registry) -> Co
             return apply_pre(cols, valid)
 
         return CompiledFragment(
-            relation=rel1, out_meta=out_meta, is_agg=False, update=update, limit=limit
+            relation=rel1, out_meta=out_meta, is_agg=False, update=update,
+            limit=limit, apply_rows=apply_pre,
         )
 
     return _compile_agg(agg, post, limit, apply_pre, rel1, dicts1, registry)
@@ -174,8 +185,8 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
 
     init_carries = {ae.out_name: uda.init(g) for ae, uda, _, _ in aggs_bound}
 
-    @jax.jit
-    def update(state, cols, valid):
+    def window_state(cols, valid):
+        """Fold one window of rows into a fresh [G]-slot group state."""
         cols, valid = apply_pre(cols, valid)
         key_planes = [cols[c][i] for c, i in key_plane_index]
         gids, keys_w, valid_w, n_w = dense_group_ids(key_planes, valid, g)
@@ -188,27 +199,46 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
             ]
             args = [jnp.broadcast_to(a, valid.shape) for a in args]
             carries_w[ae.out_name] = uda.update(uda.init(g), gids, valid, *args)
+        return {
+            "keys": tuple(keys_w),
+            "valid": valid_w,
+            "carries": carries_w,
+            "overflow": n_w > g,
+        }
 
+    def merge_states(sa, sb):
+        """Associative merge of two group states (slot orders may differ).
+
+        This single function is both the window accumulator and the
+        distributed finalize: per-device partial states gathered over the
+        mesh merge through it, replacing Carnot's UDA Serialize -> GRPC ->
+        finalize-agg pipeline (``planner/distributed/splitter/partial_op_mgr``).
+        """
         ids_a, ids_b, m_keys, m_valid, n_tot = regroup_pair(
-            state["keys"], state["valid"], tuple(keys_w), valid_w, g
+            sa["keys"], sa["valid"], sb["keys"], sb["valid"], g
         )
         carries = {}
         for ae, uda, _, _ in aggs_bound:
             ca = scatter_carry(
-                state["carries"][ae.out_name], ids_a, state["valid"], g,
+                sa["carries"][ae.out_name], ids_a, sa["valid"], g,
                 init_carries[ae.out_name],
             )
             cb = scatter_carry(
-                carries_w[ae.out_name], ids_b, valid_w, g, init_carries[ae.out_name]
+                sb["carries"][ae.out_name], ids_b, sb["valid"], g,
+                init_carries[ae.out_name],
             )
             carries[ae.out_name] = uda.merge(ca, cb)
-        overflow = state["overflow"] | (n_w > g) | (n_tot > g)
+        overflow = sa["overflow"] | sb["overflow"] | (n_tot > g)
         return {
             "keys": tuple(m_keys),
             "valid": m_valid,
             "carries": carries,
             "overflow": overflow,
         }
+
+    @jax.jit
+    def update(state, cols, valid):
+        return merge_states(state, window_state(cols, valid))
 
     # Output relation: group cols then agg outputs (struct sketches keep a
     # [G, k] plane; they are host-materialized and opaque to post ops).
@@ -284,4 +314,7 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
         finalize=finalize,
         init_state=init_state,
         limit=limit,
+        window_state=window_state,
+        merge_states=merge_states,
+        apply_rows=apply_pre,
     )

@@ -1,0 +1,21 @@
+"""Distributed execution over a JAX device mesh.
+
+Reference parity: Pixie's distributed plan fans a query out across
+per-node PEM agents and reduces on Kelvin compute nodes via gRPC
+``ResultSinkService.TransferResultChunk`` streams
+(``src/carnot/planner/distributed/``, ``src/carnot/exec/grpc_router.h:53``).
+The TPU-native equivalent (SURVEY.md §2.7):
+
+- each mesh device is a "virtual PEM" holding a row shard of every table;
+- plan fragments run under ``shard_map`` over the ``agents`` mesh axis;
+- the PEM->Kelvin GRPC bridge becomes an XLA collective chosen by
+  pattern: partial-agg finalize -> ``all_gather`` + associative state
+  merge (or ``psum`` for keyless aggregates), union -> gather of row
+  shards, broadcast join -> replicated build side.
+
+Control-plane messaging (plan dispatch, heartbeats) stays host-side —
+see ``pixie_tpu.service``.
+"""
+
+from .mesh import agent_mesh, row_sharding  # noqa: F401
+from .executor import DistributedEngine, distributed_agg_step  # noqa: F401

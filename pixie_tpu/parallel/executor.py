@@ -1,0 +1,154 @@
+"""Distributed fragment execution: shard_map + collectives.
+
+The reference's distributed query path (SURVEY.md §3.1) ships partial-agg
+carries PEM->Kelvin over gRPC (``src/carnot/exec/grpc_sink_node.cc``,
+``grpc_router.h:53``) and finalizes on the Kelvin fragment. Here the whole
+topology compiles into ONE XLA program per window:
+
+    window rows, sharded over the mesh
+      └─ per-device: Map/Filter + local group state   (the PEM fragment)
+      └─ all_gather(states) over ``agents`` + associative fold merge
+         — the GRPC bridge become an ICI collective
+      └─ (2D mesh) second fold over ``kelvin``        (the Kelvin tier)
+      └─ merge into the running replicated query state
+
+Elasticity: the mesh is rebuilt per query from the live device set
+(``mesh.agent_mesh``), the moral equivalent of replanning around live
+agents (``prune_unavailable_sources_rule``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..exec.engine import Engine, QueryError, _to_host_batch
+from ..types.batch import bucket_capacity
+from .mesh import AGENTS, KELVIN, agent_mesh, pad_to_multiple, row_sharding
+
+
+def _axis_fold_merge(state, axis_name: str, axis_size: int, merge):
+    """all_gather per-device states along an axis and fold-merge them.
+
+    The fold is sequential in the axis size (7 merges on a v5e-8) but each
+    merge is one [2G] sort — negligible next to the per-row window work.
+    """
+    gathered = jax.lax.all_gather(state, axis_name)  # leaves: [axis_size, ...]
+    init = jax.tree_util.tree_map(lambda x: x[0], gathered)
+
+    def body(i, acc):
+        s_i = jax.tree_util.tree_map(lambda x: x[i], gathered)
+        return merge(acc, s_i)
+
+    return jax.lax.fori_loop(1, axis_size, body, init)
+
+
+def distributed_agg_step(frag, mesh: Mesh):
+    """Compile the distributed window step for an aggregating fragment.
+
+    Returns jitted ``step(state, cols, valid) -> state`` where ``state``
+    is replicated and ``cols``/``valid`` are row-sharded over the mesh.
+    """
+    axes = mesh.axis_names
+    sizes = dict(zip(axes, mesh.devices.shape))
+
+    def step(state, cols, valid):
+        local = frag.window_state(cols, valid)
+        merged = _axis_fold_merge(local, AGENTS, sizes[AGENTS], frag.merge_states)
+        if sizes.get(KELVIN, 1) > 1:
+            merged = _axis_fold_merge(merged, KELVIN, sizes[KELVIN], frag.merge_states)
+        return frag.merge_states(state, merged)
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P(axes), P(axes)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=0)
+
+
+def distributed_rows_step(frag, mesh: Mesh):
+    """Compile the distributed step for a non-aggregating (map/filter)
+    fragment: pure elementwise work, no collectives — output stays
+    row-sharded (each virtual PEM keeps its shard, like MemorySink)."""
+    axes = mesh.axis_names
+
+    def step(cols, valid):
+        return frag.apply_rows(cols, valid)
+
+    sharded = jax.shard_map(
+        step, mesh=mesh, in_specs=(P(axes), P(axes)), out_specs=P(axes),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+class DistributedEngine(Engine):
+    """Engine whose fragment materialization runs over a device mesh.
+
+    Joins/unions still reduce on host (they consume post-agg, small
+    inputs); all per-row work and partial-agg merging is on-mesh.
+    """
+
+    def __init__(self, registry=None, window_rows: int = 1 << 17,
+                 mesh: Mesh | None = None, n_agents: int | None = None,
+                 n_kelvin: int = 1):
+        super().__init__(registry=registry, window_rows=window_rows)
+        self.mesh = mesh if mesh is not None else agent_mesh(n_agents, n_kelvin)
+        self.n_devices = int(np.prod(self.mesh.devices.shape))
+
+    def _window_capacity(self, length: int) -> int:
+        cap = max(bucket_capacity(self.window_rows), bucket_capacity(length))
+        return pad_to_multiple(cap, self.n_devices)
+
+    def _stage(self, hb, capacity: int):
+        """Pad a host batch to capacity and place it row-sharded."""
+        db = hb.to_device(capacity, sharding=row_sharding(self.mesh))
+        return db.cols, db.valid
+
+    def _materialize(self, res):
+        from ..exec.engine import _Stream, _apply_limit, _concat_host
+        from ..exec.fragment import compile_fragment
+
+        if not isinstance(res, _Stream):
+            return res
+        stream = res
+        frag = compile_fragment(
+            stream.chain, stream.relation, stream.dicts, self.registry
+        )
+        agg_step = distributed_agg_step(frag, self.mesh) if frag.is_agg else None
+        rows_step = None if frag.is_agg else distributed_rows_step(frag, self.mesh)
+
+        if frag.is_agg:
+            state = jax.device_put(
+                frag.init_state(), jax.sharding.NamedSharding(self.mesh, P())
+            )
+            for hb in self._windows(stream):
+                cols, valid = self._stage(hb, self._window_capacity(hb.length))
+                state = agg_step(state, cols, valid)
+            cols, valid, overflow = frag.finalize(state)
+            if bool(overflow):
+                raise QueryError(
+                    "group-by overflow: more distinct groups than max_groups; "
+                    "raise AggOp.max_groups"
+                )
+            out = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
+            return _apply_limit(out, frag.limit)
+
+        pieces, total = [], 0
+        for hb in self._windows(stream):
+            cols, valid = self._stage(hb, self._window_capacity(hb.length))
+            out_cols, out_valid = rows_step(cols, valid)
+            piece = _to_host_batch(frag.out_meta, out_cols, np.asarray(out_valid))
+            pieces.append(piece)
+            total += piece.length
+            if frag.limit is not None and total >= frag.limit:
+                break
+        out = _concat_host(pieces, frag.relation)
+        return _apply_limit(out, frag.limit)

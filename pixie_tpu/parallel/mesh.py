@@ -1,0 +1,50 @@
+"""Device mesh construction — per-query, elastic.
+
+Reference parity: Pixie replans every query against the currently-live
+agent set (``query_executor.go:415``, ``prune_unavailable_sources_rule``);
+here the analog is constructing the mesh from ``jax.devices()`` at query
+time and re-sharding when the device set changes.
+
+Mesh axes:
+- ``agents``: the data-parallel axis — each device is a virtual PEM
+  holding a row shard of every table. All bulk-data collectives
+  (partial-agg merge, union gather, repartition) ride this axis over ICI.
+- ``kelvin`` (optional, size>1 for 2D meshes): a second axis for
+  hierarchical reduction on multi-slice topologies — partial-agg merges
+  first within an ``agents`` group (ICI), then across ``kelvin`` (DCN),
+  mirroring PEM->Kelvin->query-broker two-level reduction.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AGENTS = "agents"
+KELVIN = "kelvin"
+
+
+def agent_mesh(n_agents: int | None = None, n_kelvin: int = 1, devices=None) -> Mesh:
+    """Build an (agents[, kelvin]) mesh from the live device set."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_agents is None:
+        n_agents = len(devices) // n_kelvin
+    need = n_agents * n_kelvin
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {n_agents}x{n_kelvin} needs {need} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[:need]).reshape(n_kelvin, n_agents)
+    return Mesh(arr, (KELVIN, AGENTS))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded over every mesh axis (agents x kelvin jointly)."""
+    return NamedSharding(mesh, P(mesh.axis_names))
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    return int(math.ceil(n / m)) * m if n else m

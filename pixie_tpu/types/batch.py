@@ -116,10 +116,17 @@ class HostBatch:
                 out[name] = planes[0]
         return out
 
-    def to_device(self, capacity: int | None = None) -> "DeviceBatch":
+    def to_device(self, capacity: int | None = None, sharding=None) -> "DeviceBatch":
+        """Pad to a fixed capacity and place on device.
+
+        ``sharding`` (a jax.sharding.Sharding) places planes row-sharded
+        over a mesh — the distributed staging path; None keeps the default
+        single-device placement.
+        """
         cap = capacity if capacity is not None else bucket_capacity(self.length)
         if cap < self.length:
             raise ValueError(f"capacity {cap} < batch length {self.length}")
+        put = (lambda a: jax.device_put(a, sharding)) if sharding is not None else jnp.asarray
         cols: dict[str, Planes] = {}
         for name, dt in self.relation.items():
             pads = pad_values(dt)
@@ -128,11 +135,11 @@ class HostBatch:
             for plane, pad, ddt in zip(self.cols[name], pads, ddts):
                 padded = np.full(cap, pad, dtype=np.dtype(ddt))
                 padded[: self.length] = plane
-                planes.append(jnp.asarray(padded))
+                planes.append(put(padded))
             cols[name] = tuple(planes)
         valid = np.zeros(cap, dtype=np.bool_)
         valid[: self.length] = True
-        return DeviceBatch(relation=self.relation, cols=cols, valid=jnp.asarray(valid))
+        return DeviceBatch(relation=self.relation, cols=cols, valid=put(valid))
 
 
 @jax.tree_util.register_pytree_node_class

@@ -1,0 +1,203 @@
+"""Distributed execution tests on the virtual 8-device CPU mesh.
+
+The reference fakes its distributed system with in-process gRPC servers
+and synthetic DistributedState (SURVEY.md §4); here 8 XLA host devices
+stand in for a v5e-8 and the same plans must produce identical results
+single-chip vs distributed.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pixie_tpu.exec.engine import Engine
+from pixie_tpu.exec.plan import (
+    AggExpr,
+    AggOp,
+    ColumnRef,
+    FilterOp,
+    FuncCall,
+    JoinOp,
+    Literal,
+    LimitOp,
+    MapOp,
+    MemorySourceOp,
+    Plan,
+    ResultSinkOp,
+)
+from pixie_tpu.parallel import DistributedEngine, agent_mesh
+from pixie_tpu.types.dtypes import DataType
+
+
+def _http_events(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "time_": np.arange(n, dtype=np.int64).astype("datetime64[ns]"),
+        "latency_ns": rng.integers(1000, 10_000_000, n),
+        "resp_status": rng.choice([200, 200, 200, 404, 500], n),
+        "service": rng.choice(["cart", "checkout", "frontend", "db"], n),
+        "req_path": rng.choice(["/a", "/b", "/c"], n),
+    }
+
+
+def _http_stats_plan(table="http_events"):
+    """filter(status>=200) -> groupby(service).agg(count, mean latency)."""
+    p = Plan()
+    src = p.add(MemorySourceOp(table=table))
+    flt = p.add(
+        FilterOp(
+            predicate=FuncCall(
+                "greaterThanEqual",
+                (ColumnRef("resp_status"), Literal(200, DataType.INT64)),
+            )
+        ),
+        [src],
+    )
+    agg = p.add(
+        AggOp(
+            group_cols=("service",),
+            aggs=(
+                AggExpr("n", "count", (ColumnRef("latency_ns"),)),
+                AggExpr("lat_mean", "mean", (ColumnRef("latency_ns"),)),
+                AggExpr("lat_max", "max", (ColumnRef("latency_ns"),)),
+            ),
+        ),
+        [flt],
+    )
+    p.add(ResultSinkOp("out"), [agg])
+    return p
+
+
+def _sorted_rows(hb, key="service"):
+    d = hb.to_pydict()
+    order = np.argsort(d[key])
+    return {k: v[order] for k, v in d.items()}
+
+
+@pytest.fixture(scope="module")
+def engines():
+    single = Engine(window_rows=4096)
+    dist = DistributedEngine(window_rows=4096, mesh=agent_mesh(8))
+    data = _http_events(10_000)
+    for e in (single, dist):
+        e.append_data("http_events", data)
+    return single, dist
+
+
+def test_distributed_agg_matches_single_chip(engines):
+    single, dist = engines
+    plan = _http_stats_plan()
+    r1 = _sorted_rows(single.execute_plan(plan)["out"])
+    r2 = _sorted_rows(dist.execute_plan(plan)["out"])
+    assert list(r1) == list(r2)
+    _assert_rows_close(r1, r2)
+
+
+def _assert_rows_close(r1, r2, rtol=1e-9):
+    for k in r1:
+        if r1[k].dtype.kind in "OUS":
+            assert r1[k].tolist() == r2[k].tolist(), k
+        else:
+            np.testing.assert_allclose(r1[k], r2[k], rtol=rtol, err_msg=k)
+
+
+def test_distributed_agg_2d_mesh(engines):
+    single, _ = engines
+    dist2d = DistributedEngine(window_rows=4096, mesh=agent_mesh(4, n_kelvin=2))
+    dist2d.append_data("http_events", _http_events(10_000))
+    plan = _http_stats_plan()
+    r1 = _sorted_rows(single.execute_plan(plan)["out"])
+    r2 = _sorted_rows(dist2d.execute_plan(plan)["out"])
+    _assert_rows_close(r1, r2)
+
+
+def test_distributed_rows_fragment(engines):
+    single, dist = engines
+    p = Plan()
+    src = p.add(MemorySourceOp(table="http_events"))
+    flt = p.add(
+        FilterOp(
+            predicate=FuncCall(
+                "equal", (ColumnRef("resp_status"), Literal(500, DataType.INT64))
+            )
+        ),
+        [src],
+    )
+    m = p.add(
+        MapOp(
+            exprs=(
+                ("service", ColumnRef("service")),
+                ("lat_ms", FuncCall(
+                    "divide",
+                    (ColumnRef("latency_ns"), Literal(1e6, DataType.FLOAT64)),
+                )),
+            )
+        ),
+        [flt],
+    )
+    p.add(ResultSinkOp("out"), [m])
+    r1 = single.execute_plan(p)["out"].to_pydict()
+    r2 = dist.execute_plan(p)["out"].to_pydict()
+    assert r1["service"].tolist() == r2["service"].tolist()
+    np.testing.assert_allclose(r1["lat_ms"], r2["lat_ms"])
+
+
+def test_distributed_quantiles_sketch(engines):
+    """t-digest partial states must merge across devices (approximately)."""
+    single, dist = engines
+    p1, p2 = Plan(), Plan()
+    for p in (p1, p2):
+        src = p.add(MemorySourceOp(table="http_events"))
+        agg = p.add(
+            AggOp(
+                group_cols=("service",),
+                aggs=(AggExpr("lat_p50", "_quantile_p50", (ColumnRef("latency_ns"),)),),
+            ),
+            [src],
+        )
+        p.add(ResultSinkOp("out"), [agg])
+    r1 = _sorted_rows(single.execute_plan(p1)["out"])
+    r2 = _sorted_rows(dist.execute_plan(p2)["out"])
+    assert r1["service"].tolist() == r2["service"].tolist()
+    # Sketches are approximate; distributed merge order differs.
+    np.testing.assert_allclose(r1["lat_p50"], r2["lat_p50"], rtol=0.1)
+
+
+def test_distributed_join_and_limit(engines):
+    single, dist = engines
+    results = []
+    for e in (single, dist):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        agg1 = p.add(
+            AggOp(
+                group_cols=("service", "req_path"),
+                aggs=(AggExpr("n", "count", (ColumnRef("latency_ns"),)),),
+            ),
+            [src],
+        )
+        src2 = p.add(MemorySourceOp(table="http_events"))
+        agg2 = p.add(
+            AggOp(
+                group_cols=("service",),
+                aggs=(AggExpr("total", "count", (ColumnRef("latency_ns"),)),),
+            ),
+            [src2],
+        )
+        j = p.add(
+            JoinOp(left_on=("service",), right_on=("service",)), [agg1, agg2]
+        )
+        lim = p.add(LimitOp(5), [j])
+        p.add(ResultSinkOp("out"), [lim])
+        results.append(e.execute_plan(p))
+    r1, r2 = results[0]["out"], results[1]["out"]
+    assert r1.length == r2.length == 5
+    d1, d2 = r1.to_pydict(), r2.to_pydict()
+    assert set(d1) == set(d2)
+
+
+def test_mesh_uses_all_devices():
+    mesh = agent_mesh(8)
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("kelvin", "agents")
