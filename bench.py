@@ -1,0 +1,190 @@
+"""Headline benchmark: px/http_stats-class query throughput (rows/sec).
+
+Runs BASELINE.json configs[0] — filter + group-by aggregate over an
+http_events replay — through the single-chip engine, streaming fixed-size
+windows device-side, and compares against a vectorized numpy CPU baseline
+(stand-in for CPU Carnot, whose repo publishes no absolute numbers —
+SURVEY.md §6).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": rows/sec, "unit": "rows/s", "vs_baseline": x}
+
+Environment knobs:
+  PIXIE_TPU_BENCH_ROWS    total replay rows (default 16M)
+  PIXIE_TPU_BENCH_WINDOW  window rows per device dispatch (default 2^21)
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def gen_http_events(n, window):
+    """Pre-encoded http_events replay, chunked into HostBatch windows."""
+    from pixie_tpu.types.batch import HostBatch
+    from pixie_tpu.types.relation import Relation
+    from pixie_tpu.types.dtypes import DataType
+    from pixie_tpu.types.strings import StringDictionary
+
+    rng = np.random.default_rng(7)
+    services = [f"svc-{i}" for i in range(32)]
+    paths = [f"/api/v1/ep{i}" for i in range(8)]
+    svc_dict, path_dict = StringDictionary(services), StringDictionary(paths)
+    rel = Relation(
+        [
+            ("time_", DataType.TIME64NS),
+            ("latency_ns", DataType.INT64),
+            ("resp_status", DataType.INT64),
+            ("service", DataType.STRING),
+            ("req_path", DataType.STRING),
+        ]
+    )
+    batches = []
+    for off in range(0, n, window):
+        m = min(window, n - off)
+        cols = {
+            "time_": (np.arange(off, off + m, dtype=np.int64),),
+            "latency_ns": (rng.integers(1_000, 100_000_000, m),),
+            "resp_status": (
+                rng.choice(np.array([200, 200, 200, 200, 404, 500]), m),
+            ),
+            "service": (rng.integers(0, len(services), m).astype(np.int32),),
+            "req_path": (rng.integers(0, len(paths), m).astype(np.int32),),
+        }
+        batches.append(
+            HostBatch(
+                relation=rel,
+                cols=cols,
+                length=m,
+                dicts={"service": svc_dict, "req_path": path_dict},
+            )
+        )
+    return rel, batches
+
+
+def build_plan():
+    from pixie_tpu.exec.plan import (
+        AggExpr, AggOp, ColumnRef, FilterOp, FuncCall, Literal,
+        MemorySourceOp, Plan, ResultSinkOp,
+    )
+    from pixie_tpu.types.dtypes import DataType
+
+    p = Plan()
+    src = p.add(MemorySourceOp(table="http_events"))
+    flt = p.add(
+        FilterOp(
+            predicate=FuncCall(
+                "lessThan", (ColumnRef("resp_status"), Literal(400, DataType.INT64))
+            )
+        ),
+        [src],
+    )
+    agg = p.add(
+        AggOp(
+            group_cols=("service", "req_path"),
+            aggs=(
+                AggExpr("n", "count", (ColumnRef("latency_ns"),)),
+                AggExpr("lat_mean", "mean", (ColumnRef("latency_ns"),)),
+                AggExpr("lat_max", "max", (ColumnRef("latency_ns"),)),
+            ),
+            max_groups=512,
+        ),
+        [flt],
+    )
+    p.add(ResultSinkOp("out"), [agg])
+    return p
+
+
+def numpy_baseline(batches):
+    """Vectorized single-core CPU implementation of the same query."""
+    t0 = time.perf_counter()
+    key_acc, lat_acc = [], []
+    for hb in batches:
+        ok = hb.cols["resp_status"][0] < 400
+        key = (
+            hb.cols["service"][0][ok].astype(np.int64) * 1024
+            + hb.cols["req_path"][0][ok]
+        )
+        key_acc.append(key)
+        lat_acc.append(hb.cols["latency_ns"][0][ok])
+    key = np.concatenate(key_acc)
+    lat = np.concatenate(lat_acc)
+    uniq, inv = np.unique(key, return_inverse=True)
+    n = np.bincount(inv)
+    s = np.bincount(inv, weights=lat.astype(np.float64))
+    mx = np.full(len(uniq), -np.inf)
+    np.maximum.at(mx, inv, lat)
+    dt = time.perf_counter() - t0
+    return {"n": n, "mean": s / n, "max": mx, "uniq": uniq}, dt
+
+
+def main():
+    n_rows = int(os.environ.get("PIXIE_TPU_BENCH_ROWS", 16 * 1024 * 1024))
+    window = int(os.environ.get("PIXIE_TPU_BENCH_WINDOW", 1 << 21))
+
+    import jax
+
+    log(f"devices: {jax.devices()}")
+    from pixie_tpu.exec.engine import Engine
+
+    log(f"generating {n_rows:,} rows ...")
+    rel, batches = gen_http_events(n_rows, window)
+
+    eng = Engine(window_rows=window)
+    t = eng.create_table("http_events", rel)
+    for hb in batches:
+        t.dicts.update(hb.dicts)
+        t.batches.append(hb)
+
+    plan = build_plan()
+    # Warmup: one pass over a single window to compile.
+    warm = Engine(window_rows=window)
+    tw = warm.create_table("http_events", rel)
+    tw.dicts.update(batches[0].dicts)
+    tw.batches.append(batches[0])
+    t0 = time.perf_counter()
+    warm.execute_plan(plan)
+    log(f"warmup (compile + first window): {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    out = eng.execute_plan(plan)["out"]
+    elapsed = time.perf_counter() - t0
+    rows_per_sec = n_rows / elapsed
+    log(f"engine: {elapsed:.3f}s  {rows_per_sec:,.0f} rows/s  ({out.length} groups)")
+
+    ref, ref_dt = numpy_baseline(batches)
+    ref_rows_per_sec = n_rows / ref_dt
+    log(f"numpy baseline: {ref_dt:.3f}s  {ref_rows_per_sec:,.0f} rows/s")
+
+    # Correctness cross-check vs the baseline.
+    got = out.to_pydict(decode_strings=False)
+    order = np.argsort(got["service"].astype(np.int64) * 1024 + got["req_path"])
+    assert np.array_equal(np.sort(ref["uniq"]),
+                          (got["service"].astype(np.int64) * 1024 + got["req_path"])[order])
+    ref_order = np.argsort(ref["uniq"])
+    assert np.array_equal(got["n"][order], ref["n"][ref_order].astype(got["n"].dtype))
+    np.testing.assert_allclose(got["lat_mean"][order], ref["mean"][ref_order], rtol=1e-6)
+    np.testing.assert_allclose(got["lat_max"][order], ref["max"][ref_order])
+    log("correctness vs baseline: OK")
+
+    print(
+        json.dumps(
+            {
+                "metric": "http_stats_rows_per_sec",
+                "value": round(rows_per_sec),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_sec / ref_rows_per_sec, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -230,6 +230,21 @@ class Engine:
                         dicts=b.dicts,
                     )
 
+    # -- execution seams (overridden by DistributedEngine) -------------------
+    def _window_capacity(self, length: int) -> int:
+        return max(bucket_capacity(self.window_rows), bucket_capacity(length))
+
+    def _stage(self, hb: HostBatch, capacity: int):
+        """Pad a host window to capacity and place it on device."""
+        db = hb.to_device(capacity)
+        return db.cols, db.valid
+
+    def _compile_steps(self, frag):
+        """(init_state_fn, agg_step, rows_step) for a compiled fragment."""
+        if frag.is_agg:
+            return frag.init_state, frag.update, None
+        return None, None, frag.update
+
     def _materialize(self, res) -> HostBatch:
         if isinstance(res, HostBatch):
             return res
@@ -237,13 +252,13 @@ class Engine:
         frag = compile_fragment(
             stream.chain, stream.relation, stream.dicts, self.registry
         )
-        capacity = bucket_capacity(self.window_rows)
+        init_state, agg_step, rows_step = self._compile_steps(frag)
 
         if frag.is_agg:
-            state = frag.init_state()
+            state = init_state()
             for hb in self._windows(stream):
-                db = hb.to_device(max(capacity, bucket_capacity(hb.length)))
-                state = frag.update(state, db.cols, db.valid)
+                cols, valid = self._stage(hb, self._window_capacity(hb.length))
+                state = agg_step(state, cols, valid)
             cols, valid, overflow = frag.finalize(state)
             if bool(overflow):
                 raise QueryError(
@@ -256,9 +271,9 @@ class Engine:
         # Non-agg: stream windows, stop early once a limit is satisfied.
         pieces, total = [], 0
         for hb in self._windows(stream):
-            db = hb.to_device(max(capacity, bucket_capacity(hb.length)))
-            cols, valid = frag.update(db.cols, db.valid)
-            piece = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
+            cols, valid = self._stage(hb, self._window_capacity(hb.length))
+            out_cols, out_valid = rows_step(cols, valid)
+            piece = _to_host_batch(frag.out_meta, out_cols, np.asarray(out_valid))
             pieces.append(piece)
             total += piece.length
             if frag.limit is not None and total >= frag.limit:

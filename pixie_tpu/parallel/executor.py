@@ -12,21 +12,19 @@ topology compiles into ONE XLA program per window:
       └─ (2D mesh) second fold over ``kelvin``        (the Kelvin tier)
       └─ merge into the running replicated query state
 
-Elasticity: the mesh is rebuilt per query from the live device set
-(``mesh.agent_mesh``), the moral equivalent of replanning around live
-agents (``prune_unavailable_sources_rule``).
+Elasticity: an engine is bound to one mesh at construction; after a
+device-set change, construct a fresh engine over a fresh ``agent_mesh``
+— the moral equivalent of replanning around live agents
+(``prune_unavailable_sources_rule``).
 """
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..exec.engine import Engine, QueryError, _to_host_batch
+from ..exec.engine import Engine
 from ..types.batch import bucket_capacity
 from .mesh import AGENTS, KELVIN, agent_mesh, pad_to_multiple, row_sharding
 
@@ -104,7 +102,7 @@ class DistributedEngine(Engine):
         self.n_devices = int(np.prod(self.mesh.devices.shape))
 
     def _window_capacity(self, length: int) -> int:
-        cap = max(bucket_capacity(self.window_rows), bucket_capacity(length))
+        cap = super()._window_capacity(length)
         return pad_to_multiple(cap, self.n_devices)
 
     def _stage(self, hb, capacity: int):
@@ -112,43 +110,12 @@ class DistributedEngine(Engine):
         db = hb.to_device(capacity, sharding=row_sharding(self.mesh))
         return db.cols, db.valid
 
-    def _materialize(self, res):
-        from ..exec.engine import _Stream, _apply_limit, _concat_host
-        from ..exec.fragment import compile_fragment
-
-        if not isinstance(res, _Stream):
-            return res
-        stream = res
-        frag = compile_fragment(
-            stream.chain, stream.relation, stream.dicts, self.registry
-        )
-        agg_step = distributed_agg_step(frag, self.mesh) if frag.is_agg else None
-        rows_step = None if frag.is_agg else distributed_rows_step(frag, self.mesh)
-
+    def _compile_steps(self, frag):
         if frag.is_agg:
-            state = jax.device_put(
-                frag.init_state(), jax.sharding.NamedSharding(self.mesh, P())
-            )
-            for hb in self._windows(stream):
-                cols, valid = self._stage(hb, self._window_capacity(hb.length))
-                state = agg_step(state, cols, valid)
-            cols, valid, overflow = frag.finalize(state)
-            if bool(overflow):
-                raise QueryError(
-                    "group-by overflow: more distinct groups than max_groups; "
-                    "raise AggOp.max_groups"
+            def init_state():
+                return jax.device_put(
+                    frag.init_state(), jax.sharding.NamedSharding(self.mesh, P())
                 )
-            out = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
-            return _apply_limit(out, frag.limit)
 
-        pieces, total = [], 0
-        for hb in self._windows(stream):
-            cols, valid = self._stage(hb, self._window_capacity(hb.length))
-            out_cols, out_valid = rows_step(cols, valid)
-            piece = _to_host_batch(frag.out_meta, out_cols, np.asarray(out_valid))
-            pieces.append(piece)
-            total += piece.length
-            if frag.limit is not None and total >= frag.limit:
-                break
-        out = _concat_host(pieces, frag.relation)
-        return _apply_limit(out, frag.limit)
+            return init_state, distributed_agg_step(frag, self.mesh), None
+        return None, None, distributed_rows_step(frag, self.mesh)

@@ -2,8 +2,9 @@
 
 Reference parity: Pixie replans every query against the currently-live
 agent set (``query_executor.go:415``, ``prune_unavailable_sources_rule``);
-here the analog is constructing the mesh from ``jax.devices()`` at query
-time and re-sharding when the device set changes.
+here the analog is cheap mesh (re)construction from ``jax.devices()`` —
+an engine is bound to one mesh, and degrading after a device-set change
+means constructing a fresh engine over a fresh mesh.
 
 Mesh axes:
 - ``agents``: the data-parallel axis — each device is a virtual PEM
@@ -33,9 +34,10 @@ def agent_mesh(n_agents: int | None = None, n_kelvin: int = 1, devices=None) -> 
     if n_agents is None:
         n_agents = len(devices) // n_kelvin
     need = n_agents * n_kelvin
-    if need > len(devices):
+    if n_agents < 1 or need > len(devices):
         raise ValueError(
-            f"mesh {n_agents}x{n_kelvin} needs {need} devices, have {len(devices)}"
+            f"mesh {n_agents}x{n_kelvin} needs {max(need, n_kelvin)} devices, "
+            f"have {len(devices)}"
         )
     arr = np.array(devices[:need]).reshape(n_kelvin, n_agents)
     return Mesh(arr, (KELVIN, AGENTS))
