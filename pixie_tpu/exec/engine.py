@@ -141,6 +141,32 @@ class Engine:
         return self.tables[name].append(data, time_cols=time_cols)
 
     # -- execution -----------------------------------------------------------
+    def execute_query(self, query: str, now_ns: int = 0,
+                      max_output_rows: int = 10_000) -> dict:
+        """Compile a PxL script and execute it (Carnot::ExecuteQuery parity,
+        ``src/carnot/carnot.cc:122-134``). Returns {output name: HostBatch}."""
+        from ..planner import CompilerState, compile_pxl
+
+        state = CompilerState(
+            schemas={n: t.relation for n, t in self.tables.items()},
+            registry=self.registry,
+            now_ns=now_ns,
+            max_output_rows=max_output_rows,
+        )
+        compiled = compile_pxl(query, state)
+        return self.execute_plan(compiled.plan)
+
+    def set_metadata_state(self, state) -> None:
+        """Attach k8s metadata; rebinds the metadata UDFs to a snapshot of
+        ``state`` (reference: per-query AgentMetadataState), preserving all
+        other registrations on this engine's registry."""
+        from ..metadata.funcs import METADATA_FUNC_NAMES, register_metadata_funcs
+
+        self.metadata_state = state
+        reg = self.registry.clone("engine", exclude=METADATA_FUNC_NAMES)
+        register_metadata_funcs(reg, state)
+        self.registry = reg
+
     def execute_plan(self, plan: Plan) -> dict:
         results: dict[int, object] = {}
         outputs: dict[str, HostBatch] = {}
@@ -176,6 +202,14 @@ class Engine:
                 if st.chain and isinstance(st.chain[-1], LimitOp):
                     # A limit terminates its fragment: apply the cap at its
                     # plan position, then keep chaining on the result.
+                    st = self._as_stream(self._materialize(st))
+                if isinstance(op, AggOp) and any(
+                    isinstance(o, AggOp) for o in st.chain
+                ):
+                    # Two blocking aggs never share a fragment: the first
+                    # materializes (its output is small), the second re-
+                    # aggregates it (the splitter's cut-at-blocking-op rule,
+                    # planner/distributed/splitter/splitter.h:75).
                     st = self._as_stream(self._materialize(st))
                 results[nid] = st.extend(op)
             elif isinstance(op, JoinOp):

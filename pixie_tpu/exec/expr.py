@@ -140,7 +140,9 @@ def _bind_func(expr: FuncCall, relation, dicts, registry: Registry) -> BoundExpr
         vals = [apply_cast(f(cols), have, want) for f, (have, want) in zip(arg_fns, casts)]
         return fn_udf(*vals)
 
-    out_dict = sibling_dict if udf.return_type == DataType.STRING else None
+    out_dict = None
+    if udf.return_type == DataType.STRING:
+        out_dict = udf.out_dict if udf.out_dict is not None else sibling_dict
     return BoundExpr(fn=fn, dtype=udf.return_type, dict=out_dict)
 
 

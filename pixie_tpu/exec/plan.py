@@ -152,6 +152,9 @@ class PlanNode:
     id: int
     op: Op
     inputs: list = field(default_factory=list)  # list[int]
+    # Output schema, populated by the planner for rule passes (the engine
+    # resolves schemas itself; manual plans may leave this None).
+    relation: object = None
 
 
 @dataclass
@@ -161,9 +164,11 @@ class Plan:
     nodes: dict = field(default_factory=dict)  # id -> PlanNode
     _counter: itertools.count = field(default_factory=itertools.count)
 
-    def add(self, op: Op, inputs: list | None = None) -> int:
+    def add(self, op: Op, inputs: list | None = None, relation=None) -> int:
         nid = next(self._counter)
-        self.nodes[nid] = PlanNode(id=nid, op=op, inputs=list(inputs or []))
+        self.nodes[nid] = PlanNode(
+            id=nid, op=op, inputs=list(inputs or []), relation=relation
+        )
         return nid
 
     def sinks(self) -> list:

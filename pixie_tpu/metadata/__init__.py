@@ -1,0 +1,11 @@
+"""K8s metadata subsystem: entity state + UPID resolution UDFs.
+
+Reference parity: ``src/shared/metadata/`` (K8sMetadataState
+``metadata_state.h:47``, AgentMetadataState ``:251`` mapping UPID ->
+PIDInfo -> pod/service) and the metadata UDFs in
+``src/carnot/funcs/metadata/``.
+"""
+
+from .state import ContainerInfo, MetadataState, PodInfo, ServiceInfo, UPID
+
+__all__ = ["ContainerInfo", "MetadataState", "PodInfo", "ServiceInfo", "UPID"]

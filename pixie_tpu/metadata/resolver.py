@@ -1,0 +1,54 @@
+"""``df.ctx['service']`` resolution: metadata property -> UDF call.
+
+Reference parity: ``src/carnot/planner/metadata/metadata_handler.h:72`` +
+the convert_metadata_rule analyzer pass — a ctx property becomes an
+``upid_to_*`` function call on the table's UPID column.
+"""
+
+from __future__ import annotations
+
+from ..exec.plan import ColumnRef, FuncCall
+from ..planner.objects import ColumnExpr, PxLError
+
+# ctx key -> upid_to_* UDF
+_CTX_FUNCS = {
+    "pod_id": "upid_to_pod_id",
+    "pod": "upid_to_pod_name",
+    "pod_name": "upid_to_pod_name",
+    "namespace": "upid_to_namespace",
+    "node": "upid_to_node_name",
+    "node_name": "upid_to_node_name",
+    "service_id": "upid_to_service_id",
+    "service": "upid_to_service_name",
+    "service_name": "upid_to_service_name",
+    "container_id": "upid_to_container_id",
+    "container": "upid_to_container_name",
+    "container_name": "upid_to_container_name",
+    "cmdline": "upid_to_cmdline",
+    "cmd": "upid_to_cmdline",
+}
+
+_UPID_COLUMNS = ("upid", "upid_")
+
+
+def resolve_ctx(df, key: str) -> ColumnExpr:
+    if key not in _CTX_FUNCS:
+        raise PxLError(
+            f"unknown metadata property ctx[{key!r}]; available: "
+            f"{sorted(set(_CTX_FUNCS))}"
+        )
+    upid_col = next(
+        (c for c in _UPID_COLUMNS if df.relation.has_column(c)), None
+    )
+    if upid_col is None:
+        raise PxLError(
+            f"ctx[{key!r}] requires a 'upid' column in the table "
+            f"(have: {list(df.relation.column_names)})"
+        )
+    fname = _CTX_FUNCS[key]
+    if not df.builder.registry.has_scalar(fname):
+        raise PxLError(
+            f"ctx[{key!r}]: metadata functions are not registered on this "
+            "engine (no metadata state attached)"
+        )
+    return ColumnExpr(FuncCall(fname, (ColumnRef(upid_col),)), df)

@@ -1,0 +1,143 @@
+"""Static open-addressing hash table: host build, device lookup.
+
+The TPU replacement for pointer-chasing hash maps (reference:
+``src/carnot/exec/row_tuple.h`` AbslRowTupleHashMap): the host builds a
+power-of-two table with a *bounded* probe length (rebuilding larger until
+every key fits within ``max_probes`` slots), so the device lookup is a
+fixed number of gathers + compares — fully static shapes, no loops.
+
+Keys are tuples of uint64 planes (a UINT128 UPID is (hi, lo)); values are
+int32 payload indices. Used for metadata UPID->entity resolution and
+reusable as a hash-join build side.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer (wrapping uint64 arithmetic)."""
+    x = x.astype(np.uint64)
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    return x
+
+
+def _hash_planes(planes) -> np.ndarray:
+    h = np.zeros(len(planes[0]), dtype=np.uint64)
+    for p in planes:
+        h = _mix64(h ^ (p.astype(np.uint64) + _GOLDEN))
+    return h
+
+
+@dataclass
+class HashTable:
+    """Host-built table; ``key_planes``/``values``/``occupied`` are dense
+    [size] arrays ready for device placement."""
+
+    key_planes: tuple  # tuple[np.ndarray[uint64]], one per key plane
+    values: np.ndarray  # int32[size]
+    occupied: np.ndarray  # bool[size]
+    max_probes: int
+
+    @property
+    def size(self) -> int:
+        return len(self.values)
+
+
+def build_table(key_planes, values, max_probes: int = 8) -> HashTable:
+    """Insert (key -> value) pairs; grow until probe length <= max_probes.
+
+    ``key_planes``: sequence of uint64 arrays (same length n).
+    ``values``: int array [n]. Duplicate keys keep the LAST value
+    (metadata updates overwrite earlier state).
+    """
+    planes = [np.asarray(p, dtype=np.uint64) for p in key_planes]
+    values = np.asarray(values, dtype=np.int32)
+    n = len(values)
+    size = 16
+    while size < 2 * max(n, 1):
+        size *= 2
+
+    while True:
+        mask = np.uint64(size - 1)
+        tbl_planes = [np.zeros(size, dtype=np.uint64) for _ in planes]
+        tbl_vals = np.zeros(size, dtype=np.int32)
+        occ = np.zeros(size, dtype=bool)
+        h = (_hash_planes(planes) & mask).astype(np.int64) if n else np.zeros(0, np.int64)
+        ok = True
+        for i in range(n):
+            slot = h[i]
+            placed = False
+            for _p in range(max_probes):
+                s = (slot + _p) & (size - 1)
+                if not occ[s]:
+                    occ[s] = True
+                    for tp, kp in zip(tbl_planes, planes):
+                        tp[s] = kp[i]
+                    tbl_vals[s] = values[i]
+                    placed = True
+                    break
+                if all(tp[s] == kp[i] for tp, kp in zip(tbl_planes, planes)):
+                    tbl_vals[s] = values[i]  # overwrite duplicate key
+                    placed = True
+                    break
+            if not placed:
+                ok = False
+                break
+        if ok:
+            return HashTable(tuple(tbl_planes), tbl_vals, occ, max_probes)
+        size *= 2
+
+
+def _mix64_j(x):
+    x = x.astype(jnp.uint64)
+    x ^= x >> jnp.uint64(30)
+    x *= jnp.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> jnp.uint64(27)
+    x *= jnp.uint64(0x94D049BB133111EB)
+    x ^= x >> jnp.uint64(31)
+    return x
+
+
+def device_lookup(table: HashTable, query_planes, device_arrays=None):
+    """Vectorized exact lookup: [n] keys -> (values int32[n], found bool[n]).
+
+    ``device_arrays`` optionally carries pre-placed jnp copies of the
+    table arrays (so a closure can stage them once); defaults to placing
+    ``table``'s numpy arrays inline.
+    """
+    if device_arrays is None:
+        device_arrays = (
+            tuple(jnp.asarray(p) for p in table.key_planes),
+            jnp.asarray(table.values),
+            jnp.asarray(table.occupied),
+        )
+    tbl_planes, tbl_vals, occ = device_arrays
+    size = table.size
+    mask = jnp.uint64(size - 1)
+
+    h = jnp.zeros(query_planes[0].shape, dtype=jnp.uint64)
+    for p in query_planes:
+        h = _mix64_j(h ^ (p.astype(jnp.uint64) + jnp.uint64(0x9E3779B97F4A7C15)))
+    base = (h & mask).astype(jnp.int32)
+
+    # [n, P] candidate slots; bounded probes -> static shapes.
+    probes = jnp.arange(table.max_probes, dtype=jnp.int32)
+    slots = (base[:, None] + probes[None, :]) & jnp.int32(size - 1)
+    match = occ[slots]
+    for tp, qp in zip(tbl_planes, query_planes):
+        match = match & (tp[slots] == qp.astype(jnp.uint64)[:, None])
+    found = jnp.any(match, axis=1)
+    first = jnp.argmax(match, axis=1)
+    vals = tbl_vals[jnp.take_along_axis(slots, first[:, None], axis=1)[:, 0]]
+    return jnp.where(found, vals, -1), found

@@ -1,0 +1,75 @@
+"""Compiler driver: PxL source -> analyzed exec Plan.
+
+Reference parity: ``src/carnot/planner/compiler/compiler.h:39``
+(Compiler::CompileToIR: parse -> ASTVisitor -> IR -> Analyze -> Optimize)
+plus the LogicalPlanner facade (``planner/logical_planner.h:40``). The
+distributed step (per-agent plan splitting) is the DistributedEngine's
+shard_map compilation; see ``pixie_tpu.parallel``.
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+from dataclasses import dataclass, field
+
+from ..exec.plan import Plan
+from .ast_visitor import ASTVisitor
+from .objects import PlanBuilder, PxLError
+from .px_module import PxModule
+from .rules import run_rules
+
+
+@dataclass
+class CompilerState:
+    """Per-query compile inputs (reference:
+    ``planner/compiler_state/compiler_state.h`` — schemas, time, max
+    output rows, registry info)."""
+
+    schemas: dict  # table name -> Relation
+    registry: object
+    now_ns: int = 0
+    max_output_rows: int = 10_000
+    max_groups: int = 4096
+
+    def __post_init__(self):
+        if not self.now_ns:
+            self.now_ns = time.time_ns()
+
+
+@dataclass
+class CompiledScript:
+    plan: Plan
+    outputs: list  # sink names in display order
+    funcs: dict = field(default_factory=dict)  # module-level PxL functions
+
+
+def parse_pxl(query: str) -> ast.Module:
+    """Parse PxL source (reference wraps libpypa, ``parser/parser.h:45``;
+    PxL is Python-shaped so CPython's ast is the natural parser here)."""
+    try:
+        return ast.parse(query)
+    except SyntaxError as e:
+        raise PxLError(f"syntax error: {e.msg}", e.lineno)
+
+
+def compile_pxl(query: str, state: CompilerState) -> CompiledScript:
+    tree = parse_pxl(query)
+    builder = PlanBuilder(
+        plan=Plan(),
+        schemas=dict(state.schemas),
+        registry=state.registry,
+        max_groups=state.max_groups,
+    )
+    px = PxModule(builder, state.now_ns)
+    visitor = ASTVisitor(px)
+    visitor.run(tree)
+    if not builder.sinks:
+        raise PxLError(
+            "script produced no output tables; call px.display(df) (or the "
+            "script only defines functions — call one and display its result)"
+        )
+    run_rules(builder.plan, state.max_output_rows)
+    return CompiledScript(
+        plan=builder.plan, outputs=list(builder.sinks), funcs=visitor.funcs
+    )

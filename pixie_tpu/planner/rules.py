@@ -1,0 +1,306 @@
+"""Plan rewrite rules (analyzer/optimizer batches).
+
+Reference parity: ``src/carnot/planner/compiler/analyzer/`` +
+``optimizer/`` rule passes run by RuleExecutor
+(``planner/rules/rule_executor.h:120``). The rules here operate on the
+exec-layer Plan DAG:
+
+- ``fuse_quantile_plucks``: pluck_float64(quantiles(x), 'p99') inside the
+  aggregating fragment becomes a direct ``_quantile_p99`` UDA output, so
+  the hot path never materializes JSON sketch strings (TPU-specific; the
+  reference evaluates pluck per row).
+- ``prune_unused_columns``: projection pushdown to sources + dropping
+  dead Map/Agg outputs (reference ``prune_unused_columns_rule``).
+- ``add_limit_to_result_sinks``: cap result streams (reference
+  ``add_limit_to_batch_result_sink_rule``, 10k default).
+- ``prune_unreachable``: drop operators not feeding any result sink
+  (reference ``prune_unconnected_operators_rule``).
+"""
+
+from __future__ import annotations
+
+from ..exec.plan import (
+    AggExpr,
+    AggOp,
+    ColumnRef,
+    FilterOp,
+    FuncCall,
+    JoinOp,
+    LimitOp,
+    Literal,
+    MapOp,
+    MemorySourceOp,
+    Plan,
+    ResultSinkOp,
+    UnionOp,
+)
+from ..udf.builtins.math_sketches import QUANTILE_FIELDS
+
+_PLUCK_FUNCS = frozenset({"pluck", "pluck_float64", "pluck_int64"})
+ALL = None  # "requires every column" marker
+
+
+def run_rules(plan: Plan, max_output_rows: int = 10_000) -> Plan:
+    prune_unreachable(plan)
+    fuse_quantile_plucks(plan)
+    prune_unused_columns(plan)
+    add_limit_to_result_sinks(plan, max_output_rows)
+    return plan
+
+
+def _consumers(plan: Plan) -> dict:
+    out: dict[int, list] = {nid: [] for nid in plan.nodes}
+    for n in plan.nodes.values():
+        for i in n.inputs:
+            out[i].append(n.id)
+    return out
+
+
+def _expr_columns(expr, acc: set):
+    if isinstance(expr, ColumnRef):
+        acc.add(expr.name)
+    elif isinstance(expr, FuncCall):
+        for a in expr.args:
+            _expr_columns(a, acc)
+    return acc
+
+
+def _rewrite_expr(expr, fn):
+    """Bottom-up expression rewrite; ``fn`` maps a node to a replacement
+    (or returns it unchanged)."""
+    if isinstance(expr, FuncCall):
+        expr = FuncCall(expr.name, tuple(_rewrite_expr(a, fn) for a in expr.args))
+    return fn(expr)
+
+
+# -- quantile pluck fusion ----------------------------------------------------
+def fuse_quantile_plucks(plan: Plan) -> None:
+    consumers = _consumers(plan)
+
+    def find_quantile_agg(start_nid: int, col: str):
+        """Walk up a single-consumer chain to the AggOp producing ``col``
+        via the 'quantiles' UDA. Returns (agg_nid, path_map_nids,
+        agg_out_name) or None."""
+        nid = start_nid
+        path_maps = []
+        while True:
+            if len(consumers.get(nid, [])) > 1:
+                return None  # materialization boundary: host pluck works
+            node = plan.nodes[nid]
+            op = node.op
+            if isinstance(op, AggOp):
+                for ae in op.aggs:
+                    if ae.out_name == col:
+                        if ae.uda_name == "quantiles":
+                            return nid, path_maps, col
+                        return None
+                return None
+            if isinstance(op, (FilterOp, LimitOp)):
+                nid = node.inputs[0]
+            elif isinstance(op, MapOp):
+                src = next((e for n, e in op.exprs if n == col), None)
+                if not isinstance(src, ColumnRef):
+                    return None
+                col = src.name
+                path_maps.append(nid)
+                nid = node.inputs[0]
+            else:
+                return None
+
+    for nid in list(plan.topo_order()):
+        node = plan.nodes[nid]
+        op = node.op
+        if not isinstance(op, (MapOp, FilterOp)):
+            continue
+
+        def rewrite(e, _node=node):
+            if not (
+                isinstance(e, FuncCall)
+                and e.name in _PLUCK_FUNCS
+                and len(e.args) == 2
+                and isinstance(e.args[0], ColumnRef)
+                and isinstance(e.args[1], Literal)
+                and e.args[1].value in QUANTILE_FIELDS
+            ):
+                return e
+            if not _node.inputs:
+                return e
+            found = find_quantile_agg(_node.inputs[0], e.args[0].name)
+            if found is None:
+                return e
+            agg_nid, path_maps, agg_out = found
+            agg_node = plan.nodes[agg_nid]
+            field = e.args[1].value
+            src_ae = next(
+                ae for ae in agg_node.op.aggs if ae.out_name == agg_out
+            )
+            new_name = f"_q_{field}_{src_ae.out_name}"
+            if all(ae.out_name != new_name for ae in agg_node.op.aggs):
+                agg_node.op = AggOp(
+                    group_cols=agg_node.op.group_cols,
+                    aggs=agg_node.op.aggs
+                    + (AggExpr(new_name, f"_quantile_{field}", src_ae.args),),
+                    max_groups=agg_node.op.max_groups,
+                )
+            # Thread the new column through intermediate full projections.
+            for mid in path_maps:
+                mop = plan.nodes[mid].op
+                if all(n != new_name for n, _ in mop.exprs):
+                    plan.nodes[mid].op = MapOp(
+                        exprs=mop.exprs + ((new_name, ColumnRef(new_name)),)
+                    )
+            return ColumnRef(new_name)
+
+        if isinstance(op, MapOp):
+            node.op = MapOp(
+                exprs=tuple((n, _rewrite_expr(e, rewrite)) for n, e in op.exprs)
+            )
+        else:
+            node.op = FilterOp(predicate=_rewrite_expr(op.predicate, rewrite))
+
+
+# -- column pruning -----------------------------------------------------------
+def prune_unused_columns(plan: Plan) -> None:
+    """Two phases: propagate per-node column requirements from the sinks,
+    then rewrite Map/Agg/Source ops to drop dead columns."""
+    order = plan.topo_order()
+    required: dict[int, object] = {nid: set() for nid in plan.nodes}
+
+    def require(nid, cols):
+        if cols is ALL or required[nid] is ALL:
+            required[nid] = ALL
+        else:
+            required[nid] = required[nid] | cols
+
+    for nid in reversed(order):
+        node = plan.nodes[nid]
+        op = node.op
+        req = required[nid]
+        if isinstance(op, ResultSinkOp):
+            require(node.inputs[0], ALL)
+        elif isinstance(op, (LimitOp, UnionOp)):
+            for i in node.inputs:
+                require(i, req)
+        elif isinstance(op, FilterOp):
+            pred_cols = _expr_columns(op.predicate, set())
+            require(node.inputs[0], ALL if req is ALL else req | pred_cols)
+        elif isinstance(op, MapOp):
+            kept = _kept_map_exprs(op, req)
+            needed = set()
+            for _n, e in kept:
+                _expr_columns(e, needed)
+            require(node.inputs[0], needed)
+        elif isinstance(op, AggOp):
+            needed = set(op.group_cols)
+            for ae in op.aggs:
+                if req is ALL or ae.out_name in req:
+                    for a in ae.args:
+                        _expr_columns(a, needed)
+            require(node.inputs[0], needed)
+        elif isinstance(op, JoinOp):
+            l_rel = plan.nodes[node.inputs[0]].relation
+            r_rel = plan.nodes[node.inputs[1]].relation
+            if req is ALL or l_rel is None or r_rel is None:
+                require(node.inputs[0], ALL)
+                require(node.inputs[1], ALL)
+            else:
+                l_req, r_req = set(op.left_on), set(op.right_on)
+                taken = set(l_rel.column_names)
+                for c in l_rel.column_names:
+                    if c in req:
+                        l_req.add(c)
+                for c in r_rel.column_names:
+                    if c in op.right_on:
+                        continue
+                    out_n = c
+                    while out_n in taken:
+                        out_n += op.suffix
+                    taken.add(out_n)
+                    if out_n in req:
+                        r_req.add(c)
+                require(node.inputs[0], l_req)
+                require(node.inputs[1], r_req)
+        elif isinstance(op, MemorySourceOp):
+            pass
+        else:
+            for i in node.inputs:
+                require(i, ALL)
+
+    # Phase 2: rewrite.
+    for nid in order:
+        node = plan.nodes[nid]
+        op = node.op
+        req = required[nid]
+        if req is ALL:
+            continue
+        if isinstance(op, MapOp):
+            kept = _kept_map_exprs(op, req)
+            if len(kept) != len(op.exprs):
+                node.op = MapOp(exprs=kept)
+        elif isinstance(op, AggOp):
+            kept = tuple(ae for ae in op.aggs if ae.out_name in req)
+            if len(kept) != len(op.aggs):
+                node.op = AggOp(
+                    group_cols=op.group_cols, aggs=kept,
+                    max_groups=op.max_groups,
+                )
+        elif isinstance(op, MemorySourceOp):
+            if node.relation is not None:
+                cols = tuple(
+                    c for c in node.relation.column_names if c in req
+                )
+                if len(cols) != len(node.relation.column_names):
+                    node.op = MemorySourceOp(
+                        table=op.table, columns=cols,
+                        start_time=op.start_time, stop_time=op.stop_time,
+                    )
+
+
+def _kept_map_exprs(op: MapOp, req):
+    """Map exprs surviving pruning (shared by both phases so requirement
+    propagation matches the rewrite): at least one expr is kept to
+    preserve row cardinality."""
+    if req is ALL:
+        return op.exprs
+    kept = tuple((n, e) for n, e in op.exprs if n in req)
+    if not kept and op.exprs:
+        kept = op.exprs[:1]
+    return kept
+
+
+# -- limits -------------------------------------------------------------------
+def add_limit_to_result_sinks(plan: Plan, max_rows: int) -> None:
+    for nid in list(plan.nodes):
+        node = plan.nodes[nid]
+        if not isinstance(node.op, ResultSinkOp):
+            continue
+        src = node.inputs[0]
+        src_op = plan.nodes[src].op
+        if isinstance(src_op, LimitOp) and src_op.n <= max_rows:
+            continue
+        lim = plan.add(LimitOp(max_rows), [src])
+        plan.nodes[lim].relation = plan.nodes[src].relation
+        node.inputs[0] = lim
+
+
+# -- reachability -------------------------------------------------------------
+def prune_unreachable(plan: Plan) -> None:
+    sink_ids = [
+        nid for nid, n in plan.nodes.items() if isinstance(n.op, ResultSinkOp)
+    ]
+    if not sink_ids:
+        return
+    seen: set = set()
+
+    def visit(nid):
+        if nid in seen:
+            return
+        seen.add(nid)
+        for i in plan.nodes[nid].inputs:
+            visit(i)
+
+    for s in sink_ids:
+        visit(s)
+    for nid in list(plan.nodes):
+        if nid not in seen:
+            del plan.nodes[nid]

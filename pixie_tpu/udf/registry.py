@@ -44,6 +44,7 @@ class Registry:
         fn: Callable,
         executor: Executor = Executor.DEVICE,
         dict_arg: int = 0,
+        out_dict=None,
         doc: str = "",
     ) -> ScalarUDFDef:
         udf = ScalarUDFDef(
@@ -53,6 +54,7 @@ class Registry:
             fn=fn,
             executor=executor,
             dict_arg=dict_arg,
+            out_dict=out_dict,
             doc=doc,
         )
         self.register_scalar(udf)
@@ -107,6 +109,16 @@ class Registry:
 
     def uda_names(self) -> list[str]:
         return sorted(self._uda)
+
+    def clone(self, name: str | None = None, exclude=()) -> "Registry":
+        """Shallow copy (defs are frozen), optionally dropping some names —
+        used to rebind state-backed funcs (metadata) without losing caller
+        registrations."""
+        out = Registry(name or self.name)
+        ex = set(exclude)
+        out._scalar = {n: list(v) for n, v in self._scalar.items() if n not in ex}
+        out._uda = {n: list(v) for n, v in self._uda.items() if n not in ex}
+        return out
 
     def docs(self) -> dict[str, str]:
         """name -> doc for every registered func (doc-extraction parity)."""

@@ -63,6 +63,10 @@ class ScalarUDFDef:
     # HOST_DICT only: fn is str -> python value; which arg is the string
     # column (all other args must be literals at plan time).
     dict_arg: int = 0
+    # DEVICE UDFs returning STRING may carry their own output dictionary
+    # (metadata lookups emit ids into an entity-name dictionary rather than
+    # remapping an input dictionary).
+    out_dict: object = None
     doc: str = ""
 
 

@@ -1,0 +1,311 @@
+"""PxL frontend tests: compile scripts -> plans -> engine execution.
+
+Mirrors the reference's compiler tests (``planner/compiler/compiler_test.cc``)
+plus the end-to-end carnot_test.cc style: every script executes against an
+in-memory engine and results are checked against numpy.
+"""
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec.engine import Engine, QueryError
+from pixie_tpu.exec.plan import AggOp, LimitOp, MemorySourceOp, ResultSinkOp
+from pixie_tpu.metadata import MetadataState, UPID
+from pixie_tpu.planner import CompilerState, PxLError, compile_pxl
+from pixie_tpu.types.batch import HostBatch
+from pixie_tpu.types.dtypes import DataType
+from pixie_tpu.types.relation import Relation
+
+NOW = 1_700_000_000_000_000_000
+N = 4000
+
+
+def _http_events(n=N, seed=3):
+    rng = np.random.default_rng(seed)
+    upid_hi = rng.integers(1, 5, n).astype(np.uint64)  # asid<<32|pid simplified
+    upid_lo = np.full(n, 7, dtype=np.uint64)
+    return {
+        "time_": NOW - np.arange(n, dtype=np.int64)[::-1] * 1_000_000,
+        "upid": np.stack([upid_hi, upid_lo], axis=1),
+        "service": rng.choice(["cart", "checkout", "frontend", ""], n),
+        "req_path": rng.choice(["/a", "/b", "/c"], n),
+        "resp_status": rng.choice([200, 200, 200, 404, 500], n),
+        "latency": rng.integers(10_000, 50_000_000, n),
+    }
+
+
+REL = Relation([
+    ("time_", DataType.TIME64NS),
+    ("upid", DataType.UINT128),
+    ("service", DataType.STRING),
+    ("req_path", DataType.STRING),
+    ("resp_status", DataType.INT64),
+    ("latency", DataType.INT64),
+])
+
+
+@pytest.fixture()
+def engine():
+    eng = Engine(window_rows=2048)
+    eng.create_table("http_events", REL)
+    eng.append_data("http_events", HostBatch.from_pydict(_http_events(), relation=REL))
+    return eng
+
+
+def run(engine, query, **kw):
+    return engine.execute_query(query, now_ns=NOW, **kw)
+
+
+def test_simple_filter_agg_script(engine):
+    out = run(engine, """
+import px
+df = px.DataFrame(table='http_events')
+df = df[df.resp_status >= 400]
+df = df.groupby('service').agg(n=('latency', px.count))
+px.display(df)
+""")["output"].to_pydict()
+    data = _http_events()
+    bad = data["resp_status"] >= 400
+    for svc, cnt in zip(out["service"], out["n"]):
+        expect = int(np.sum(bad & (data["service"] == svc)))
+        assert cnt == expect
+
+
+def test_map_assign_projection_and_literal_math(engine):
+    out = run(engine, """
+import px
+ns_per_ms = 1000 * 1000
+df = px.DataFrame(table='http_events')
+df.lat_ms = df.latency / ns_per_ms
+df.slow = df.lat_ms > 10.0
+df = df[['service', 'lat_ms', 'slow']]
+px.display(df, 'mapped')
+""")["mapped"].to_pydict()
+    data = _http_events()
+    np.testing.assert_allclose(
+        out["lat_ms"], data["latency"] / 1e6, rtol=1e-5
+    )
+    assert set(out) == {"service", "lat_ms", "slow"}
+
+
+def test_quantiles_pluck_fusion(engine):
+    q = """
+import px
+df = px.DataFrame(table='http_events')
+agg = df.groupby('service').agg(lat_q=('latency', px.quantiles),
+                                n=('latency', px.count))
+agg.p50 = px.pluck_float64(agg.lat_q, 'p50')
+agg = agg[['service', 'p50', 'n']]
+px.display(agg)
+"""
+    state = CompilerState(
+        schemas={"http_events": REL}, registry=engine.registry, now_ns=NOW
+    )
+    compiled = compile_pxl(q, state)
+    aggs = [n.op for n in compiled.plan.nodes.values() if isinstance(n.op, AggOp)]
+    assert len(aggs) == 1
+    names = {ae.uda_name for ae in aggs[0].aggs}
+    assert "_quantile_p50" in names
+    # The unused struct output is pruned.
+    assert "quantiles" not in names
+
+    out = run(engine, q)["output"].to_pydict()
+    data = _http_events()
+    for svc, p50 in zip(out["service"], out["p50"]):
+        ref = np.quantile(data["latency"][data["service"] == svc], 0.5)
+        assert abs(p50 - ref) / ref < 0.15
+
+
+def test_http_request_stats_script(engine):
+    """Compressed version of px/http_request_stats/stats.pxl
+    (reference: src/pxl_scripts/px/http_request_stats/stats.pxl)."""
+    out = run(engine, """
+import px
+t1 = px.DataFrame(table='http_events', start_time='-300s')
+t1.failure = t1.resp_status >= 400
+window = px.DurationNanos(px.seconds(1))
+t1.range_group = px.bin(t1.time_, window)
+
+quantiles_agg = t1.groupby('service').agg(
+    latency_quantiles=('latency', px.quantiles),
+    errors=('failure', px.mean),
+    throughput_total=('resp_status', px.count),
+)
+quantiles_agg.errors = px.Percent(quantiles_agg.errors)
+quantiles_agg.latency_p50 = px.DurationNanos(px.floor(
+    px.pluck_float64(quantiles_agg.latency_quantiles, 'p50')))
+quantiles_agg.latency_p99 = px.DurationNanos(px.floor(
+    px.pluck_float64(quantiles_agg.latency_quantiles, 'p99')))
+quantiles_table = quantiles_agg[['service', 'latency_p50', 'latency_p99',
+                                 'errors', 'throughput_total']]
+
+range_agg = t1.groupby(['service', 'range_group']).agg(
+    requests_per_window=('resp_status', px.count),
+)
+rps_table = range_agg.groupby('service').agg(
+    request_throughput=('requests_per_window', px.mean))
+
+joined_table = quantiles_table.merge(rps_table,
+                                     how='inner',
+                                     left_on=['service'],
+                                     right_on=['service'],
+                                     suffixes=['', '_x'])
+joined_table['throughput'] = joined_table.request_throughput / window
+joined_table = joined_table[[
+    'service', 'latency_p50', 'latency_p99', 'errors', 'throughput']]
+joined_table = joined_table[joined_table.service != '']
+px.display(joined_table)
+""")["output"].to_pydict()
+    data = _http_events()
+    assert set(out["service"]) == {"cart", "checkout", "frontend"}
+    for svc, errs, p99 in zip(out["service"], out["errors"], out["latency_p99"]):
+        m = data["service"] == svc
+        ref_err = np.mean(data["resp_status"][m] >= 400)
+        np.testing.assert_allclose(errs, ref_err, rtol=1e-6)
+        ref_p99 = np.quantile(data["latency"][m], 0.99)
+        assert abs(p99 - ref_p99) / ref_p99 < 0.2
+
+
+def test_ctx_metadata(engine):
+    md = MetadataState()
+    md.add_service("s-1", "payments", "prod")
+    md.add_pod("p-1", "payments-0", "prod", node_name="node-a",
+               ip="10.0.0.1", service_uids=("s-1",))
+    md.add_pod("p-2", "web-0", "prod", ip="10.0.0.2")
+    for asid in (1, 2):
+        md.add_process(UPID(0, asid, 7), "p-1")
+    for asid in (3, 4):
+        md.add_process(UPID(0, asid, 7), "p-2")
+    engine.set_metadata_state(md)
+
+    out = run(engine, """
+import px
+df = px.DataFrame(table='http_events')
+df.service = df.ctx['service']
+df.pod = df.ctx['pod']
+df = df.groupby(['service', 'pod']).agg(n=('latency', px.count))
+px.display(df)
+""")["output"].to_pydict()
+    rows = {(s, p): n for s, p, n in zip(out["service"], out["pod"], out["n"])}
+    data = _http_events()
+    his = data["upid"][:, 0]
+    assert rows[("prod/payments", "prod/payments-0")] == int(np.sum(his <= 2))
+    assert rows[("", "prod/web-0")] == int(np.sum(his >= 3))
+
+
+def test_head_drop_append(engine):
+    out = run(engine, """
+import px
+df = px.DataFrame(table='http_events')
+a = df[df.resp_status == 404].drop(['upid', 'time_'])
+b = df[df.resp_status == 500].drop(['upid', 'time_'])
+u = a.append(b)
+u = u.head(50)
+px.display(u, 'errors')
+""")["errors"]
+    assert out.length <= 50
+    d = out.to_pydict()
+    assert set(np.unique(d["resp_status"])) <= {404, 500}
+    assert "upid" not in d
+
+
+def test_compile_time_control_flow(engine):
+    out = run(engine, """
+import px
+
+filter_errors = True
+paths = ['/a', '/b']
+
+def make_table(start_time: str):
+    df = px.DataFrame(table='http_events', start_time=start_time)
+    if filter_errors:
+        df = df[df.resp_status >= 400]
+    cond = df.req_path == paths[0]
+    for p in paths[1:]:
+        cond = cond | (df.req_path == p)
+    return df[cond]
+
+px.display(make_table('-300s').groupby('req_path').agg(
+    n=('latency', px.count)))
+""")["output"].to_pydict()
+    data = _http_events()
+    m = (data["resp_status"] >= 400) & np.isin(data["req_path"], ["/a", "/b"])
+    assert sorted(out["req_path"]) == ["/a", "/b"]
+    assert int(out["n"].sum()) == int(m.sum())
+
+
+def test_prune_pushes_columns_into_source(engine):
+    q = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.groupby('service').agg(n=('latency', px.count))
+px.display(df)
+"""
+    state = CompilerState(
+        schemas={"http_events": REL}, registry=engine.registry, now_ns=NOW
+    )
+    plan = compile_pxl(q, state).plan
+    src = next(n.op for n in plan.nodes.values()
+               if isinstance(n.op, MemorySourceOp))
+    assert src.columns is not None
+    assert set(src.columns) == {"service", "latency"}
+    # A limit protects the sink.
+    sink = next(n for n in plan.nodes.values()
+                if isinstance(n.op, ResultSinkOp))
+    assert isinstance(plan.nodes[sink.inputs[0]].op, LimitOp)
+
+
+def test_time_bounds(engine):
+    out = run(engine, """
+import px
+df = px.DataFrame(table='http_events', start_time='-1s')
+df = df.agg(n=('latency', px.count))
+px.display(df)
+""")["output"].to_pydict()
+    data = _http_events()
+    expect = int(np.sum(data["time_"] >= NOW - 1_000_000_000))
+    assert out["n"].tolist() == [expect]
+
+
+def test_errors(engine):
+    with pytest.raises(PxLError, match="does not exist"):
+        run(engine, "import px\npx.display(px.DataFrame(table='nope'))")
+    with pytest.raises(PxLError, match="column 'nope'"):
+        run(engine, """
+import px
+df = px.DataFrame(table='http_events')
+px.display(df[df.nope == 1])
+""")
+    with pytest.raises(PxLError, match="BOOLEAN"):
+        run(engine, """
+import px
+df = px.DataFrame(table='http_events')
+px.display(df[df.latency + 1])
+""")
+    with pytest.raises(PxLError, match="no output tables"):
+        run(engine, "import px\ndf = px.DataFrame(table='http_events')")
+    with pytest.raises(PxLError, match="only 'px'"):
+        run(engine, "import os")
+    with pytest.raises(PxLError, match="does not support While"):
+        run(engine, "import px\nwhile True:\n    pass")
+
+
+def test_script_functions_exposed(engine):
+    q = """
+import px
+
+def latency_by_path(start: str):
+    '''Per-path latency stats.'''
+    df = px.DataFrame(table='http_events', start_time=start)
+    return df.groupby('req_path').agg(mean=('latency', px.mean))
+
+px.display(latency_by_path('-300s'), 'by_path')
+"""
+    state = CompilerState(
+        schemas={"http_events": REL}, registry=engine.registry, now_ns=NOW
+    )
+    compiled = compile_pxl(q, state)
+    assert "latency_by_path" in compiled.funcs
+    assert compiled.funcs["latency_by_path"].doc == "Per-path latency stats."
+    out = run(engine, q)["by_path"].to_pydict()
+    assert len(out["req_path"]) == 3
