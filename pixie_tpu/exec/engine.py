@@ -17,6 +17,7 @@ TPU execution model:
 
 from __future__ import annotations
 
+import itertools
 import json
 from dataclasses import dataclass, field
 from typing import Optional
@@ -124,21 +125,32 @@ class Engine:
     ``src/carnot/engine_state.h``.)"""
 
     def __init__(self, registry: Registry | None = None, window_rows: int = 1 << 17):
+        from ..table_store import TableStore
+
         self.registry = registry or default_registry()
-        self.tables: dict[str, InMemoryTable] = {}
+        self.table_store = TableStore()
         self.window_rows = window_rows
 
+    @property
+    def tables(self) -> dict:
+        """{name: default-tablet (or first) Table} view over the store."""
+        out = {}
+        for n in self.table_store.table_names():
+            t = self.table_store.get_table(n)
+            if t is None:
+                tablets = self.table_store.tablets(n)
+                t = tablets[0] if tablets else None
+            out[n] = t
+        return out
+
     # -- table management ----------------------------------------------------
-    def create_table(self, name: str, relation: Relation | None = None) -> InMemoryTable:
-        t = InMemoryTable(name=name, relation=relation or Relation())
-        self.tables[name] = t
-        return t
+    def create_table(self, name: str, relation: Relation | None = None,
+                     max_bytes: int = -1):
+        return self.table_store.add_table(name, relation, max_bytes=max_bytes)
 
     def append_data(self, name: str, data, time_cols=("time_",)):
         """Push path (Stirling's RegisterDataPushCallback analog)."""
-        if name not in self.tables:
-            self.create_table(name)
-        return self.tables[name].append(data, time_cols=time_cols)
+        return self.table_store.append_data(name, data, time_cols=time_cols)
 
     # -- execution -----------------------------------------------------------
     def execute_query(self, query: str, now_ns: int = 0,
@@ -187,16 +199,20 @@ class Engine:
             node = plan.nodes[nid]
             op = node.op
             if isinstance(op, MemorySourceOp):
-                if op.table not in self.tables:
+                tablets = self.table_store.tablets(op.table)
+                if not tablets:
                     raise QueryError(f"no table named {op.table!r}")
-                table = self.tables[op.table]
-                rel = table.relation
+                # Tablets share relation + string dictionaries (enforced by
+                # TableStore); a query scans all of them.
+                base = next((t for t in tablets if len(t.relation)), tablets[0])
                 chain = []
                 if op.columns is not None:
                     chain.append(
                         MapOp(exprs=tuple((c, _col(c)) for c in op.columns))
                     )
-                results[nid] = _Stream(rel, dict(table.dicts), chain, table, op)
+                results[nid] = _Stream(
+                    base.relation, dict(base.dicts), chain, tablets, op
+                )
             elif isinstance(op, (MapOp, FilterOp, AggOp, LimitOp)):
                 st = self._as_stream(results[node.inputs[0]])
                 if st.chain and isinstance(st.chain[-1], LimitOp):
@@ -241,10 +257,14 @@ class Engine:
             batches = [stream.source]
         else:
             sop = stream.source_op
-            batches = list(
-                stream.source.scan(
+            tables = (
+                stream.source if isinstance(stream.source, list) else [stream.source]
+            )
+            batches = itertools.chain.from_iterable(
+                t.scan(
                     sop.start_time if sop else None, sop.stop_time if sop else None
                 )
+                for t in tables
             )
         for b in batches:
             for off in range(0, max(b.length, 1), self.window_rows):

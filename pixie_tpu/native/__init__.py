@@ -1,0 +1,45 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference keeps its table store, agent shells, and data plane in C++
+(SURVEY.md L0-L2); here the host-side hot/cold table slab store is native,
+loaded via ctypes. Build is lazy and cached next to the source; when no
+toolchain is available, callers fall back to pure-numpy backends.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS: dict[str, object] = {}
+
+
+def _build(name: str) -> str:
+    src = os.path.join(_DIR, f"{name}.cc")
+    out = os.path.join(_DIR, f"lib{name}.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", out, src]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return out
+
+
+def load(name: str):
+    """Load (building if needed) libpixie native component ``name``.
+
+    Returns the ctypes CDLL, or None when the toolchain/build fails —
+    callers must degrade to their Python fallback.
+    """
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        try:
+            lib = ctypes.CDLL(_build(name))
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError):
+            lib = None
+        _LIBS[name] = lib
+        return lib

@@ -1,0 +1,541 @@
+"""Hot/cold Table with time-indexed cursors and byte-budget expiry.
+
+Reference parity: ``src/table_store/table/table.h:104`` — writes land in a
+hot store, a compaction pass merges them into large cold slabs, reads go
+through a ``Cursor`` keyed by *unique row ids* so no row is returned twice
+even when compaction/expiry runs mid-query, and the oldest batches expire
+when the byte budget is exceeded.
+
+TPU-first redesign: both stores hold flat fixed-width column slabs (no
+Arrow framing) sized so cursor reads hand back contiguous windows that
+stage straight into fixed-capacity device buffers. Strings are dictionary
+ids by the time they reach the table (``pixie_tpu.types.strings``); the
+dictionaries live on the Python Table wrapper and are append-only, so
+shared references stay valid as the table grows.
+
+The slab store itself is native C++ (``pixie_tpu/native/table_ring.cc``,
+ctypes-bound) with a pure-numpy fallback mirroring the same ABI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from dataclasses import dataclass
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..native import load as load_native
+from ..types.batch import HostBatch
+from ..types.dtypes import DataType, host_dtypes
+from ..types.relation import Relation
+from ..types.strings import StringDictionary
+
+TIME_COLUMN = "time_"
+DEFAULT_COMPACTED_ROWS = 64 * 1024
+
+
+@dataclass
+class TableStats:
+    bytes: int
+    hot_bytes: int
+    cold_bytes: int
+    num_batches: int
+    batches_added: int
+    batches_expired: int
+    bytes_added: int
+    compacted_batches: int
+    min_time: int
+    num_rows: int
+
+
+@dataclass(frozen=True)
+class StartSpec:
+    """Where a cursor begins: at a time, or the current start of table."""
+
+    start_time: Optional[int] = None
+
+    @classmethod
+    def at_time(cls, t: int) -> "StartSpec":
+        return cls(start_time=t)
+
+
+@dataclass(frozen=True)
+class StopSpec:
+    """When a cursor is exhausted: at a time, at the current end of the
+    table, or never (infinite streaming — the live-query mode)."""
+
+    stop_time: Optional[int] = None
+    infinite: bool = False
+
+    @classmethod
+    def at_time(cls, t: int) -> "StopSpec":
+        return cls(stop_time=t)
+
+    @classmethod
+    def current_end(cls) -> "StopSpec":
+        return cls()
+
+    @classmethod
+    def never(cls) -> "StopSpec":
+        return cls(infinite=True)
+
+
+class _PyBackend:
+    """Pure-numpy mirror of the native slab store ABI (fallback path)."""
+
+    def __init__(self, elem_dtypes, has_time, compacted_rows, max_bytes):
+        self.elem_dtypes = elem_dtypes
+        self.row_bytes = sum(np.dtype(d).itemsize for d in elem_dtypes)
+        self.has_time = has_time
+        self.compacted_rows = compacted_rows
+        self.max_bytes = max_bytes
+        self.lock = threading.Lock()
+        self.hot: list = []  # [first_row_id, planes, min_t, max_t]
+        self.cold: list = []
+        self.next_row_id = 0
+        self.counters = dict(
+            batches_added=0, batches_expired=0, bytes_added=0, compacted=0
+        )
+
+    def _bytes(self, q) -> int:
+        return sum(len(b[1][0]) * self.row_bytes for b in q)
+
+    def _first_row_id(self) -> int:
+        if self.cold:
+            return self.cold[0][0]
+        if self.hot:
+            return self.hot[0][0]
+        return self.next_row_id
+
+    def append(self, planes: Sequence[np.ndarray], times) -> int:
+        n = len(planes[0])
+        if n == 0:
+            return -1
+        mn, mx = (int(times.min()), int(times.max())) if self.has_time else (0, 0)
+        with self.lock:
+            if self.max_bytes >= 0:
+                while (
+                    self._bytes(self.hot) + self._bytes(self.cold) + n * self.row_bytes
+                    > self.max_bytes
+                ):
+                    q = self.cold if self.cold else self.hot
+                    if not q:
+                        break
+                    q.pop(0)
+                    self.counters["batches_expired"] += 1
+            rid = self.next_row_id
+            self.next_row_id += n
+            self.hot.append([rid, [p.copy() for p in planes], mn, mx])
+            self.counters["batches_added"] += 1
+            self.counters["bytes_added"] += n * self.row_bytes
+            return rid
+
+    def compact(self) -> int:
+        with self.lock:
+            created = 0
+            while self.hot:
+                rows, take = 0, 0
+                while take < len(self.hot) and rows < self.compacted_rows:
+                    rows += len(self.hot[take][1][0])
+                    take += 1
+                group = self.hot[:take]
+                del self.hot[:take]
+                planes = [
+                    np.concatenate([g[1][i] for g in group])
+                    for i in range(len(self.elem_dtypes))
+                ]
+                self.cold.append(
+                    [
+                        group[0][0],
+                        planes,
+                        min(g[2] for g in group),
+                        max(g[3] for g in group),
+                    ]
+                )
+                self.counters["compacted"] += 1
+                created += 1
+            return created
+
+    def first_row_id(self) -> int:
+        with self.lock:
+            return self._first_row_id()
+
+    def end_row_id(self) -> int:
+        with self.lock:
+            return self.next_row_id
+
+    def row_id_for_time(self, t: int, strictly_greater: bool) -> int:
+        with self.lock:
+            if not self.has_time:
+                return self._first_row_id()
+            for q in (self.cold, self.hot):
+                for rid, planes, _, mx in q:
+                    if (mx > t) if strictly_greater else (mx >= t):
+                        times = planes[0]
+                        hits = np.nonzero(times > t if strictly_greater else times >= t)[0]
+                        if len(hits):
+                            return rid + int(hits[0])
+            return self.next_row_id
+
+    def read(self, start_row_id: int, max_rows: int):
+        with self.lock:
+            row_id = max(start_row_id, self._first_row_id())
+            pieces = [[] for _ in self.elem_dtypes]
+            copied = 0
+            for q in (self.cold, self.hot):
+                for rid, planes, _, _ in q:
+                    n = len(planes[0])
+                    if rid + n <= row_id:
+                        continue
+                    start = max(0, row_id + copied - rid)
+                    take = min(n - start, max_rows - copied)
+                    if take <= 0:
+                        continue
+                    for i, p in enumerate(planes):
+                        pieces[i].append(p[start : start + take])
+                    copied += take
+                    if copied >= max_rows:
+                        break
+                if copied >= max_rows:
+                    break
+            out = [
+                np.concatenate(ps) if ps else np.empty(0, dtype=d)
+                for ps, d in zip(pieces, self.elem_dtypes)
+            ]
+            return out, row_id, copied
+
+    def stats(self) -> list:
+        with self.lock:
+            hot_b, cold_b = self._bytes(self.hot), self._bytes(self.cold)
+            min_t = (
+                self.cold[0][2] if self.cold else (self.hot[0][2] if self.hot else -1)
+            )
+            return [
+                hot_b + cold_b,
+                hot_b,
+                cold_b,
+                len(self.hot) + len(self.cold),
+                self.counters["batches_added"],
+                self.counters["batches_expired"],
+                self.counters["bytes_added"],
+                self.counters["compacted"],
+                min_t,
+                self.next_row_id - self._first_row_id(),
+            ]
+
+
+class _NativeBackend:
+    """ctypes binding for pixie_tpu/native/table_ring.cc."""
+
+    _configured = False
+
+    def __init__(self, lib, elem_dtypes, has_time, compacted_rows, max_bytes):
+        self.lib = lib
+        self.elem_dtypes = [np.dtype(d) for d in elem_dtypes]
+        self.has_time = has_time
+        self._configure(lib)
+        sizes = (ctypes.c_int32 * len(self.elem_dtypes))(
+            *[d.itemsize for d in self.elem_dtypes]
+        )
+        self.handle = lib.pxt_table_create(
+            len(self.elem_dtypes), sizes, int(has_time), compacted_rows, max_bytes
+        )
+
+    @classmethod
+    def _configure(cls, lib):
+        if getattr(lib, "_pxt_configured", False):
+            return
+        lib.pxt_table_create.restype = ctypes.c_void_p
+        lib.pxt_table_create.argtypes = [
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.pxt_table_destroy.argtypes = [ctypes.c_void_p]
+        lib.pxt_table_append.restype = ctypes.c_int64
+        lib.pxt_table_append.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        for fn in ("pxt_table_compact", "pxt_table_first_row_id", "pxt_table_end_row_id"):
+            f = getattr(lib, fn)
+            f.restype = ctypes.c_int64
+            f.argtypes = [ctypes.c_void_p]
+        lib.pxt_table_row_id_for_time.restype = ctypes.c_int64
+        lib.pxt_table_row_id_for_time.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+        ]
+        lib.pxt_table_read.restype = ctypes.c_int64
+        lib.pxt_table_read.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.pxt_table_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib._pxt_configured = True
+
+    def __del__(self):
+        if getattr(self, "handle", None):
+            self.lib.pxt_table_destroy(self.handle)
+            self.handle = None
+
+    def append(self, planes: Sequence[np.ndarray], times) -> int:
+        planes = [np.ascontiguousarray(p) for p in planes]
+        n = len(planes[0])
+        ptrs = (ctypes.c_void_p * len(planes))(*[p.ctypes.data for p in planes])
+        tptr = None
+        if self.has_time:
+            times = np.ascontiguousarray(times, dtype=np.int64)
+            tptr = times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        return self.lib.pxt_table_append(self.handle, n, ptrs, tptr)
+
+    def compact(self) -> int:
+        return self.lib.pxt_table_compact(self.handle)
+
+    def first_row_id(self) -> int:
+        return self.lib.pxt_table_first_row_id(self.handle)
+
+    def end_row_id(self) -> int:
+        return self.lib.pxt_table_end_row_id(self.handle)
+
+    def row_id_for_time(self, t: int, strictly_greater: bool) -> int:
+        return self.lib.pxt_table_row_id_for_time(self.handle, t, int(strictly_greater))
+
+    def read(self, start_row_id: int, max_rows: int):
+        out = [np.empty(max_rows, dtype=d) for d in self.elem_dtypes]
+        ptrs = (ctypes.c_void_p * len(out))(*[a.ctypes.data for a in out])
+        first = ctypes.c_int64(0)
+        n = self.lib.pxt_table_read(
+            self.handle, start_row_id, max_rows, ptrs, ctypes.byref(first)
+        )
+        return [a[:n] for a in out], first.value, n
+
+    def stats(self) -> list:
+        buf = (ctypes.c_int64 * 10)()
+        self.lib.pxt_table_stats(self.handle, buf)
+        return list(buf)
+
+
+class Cursor:
+    """Iterates a Table without ever returning a row twice.
+
+    Reference: ``table.h`` Table::Cursor — position is the unique id of the
+    next unread row, so compaction (which moves rows between stores) and
+    expiry (which drops them) never desynchronize the read position.
+    """
+
+    def __init__(self, table: "Table", start: StartSpec, stop: StopSpec):
+        self._table = table
+        be = table._backend
+        if start.start_time is not None:
+            self._next_row_id = be.row_id_for_time(start.start_time, False)
+        else:
+            self._next_row_id = be.first_row_id()
+        self.update_stop_spec(stop)
+
+    def update_stop_spec(self, stop: StopSpec) -> None:
+        be = self._table._backend
+        if stop.infinite:
+            self._stop_row_id = None
+        elif stop.stop_time is not None:
+            # Stop at the time or the current end, whichever is first
+            # (reference StopAtTime semantics).
+            self._stop_row_id = min(
+                be.row_id_for_time(stop.stop_time, True), be.end_row_id()
+            )
+        else:
+            self._stop_row_id = be.end_row_id()
+
+    def done(self) -> bool:
+        if self._stop_row_id is None:
+            return False
+        return self._next_row_id >= self._stop_row_id
+
+    def next_batch_ready(self) -> bool:
+        if self._stop_row_id is not None:
+            return not self.done()
+        return self._next_row_id < self._table._backend.end_row_id()
+
+    def next_batch(self, max_rows: int, cols: Optional[Sequence[str]] = None):
+        """Read up to max_rows as a HostBatch, or None when exhausted/dry."""
+        if self.done():
+            return None
+        if self._stop_row_id is not None:
+            max_rows = min(max_rows, self._stop_row_id - self._next_row_id)
+        planes, first, n = self._table._backend.read(self._next_row_id, max_rows)
+        if self._stop_row_id is not None:
+            # Expiry may have skipped the read past the stop snapshot.
+            n = min(n, max(0, self._stop_row_id - first))
+            planes = [p[:n] for p in planes]
+        if n == 0:
+            self._next_row_id = max(self._next_row_id, first)
+            return None
+        self._next_row_id = first + n
+        return self._table._batch_from_planes(planes, cols)
+
+
+class Table:
+    """Engine-facing table: relation + dictionaries over the slab store."""
+
+    def __init__(
+        self,
+        name: str,
+        relation: Relation | None = None,
+        max_bytes: int = -1,
+        compacted_rows: int = DEFAULT_COMPACTED_ROWS,
+        dicts: dict[str, StringDictionary] | None = None,
+    ):
+        self.name = name
+        self.relation = relation or Relation()
+        # ``dicts`` may be shared across tablets of one logical table so
+        # every tablet encodes strings into the same id space.
+        self.dicts: dict[str, StringDictionary] = dicts if dicts is not None else {}
+        self.max_bytes = max_bytes
+        self.compacted_rows = compacted_rows
+        self._backend = None
+        self._plane_layout: list[tuple[str, int]] = []  # native order
+        if len(self.relation):
+            self._init_backend()
+
+    # -- backend wiring ------------------------------------------------------
+    def _init_backend(self) -> None:
+        has_time = (
+            self.relation.has_column(TIME_COLUMN)
+            and self.relation.col_type(TIME_COLUMN) == DataType.TIME64NS
+        )
+        # Native layout: the time plane first (the native time index reads
+        # column 0), then every remaining plane in relation order.
+        layout: list[tuple[str, int]] = []
+        if has_time:
+            layout.append((TIME_COLUMN, 0))
+        for cname, dt in self.relation.items():
+            for i in range(len(host_dtypes(dt))):
+                if (cname, i) != (TIME_COLUMN, 0) or not has_time:
+                    layout.append((cname, i))
+        self._plane_layout = layout
+        dts = [
+            np.dtype(host_dtypes(self.relation.col_type(c))[i]) for c, i in layout
+        ]
+        lib = load_native("table_ring")
+        args = (dts, has_time, self.compacted_rows, self.max_bytes)
+        self._backend = (
+            _NativeBackend(lib, *args) if lib is not None else _PyBackend(*args)
+        )
+        for cname, dt in self.relation.items():
+            if dt == DataType.STRING:
+                self.dicts.setdefault(cname, StringDictionary())
+
+    # -- write path ----------------------------------------------------------
+    def append(self, data, time_cols: Iterable[str] = (TIME_COLUMN,)) -> HostBatch:
+        """Push path: Stirling's TransferRecordBatch analog (table.h:268)."""
+        hb = (
+            data
+            if isinstance(data, HostBatch)
+            else HostBatch.from_pydict(
+                data,
+                relation=self.relation if len(self.relation) else None,
+                time_cols=tuple(time_cols),
+                dicts=self.dicts,
+            )
+        )
+        if not len(self.relation):
+            self.relation = hb.relation
+            self._init_backend()
+        if hb.length == 0:
+            return hb
+        cols = dict(hb.cols)  # never mutate the caller's batch
+        for col, d in hb.dicts.items():
+            if col not in self.dicts:
+                self.dicts[col] = d
+            elif self.dicts[col] is not d:
+                # Re-encode foreign ids into this table's dictionary,
+                # extending it in place (append-only: ids already handed
+                # out in earlier batches stay valid).
+                mine = self.dicts[col]
+                remap = np.fromiter(
+                    (mine.get_or_add(s) for s in d.strings),
+                    dtype=np.int32,
+                    count=len(d),
+                )
+                ids = cols[col][0]
+                cols[col] = (
+                    np.where(ids >= 0, remap[np.clip(ids, 0, None)], -1).astype(
+                        np.int32
+                    ),
+                )
+        planes = [np.ascontiguousarray(cols[c][i]) for c, i in self._plane_layout]
+        times = cols[TIME_COLUMN][0] if (TIME_COLUMN, 0) == self._plane_layout[0] else None
+        self._backend.append(planes, times)
+        return hb
+
+    def compact(self) -> int:
+        """CompactHotToCold analog; call periodically (service loop)."""
+        return self._backend.compact()
+
+    # -- read path -----------------------------------------------------------
+    def cursor(
+        self, start: StartSpec | None = None, stop: StopSpec | None = None
+    ) -> Cursor:
+        return Cursor(self, start or StartSpec(), stop or StopSpec())
+
+    def scan(self, start_time=None, stop_time=None, window_rows: int = 1 << 17):
+        """Yield HostBatch windows, time-bounded (engine source interface)."""
+        if self._backend is None:
+            return
+        start = StartSpec.at_time(int(start_time)) if start_time is not None else StartSpec()
+        stop = StopSpec.at_time(int(stop_time) - 1) if stop_time is not None else StopSpec()
+        cur = self.cursor(start, stop)
+        while not cur.done():
+            hb = cur.next_batch(window_rows)
+            if hb is None:
+                break
+            yield hb
+
+    def _batch_from_planes(self, planes, cols=None) -> HostBatch:
+        by_key = {k: p for k, p in zip(self._plane_layout, planes)}
+        names = list(cols) if cols is not None else self.relation.column_names
+        rel = self.relation.select(names)
+        out_cols = {
+            c: tuple(by_key[(c, i)] for i in range(len(host_dtypes(rel.col_type(c)))))
+            for c in names
+        }
+        n = len(planes[0]) if planes else 0
+        return HostBatch(
+            relation=rel,
+            cols=out_cols,
+            length=n,
+            dicts={c: d for c, d in self.dicts.items() if c in set(names)},
+        )
+
+    def read_all(self) -> HostBatch:
+        """Materialize the whole table as one HostBatch (test/debug path)."""
+        if self._backend is None:
+            from ..exec.engine import _empty_host_batch
+
+            return _empty_host_batch(self.relation, self.dicts)
+        n = max(1, self.num_rows)
+        planes, _, got = self._backend.read(self._backend.first_row_id(), n)
+        return self._batch_from_planes([p[:got] for p in planes])
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return self.stats().num_rows if self._backend is not None else 0
+
+    def stats(self) -> TableStats:
+        if self._backend is None:
+            return TableStats(0, 0, 0, 0, 0, 0, 0, 0, -1, 0)
+        return TableStats(*self._backend.stats())

@@ -1,0 +1,137 @@
+"""TableStore: the name/id -> Table map shared by ingest and queries.
+
+Reference parity: ``src/table_store/table/table_store.h:79`` — tables are
+addressable by name and by numeric id (ingest pushes by id), with tablet
+support (``tablets_group.h``): a (table, tablet_id) pair maps to its own
+physical Table, and reads over the table see all tablets.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..types.relation import Relation
+from .table import DEFAULT_COMPACTED_ROWS, Table
+
+DEFAULT_TABLET = ""
+
+
+class TableStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> {tablet_id -> Table}
+        self._tables: dict[str, dict[str, Table]] = {}
+        self._ids: dict[int, str] = {}
+        self._names_to_ids: dict[str, int] = {}
+        self._next_id = 1
+
+    def add_table(
+        self,
+        name: str,
+        relation: Relation | None = None,
+        table_id: Optional[int] = None,
+        max_bytes: int = -1,
+        compacted_rows: int = DEFAULT_COMPACTED_ROWS,
+        tablet_id: str = DEFAULT_TABLET,
+    ) -> Table:
+        with self._lock:
+            base = next(iter(self._tables.get(name, {}).values()), None)
+            t = Table(
+                name,
+                relation,
+                max_bytes=max_bytes,
+                compacted_rows=compacted_rows,
+                dicts=base.dicts if base is not None else None,
+            )
+            self._tables.setdefault(name, {})[tablet_id] = t
+            if name not in self._names_to_ids:
+                tid = table_id if table_id is not None else self._next_id
+                self._next_id = max(self._next_id, tid) + 1
+                self._ids[tid] = name
+                self._names_to_ids[name] = tid
+            return t
+
+    def get_table(self, name_or_id, tablet_id: str = DEFAULT_TABLET) -> Optional[Table]:
+        with self._lock:
+            name = (
+                self._ids.get(name_or_id) if isinstance(name_or_id, int) else name_or_id
+            )
+            if name is None:
+                return None
+            return self._tables.get(name, {}).get(tablet_id)
+
+    def get_table_id(self, name: str) -> Optional[int]:
+        with self._lock:
+            return self._names_to_ids.get(name)
+
+    def get_table_name(self, table_id: int) -> str:
+        with self._lock:
+            return self._ids.get(table_id, "")
+
+    def table_ids(self) -> list[int]:
+        with self._lock:
+            return sorted(self._ids)
+
+    def table_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._tables)
+
+    def tablets(self, name: str) -> list[Table]:
+        with self._lock:
+            return [t for _, t in sorted(self._tables.get(name, {}).items())]
+
+    def append_data(
+        self, name_or_id, data, tablet_id: str = DEFAULT_TABLET, time_cols=("time_",)
+    ):
+        """Ingest push target (table_store.h:152 AppendData). Creates the
+        tablet on first write; the table itself must already exist when
+        addressed by id."""
+        t = self.get_table(name_or_id, tablet_id)
+        if t is None:
+            with self._lock:
+                name = (
+                    self._ids.get(name_or_id)
+                    if isinstance(name_or_id, int)
+                    else name_or_id
+                )
+                if name is None:
+                    raise KeyError(f"no table with id {name_or_id}")
+                tablets = self._tables.setdefault(name, {})
+                if tablet_id not in tablets:
+                    # New tablets inherit the base tablet's schema, byte
+                    # budget, and (shared) string dictionaries so every
+                    # tablet encodes into one id space.
+                    base = next(iter(tablets.values()), None)
+                    tablets[tablet_id] = Table(
+                        name,
+                        base.relation if base is not None else None,
+                        max_bytes=base.max_bytes if base is not None else -1,
+                        compacted_rows=(
+                            base.compacted_rows
+                            if base is not None
+                            else DEFAULT_COMPACTED_ROWS
+                        ),
+                        dicts=base.dicts if base is not None else None,
+                    )
+                if name not in self._names_to_ids:
+                    self._names_to_ids[name] = self._next_id
+                    self._ids[self._next_id] = name
+                    self._next_id += 1
+                t = tablets[tablet_id]
+        return t.append(data, time_cols=time_cols)
+
+    def compact_all(self) -> int:
+        """One compaction pass over every tablet (the background
+        compaction-thread body; reference runs this off a timer)."""
+        n = 0
+        for tablets in list(self._tables.values()):
+            for t in list(tablets.values()):
+                n += t.compact()
+        return n
+
+    def relation(self, name: str) -> Optional[Relation]:
+        tablets = self._tables.get(name)
+        if not tablets:
+            return None
+        return next(iter(tablets.values())).relation

@@ -69,7 +69,7 @@ class TestMapFilter:
         flt = p.add(FilterOp(f("greaterThanEqual", C("resp_status"), lit(400))), [src])
         p.add(ResultSinkOp("output"), [flt])
         out = run(engine, p).to_pydict()
-        table = engine.tables["http_events"].batches[0]
+        table = engine.tables["http_events"].read_all()
         expected = int((table.cols["resp_status"][0] >= 400).sum())
         assert len(out["resp_status"]) == expected
         assert set(np.unique(out["resp_status"])) <= {404, 500}
@@ -89,7 +89,7 @@ class TestMapFilter:
         p.add(ResultSinkOp("output"), [m])
         out = run(engine, p)
         assert out.relation.column_names == ("service", "latency_ms")
-        table = engine.tables["http_events"].batches[0]
+        table = engine.tables["http_events"].read_all()
         np.testing.assert_allclose(
             out.cols["latency_ms"][0][:100],
             table.cols["latency_ns"][0][:100] / 1e6,
@@ -146,7 +146,7 @@ class TestMapFilter:
 
 class TestAgg:
     def _truth(self, engine):
-        t = engine.tables["http_events"].batches[0]
+        t = engine.tables["http_events"].read_all()
         svc = t.dicts["service"].decode(t.cols["service"][0])
         lat = t.cols["latency_ns"][0]
         status = t.cols["resp_status"][0]
@@ -179,7 +179,7 @@ class TestAgg:
         """Cross-window regroup: tiny windows must agree with one window."""
         small = Engine(window_rows=256)
         big = Engine(window_rows=1 << 15)
-        t = engine.tables["http_events"].batches[0]
+        t = engine.tables["http_events"].read_all()
         for e in (small, big):
             e.append_data("http_events", t.to_pydict())
 
@@ -300,7 +300,7 @@ class TestJoinUnion:
         out = run(engine, p).to_pydict()
         assert len(out["service"]) == 7
         assert set(out) == {"service", "n", "total"}
-        svc = engine.tables["http_events"].batches[0]
+        svc = engine.tables["http_events"].read_all()
         dec = svc.dicts["service"].decode(svc.cols["service"][0])
         lat = svc.cols["latency_ns"][0]
         got = dict(zip(out["service"], out["total"]))

@@ -1,0 +1,238 @@
+"""Table store tests: hot/cold, cursors, expiry, compaction, tablets.
+
+Mirrors the reference's table tests (``src/table_store/table/table_test.cc``
+scenarios: write/read round trip, cursor stability across compaction,
+expiry ordering, time-bounded reads).
+"""
+
+import numpy as np
+import pytest
+
+from pixie_tpu.table_store import StartSpec, StopSpec, Table, TableStore
+from pixie_tpu.table_store.table import _PyBackend
+from pixie_tpu.types.dtypes import DataType
+from pixie_tpu.types.relation import Relation
+
+REL = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("latency", DataType.INT64),
+        ("service", DataType.STRING),
+    ]
+)
+
+
+def _batch(t0, n, svc="a"):
+    return {
+        "time_": np.arange(t0, t0 + n, dtype=np.int64),
+        "latency": np.arange(n, dtype=np.int64),
+        "service": [svc] * n,
+    }
+
+
+def _mk(max_bytes=-1, compacted_rows=1 << 16) -> Table:
+    return Table("t", REL, max_bytes=max_bytes, compacted_rows=compacted_rows)
+
+
+class TestTable:
+    def test_round_trip(self):
+        t = _mk()
+        t.append(_batch(0, 100))
+        t.append(_batch(100, 50, svc="b"))
+        hb = t.read_all()
+        assert hb.length == 150
+        d = hb.to_pydict()
+        assert d["time_"][0] == 0 and d["time_"][-1] == 149
+        assert d["service"][0] == "a" and d["service"][-1] == "b"
+        assert t.num_rows == 150
+
+    def test_scan_time_bounds(self):
+        t = _mk()
+        t.append(_batch(0, 100))
+        got = list(t.scan(start_time=10, stop_time=20))
+        total = sum(b.length for b in got)
+        assert total == 10
+        times = np.concatenate([b.cols["time_"][0] for b in got])
+        assert times.min() == 10 and times.max() == 19
+
+    def test_cursor_stable_across_compaction(self):
+        t = _mk(compacted_rows=64)
+        for i in range(8):
+            t.append(_batch(i * 32, 32))
+        cur = t.cursor(StartSpec(), StopSpec.current_end())
+        first = cur.next_batch(100)
+        assert first.length == 100
+        t.compact()  # moves everything hot -> cold mid-read
+        rest = []
+        while not cur.done():
+            b = cur.next_batch(100)
+            if b is None:
+                break
+            rest.append(b)
+        total = first.length + sum(b.length for b in rest)
+        assert total == 256
+        all_times = np.concatenate(
+            [first.cols["time_"][0]] + [b.cols["time_"][0] for b in rest]
+        )
+        assert np.array_equal(all_times, np.arange(256))
+
+    def test_infinite_cursor_sees_new_data(self):
+        t = _mk()
+        t.append(_batch(0, 10))
+        cur = t.cursor(stop=StopSpec.never())
+        assert cur.next_batch(100).length == 10
+        assert not cur.done()
+        assert cur.next_batch(100) is None  # dry, but not done
+        t.append(_batch(10, 5))
+        assert cur.next_batch_ready()
+        assert cur.next_batch(100).length == 5
+
+    def test_expiry_drops_oldest(self):
+        row_bytes = 8 + 8 + 4  # time + latency + service id
+        t = _mk(max_bytes=100 * row_bytes)
+        t.append(_batch(0, 60))
+        t.append(_batch(60, 60))  # exceeds budget -> first batch expires
+        st = t.stats()
+        assert st.batches_expired == 1
+        hb = t.read_all()
+        assert hb.length == 60
+        assert hb.cols["time_"][0][0] == 60
+
+    def test_cursor_skips_expired(self):
+        row_bytes = 20
+        t = _mk(max_bytes=100 * row_bytes)
+        cur = t.cursor(stop=StopSpec.never())
+        t.append(_batch(0, 60))
+        t.append(_batch(60, 60))  # expires rows [0, 60)
+        b = cur.next_batch(1000)
+        assert b.cols["time_"][0][0] == 60  # resumed at first live row
+
+    def test_compaction_stats(self):
+        t = _mk(compacted_rows=128)
+        for i in range(4):
+            t.append(_batch(i * 100, 100))
+        created = t.compact()
+        st = t.stats()
+        assert created == st.compacted_batches == created
+        assert st.hot_bytes == 0 and st.cold_bytes > 0
+        assert t.read_all().length == 400
+
+    def test_start_at_time(self):
+        t = _mk()
+        t.append(_batch(0, 100))
+        cur = t.cursor(StartSpec.at_time(42), StopSpec.at_time(50))
+        b = cur.next_batch(1000)
+        times = b.cols["time_"][0]
+        assert times[0] == 42 and times[-1] == 50
+        assert cur.done()
+
+    def test_dict_merge_on_foreign_append(self):
+        from pixie_tpu.types.batch import HostBatch
+
+        t = _mk()
+        t.append(_batch(0, 3, svc="a"))
+        foreign = HostBatch.from_pydict(_batch(3, 3, svc="zzz"), relation=REL)
+        t.append(foreign)
+        d = t.read_all().to_pydict()
+        assert list(d["service"]) == ["a"] * 3 + ["zzz"] * 3
+
+    def test_py_backend_parity(self, monkeypatch):
+        import pixie_tpu.table_store.table as tbl
+
+        monkeypatch.setattr(tbl, "load_native", lambda name: None)
+        t = _mk(compacted_rows=64)
+        assert isinstance(t._backend, _PyBackend)
+        for i in range(4):
+            t.append(_batch(i * 50, 50))
+        t.compact()
+        assert t.read_all().length == 200
+        got = list(t.scan(start_time=25, stop_time=75))
+        assert sum(b.length for b in got) == 50
+
+
+class TestReviewRegressions:
+    def test_cursor_never_passes_stop_after_expiry(self):
+        row_bytes = 20
+        t = _mk(max_bytes=100 * row_bytes)
+        t.append(_batch(0, 100))
+        cur = t.cursor(StartSpec(), StopSpec.current_end())  # stop at row 100
+        t.append(_batch(100, 100))  # expires rows [0, 100)
+        assert cur.next_batch(1000) is None
+        assert cur.done()
+
+    def test_append_does_not_mutate_caller_batch(self):
+        from pixie_tpu.types.batch import HostBatch
+
+        t = _mk()
+        t.append(_batch(0, 2, svc="a"))
+        hb = HostBatch.from_pydict(_batch(2, 2, svc="y"), relation=REL)
+        t.append(hb)
+        assert list(hb.dicts["service"].decode(hb.cols["service"][0])) == ["y", "y"]
+
+    def test_zero_row_append(self, monkeypatch):
+        import pixie_tpu.table_store.table as tbl
+
+        for native in (True, False):
+            if not native:
+                monkeypatch.setattr(tbl, "load_native", lambda name: None)
+            t = _mk()
+            t.append({"time_": [], "latency": [], "service": []})
+            assert t.num_rows == 0
+            t.append(_batch(0, 3))
+            assert t.num_rows == 3
+
+
+class TestTableStore:
+    def test_query_sees_all_tablets(self):
+        from pixie_tpu.exec import Engine
+
+        e = Engine()
+        e.create_table("t", REL)
+        e.table_store.append_data("t", _batch(0, 5), tablet_id="tab1")
+        e.table_store.append_data("t", _batch(5, 7, svc="b"), tablet_id="tab2")
+        out = e.execute_query(
+            "import px\n"
+            "df = px.DataFrame(table='t')\n"
+            "df = df.groupby('service').agg(n=('latency', px.count))\n"
+            "px.display(df, 'o')\n"
+        )
+        d = out["o"].to_pydict()
+        assert sorted(zip(d["service"], (int(x) for x in d["n"]))) == [
+            ("a", 5),
+            ("b", 7),
+        ]
+
+    def test_tablet_inherits_budget_and_dicts(self):
+        ts = TableStore()
+        ts.add_table("cap", REL, max_bytes=12345, compacted_rows=99)
+        ts.append_data("cap", _batch(0, 2), tablet_id="x")
+        tab = ts.get_table("cap", "x")
+        assert tab.max_bytes == 12345 and tab.compacted_rows == 99
+        assert tab.dicts["service"] is ts.get_table("cap").dicts["service"]
+
+    def test_name_and_id_addressing(self):
+        ts = TableStore()
+        ts.add_table("http_events", REL, table_id=7)
+        assert ts.get_table_id("http_events") == 7
+        assert ts.get_table_name(7) == "http_events"
+        ts.append_data(7, _batch(0, 10))
+        assert ts.get_table("http_events").num_rows == 10
+
+    def test_tablets(self):
+        ts = TableStore()
+        ts.add_table("t", REL)
+        ts.append_data("t", _batch(0, 5), tablet_id="tablet-1")
+        ts.append_data("t", _batch(5, 7), tablet_id="tablet-2")
+        tablets = ts.tablets("t")
+        assert [t.num_rows for t in tablets] == [0, 5, 7]
+
+    def test_append_unknown_id_raises(self):
+        ts = TableStore()
+        with pytest.raises(KeyError):
+            ts.append_data(99, _batch(0, 1))
+
+    def test_compact_all(self):
+        ts = TableStore()
+        ts.add_table("a", REL)
+        ts.append_data("a", _batch(0, 10))
+        assert ts.compact_all() >= 1
