@@ -106,6 +106,9 @@ class AggOp(Op):
     group_cols: tuple  # tuple[str]
     aggs: tuple  # tuple[AggExpr]
     max_groups: int = 4096
+    # 'full' (single-fragment), 'partial' (emit mergeable carries — the
+    # PEM/prepare half), 'finalize' (merge carries — the Kelvin half).
+    mode: str = "full"
 
 
 @dataclass(frozen=True)
@@ -135,6 +138,24 @@ class LimitOp(Op):
 class UnionOp(Op):
     """Concatenate inputs with identical schemas (k-way, time-ordered at
     materialization). Reference: ``src/carnot/exec/union_node.h``."""
+
+
+@dataclass(frozen=True)
+class BridgeSinkOp(Op):
+    """End of a per-agent fragment: hand the fragment's output to a
+    cross-fragment bridge. GRPCSinkNode analog
+    (``src/carnot/exec/grpc_sink_node.h:54``); on TPU the bridge is an XLA
+    collective over the mesh, not a gRPC stream (SURVEY.md §2.7)."""
+
+    bridge_id: int
+
+
+@dataclass(frozen=True)
+class BridgeSourceOp(Op):
+    """Start of a merge fragment: consume a bridge's output.
+    GRPCSourceNode analog (``src/carnot/exec/grpc_source_node.h``)."""
+
+    bridge_id: int
 
 
 @dataclass(frozen=True)

@@ -96,10 +96,55 @@ class DistributedEngine(Engine):
 
     def __init__(self, registry=None, window_rows: int = 1 << 17,
                  mesh: Mesh | None = None, n_agents: int | None = None,
-                 n_kelvin: int = 1):
+                 n_kelvin: int = 1, distributed_state=None):
         super().__init__(registry=registry, window_rows=window_rows)
         self.mesh = mesh if mesh is not None else agent_mesh(n_agents, n_kelvin)
         self.n_devices = int(np.prod(self.mesh.devices.shape))
+        self.distributed_state = distributed_state
+        self.last_distributed_plan = None
+
+    def execute_plan(self, plan):
+        """Replan against the live agent set before executing (the
+        reference pulls DistributedState fresh per query —
+        ``query_executor.go:415``).
+
+        The DistributedPlan drives execution: when the coordinator prunes
+        agents, the query runs on a *degraded mesh* whose ``agents`` axis
+        is the surviving shard count (the reference's pruned per-agent
+        plan), and bridges are stitched against that executing mesh.
+        """
+        if self.distributed_state is None:
+            return super().execute_plan(plan)
+
+        from ..exec.engine import QueryError
+        from ..planner.distributed import DistributedPlanner
+        from ..planner.distributed.coordinator import PlanningError
+
+        planner = DistributedPlanner()
+        try:
+            split = planner.splitter.split(plan)
+            dplan = planner.coordinator.assign(split, self.distributed_state)
+        except PlanningError as e:
+            raise QueryError(str(e)) from e
+
+        n_kelvin = self.mesh.devices.shape[0]  # (kelvin, agents) layout
+        max_agents = self.mesh.devices.size // n_kelvin
+        n_shards = min(dplan.n_data_shards or max_agents, max_agents)
+        if n_shards < max_agents:
+            mesh = agent_mesh(
+                n_shards, n_kelvin, devices=self.mesh.devices.flatten()
+            )
+        else:
+            mesh = self.mesh
+        planner.stitch(dplan, self.distributed_state, mesh=mesh)
+        self.last_distributed_plan = dplan
+
+        saved = (self.mesh, self.n_devices)
+        self.mesh, self.n_devices = mesh, int(np.prod(mesh.devices.shape))
+        try:
+            return super().execute_plan(plan)
+        finally:
+            self.mesh, self.n_devices = saved
 
     def _window_capacity(self, length: int) -> int:
         cap = super()._window_capacity(length)

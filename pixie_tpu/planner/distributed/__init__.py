@@ -1,0 +1,27 @@
+"""Distributed planner: logical plan -> per-agent fragments + collectives.
+
+Reference parity: ``src/carnot/planner/distributed/`` — Splitter cuts the
+plan at blocking operators, the partial-op manager splits aggregates and
+limits into prepare/merge halves, the Coordinator assigns fragments to
+live agents (pruning sources no agent can serve), and the Stitcher wires
+the cross-fragment bridges. In the TPU build the PEM tier is the device
+mesh's ``agents`` axis and every GRPC bridge becomes an XLA collective
+over ICI, chosen by pattern (partial-agg state merge, row gather).
+"""
+
+from .coordinator import Coordinator, DistributedPlan, prune_unavailable_sources
+from .distributed_state import AgentInfo, DistributedState
+from .planner import DistributedPlanner
+from .splitter import BlockingSplitPlan, BridgeSpec, Splitter
+
+__all__ = [
+    "AgentInfo",
+    "BlockingSplitPlan",
+    "BridgeSpec",
+    "Coordinator",
+    "DistributedPlan",
+    "DistributedPlanner",
+    "DistributedState",
+    "Splitter",
+    "prune_unavailable_sources",
+]

@@ -1,0 +1,49 @@
+"""DistributedPlanner: split -> coordinate -> stitch.
+
+Reference parity: ``planner/distributed/distributed_planner.h:66``
+(DistributedPlanner::Plan) and the stitcher rules
+(``distributed_stitcher_rules.h``) that wire each GRPCSink's destination
+address to its GRPCSource. Here stitching assigns each bridge the mesh
+axes its collective runs over: the ``agents`` axis within a slice (ICI),
+plus the ``kelvin`` axis when a second reduction tier exists.
+"""
+
+from __future__ import annotations
+
+from ...exec.plan import Plan
+from ...parallel.mesh import AGENTS, KELVIN
+from .coordinator import Coordinator, DistributedPlan
+from .distributed_state import DistributedState
+from .splitter import Splitter
+
+
+class DistributedPlanner:
+    """Combines splitter + coordinator + stitcher (logical_planner.h:40
+    drives this from the query broker's compile path)."""
+
+    def __init__(self):
+        self.splitter = Splitter()
+        self.coordinator = Coordinator()
+
+    def plan(
+        self, logical_plan: Plan, state: DistributedState, mesh=None
+    ) -> DistributedPlan:
+        split = self.splitter.split(logical_plan)
+        dplan = self.coordinator.assign(split, state)
+        self.stitch(dplan, state, mesh=mesh)
+        return dplan
+
+    def stitch(self, dplan: DistributedPlan, state: DistributedState, mesh=None) -> None:
+        """Wire bridges to the mesh axes implementing them.
+
+        When the executing ``mesh`` is known it is authoritative (a bridge
+        folds over exactly the axes the mesh has, size>1); without one
+        (planning-only use) axes are derived from the agent state.
+        """
+        if mesh is not None:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            axes = (AGENTS,) + ((KELVIN,) if sizes.get(KELVIN, 1) > 1 else ())
+        else:
+            axes = (AGENTS,) + ((KELVIN,) if len(state.kelvins) > 1 else ())
+        for b in dplan.split.bridges:
+            b.axes = axes

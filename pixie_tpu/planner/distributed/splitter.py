@@ -1,0 +1,130 @@
+"""Splitter: cut a logical plan at blocking operators.
+
+Reference parity: ``planner/distributed/splitter/splitter.h:75`` — the
+plan is partitioned into ``before_blocking`` (runs on every data agent,
+ends in bridge sinks, contains no blocking nodes) and ``after_blocking``
+(runs on the merge tier, fed by bridge sources, holds the blocking nodes
+and everything downstream). The partial-op manager
+(``splitter/partial_op_mgr/partial_op_mgr.h``) splits aggregates into a
+prepare (partial, mergeable-carry) half and a merge (finalize) half, and
+limits into local + global caps.
+
+TPU mapping: each bridge records the collective that implements it —
+``agg_state_merge`` (per-device UDA carries folded over the mesh axis;
+the reference's UDA Serialize/DeSerialize path, ``udf.h:99-100``) or
+``row_gather`` (all_gather of surviving rows; the reference's plain
+GRPCSink row stream).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from ...exec.plan import (
+    AggOp,
+    BridgeSinkOp,
+    BridgeSourceOp,
+    JoinOp,
+    LimitOp,
+    MemorySourceOp,
+    Op,
+    Plan,
+    ResultSinkOp,
+    UnionOp,
+)
+
+AGG_STATE_MERGE = "agg_state_merge"
+ROW_GATHER = "row_gather"
+
+
+@dataclass
+class BridgeSpec:
+    """One PEM->Kelvin bridge (GRPCSink/Source pair analog)."""
+
+    bridge_id: int
+    kind: str  # AGG_STATE_MERGE | ROW_GATHER
+    # Filled by the stitcher (distributed_stitcher_rules.h analog):
+    # mesh axes the implementing collective reduces/gathers over.
+    axes: tuple = ()
+
+
+@dataclass
+class BlockingSplitPlan:
+    """splitter.h BlockingSplitPlan analog."""
+
+    before_blocking: Plan
+    after_blocking: Plan
+    bridges: list = field(default_factory=list)  # list[BridgeSpec]
+
+    def bridge(self, bridge_id: int) -> BridgeSpec:
+        return next(b for b in self.bridges if b.bridge_id == bridge_id)
+
+
+def _is_blocking(op: Op) -> bool:
+    """Blocking = cannot run shard-local without a cross-agent exchange."""
+    return isinstance(op, (AggOp, JoinOp, UnionOp, LimitOp, ResultSinkOp))
+
+
+class Splitter:
+    """Splits one logical plan. Stateless; per-query use."""
+
+    def split(self, plan: Plan) -> BlockingSplitPlan:
+        before, after = Plan(), Plan()
+        bridges: list[BridgeSpec] = []
+        # logical node id -> ('pem', new_id) | ('kelvin', new_id)
+        placed: dict[int, tuple[str, int]] = {}
+
+        def to_kelvin(nid: int) -> int:
+            """Id of nid's output within after_blocking, bridging if the
+            producer ran on the PEM side."""
+            side, new_id = placed[nid]
+            if side == "kelvin":
+                return new_id
+            bid = len(bridges)
+            node = plan.nodes[nid]
+            before.add(BridgeSinkOp(bid), [new_id])
+            src = after.add(BridgeSourceOp(bid))
+            if isinstance(node.op, AggOp):
+                # Partial-op manager (AggOperatorMgr): the PEM half is a
+                # partial agg, the bridge ships mergeable carries, and an
+                # explicit finalize agg runs on the merge side.
+                bridges.append(BridgeSpec(bid, AGG_STATE_MERGE))
+                src = after.add(replace(node.op, mode="finalize"), [src])
+            else:
+                bridges.append(BridgeSpec(bid, ROW_GATHER))
+            placed[nid] = ("kelvin", src)
+            return src
+
+        for nid in plan.topo_order():
+            node = plan.nodes[nid]
+            op = node.op
+            inputs_kelvin = any(placed[i][0] == "kelvin" for i in node.inputs)
+            if isinstance(op, MemorySourceOp):
+                placed[nid] = ("pem", before.add(op))
+            elif isinstance(op, AggOp) and not inputs_kelvin:
+                # Split: prepare (partial) stays on the PEM side; when the
+                # result is consumed downstream it bridges as a carry
+                # merge and the consumer reads finalized output.
+                new_id = before.add(replace(op, mode="partial"), [
+                    placed[i][1] for i in node.inputs
+                ])
+                placed[nid] = ("pem", new_id)
+                to_kelvin(nid)  # aggs always bridge (their output is global)
+            elif isinstance(op, LimitOp) and not inputs_kelvin:
+                # LimitOperatorMgr: local cap on each agent, global cap
+                # after the gather.
+                local = before.add(op, [placed[i][1] for i in node.inputs])
+                placed[nid] = ("pem", local)
+                src = to_kelvin(nid)
+                placed[nid] = ("kelvin", after.add(op, [src]))
+            elif _is_blocking(op) or inputs_kelvin:
+                placed[nid] = (
+                    "kelvin",
+                    after.add(op, [to_kelvin(i) for i in node.inputs]),
+                )
+            else:  # Map/Filter fed only by PEM-side nodes
+                placed[nid] = (
+                    "pem",
+                    before.add(op, [placed[i][1] for i in node.inputs]),
+                )
+        return BlockingSplitPlan(before, after, bridges)

@@ -1,0 +1,298 @@
+"""Distributed planner tests: splitter, partial ops, coordinator, stitcher.
+
+Mirrors the reference's no-process planner tests
+(``planner/distributed/distributed_planner_test.cc``,
+``coordinator/coordinator_test.cc``): build synthetic DistributedStates
+with fake agents and assert on the produced plan structure.
+"""
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec.plan import (
+    AggExpr,
+    AggOp,
+    BridgeSinkOp,
+    BridgeSourceOp,
+    ColumnRef,
+    FilterOp,
+    FuncCall,
+    JoinOp,
+    LimitOp,
+    Literal,
+    MapOp,
+    MemorySourceOp,
+    Plan,
+    ResultSinkOp,
+)
+from pixie_tpu.planner.distributed import (
+    AgentInfo,
+    DistributedPlanner,
+    DistributedState,
+    Splitter,
+)
+from pixie_tpu.planner.distributed.coordinator import PlanningError
+from pixie_tpu.planner.distributed.splitter import AGG_STATE_MERGE, ROW_GATHER
+from pixie_tpu.types.dtypes import DataType
+
+
+def _filter_agg_plan() -> Plan:
+    p = Plan()
+    src = p.add(MemorySourceOp(table="http_events"))
+    flt = p.add(
+        FilterOp(
+            FuncCall(
+                "greaterThanEqual",
+                (ColumnRef("resp_status"), Literal(400, DataType.INT64)),
+            )
+        ),
+        [src],
+    )
+    agg = p.add(
+        AggOp(
+            group_cols=("service",),
+            aggs=(AggExpr("n", "count", (ColumnRef("resp_status"),)),),
+        ),
+        [flt],
+    )
+    p.add(ResultSinkOp("out"), [agg])
+    return p
+
+
+def _ops(plan: Plan):
+    return [type(plan.nodes[n].op).__name__ for n in plan.topo_order()]
+
+
+class TestSplitter:
+    def test_agg_splits_partial_finalize(self):
+        split = Splitter().split(_filter_agg_plan())
+        assert _ops(split.before_blocking) == [
+            "MemorySourceOp",
+            "FilterOp",
+            "AggOp",
+            "BridgeSinkOp",
+        ]
+        pem_agg = next(
+            n.op
+            for n in split.before_blocking.nodes.values()
+            if isinstance(n.op, AggOp)
+        )
+        assert pem_agg.mode == "partial"
+        kelvin_ops = _ops(split.after_blocking)
+        assert kelvin_ops == ["BridgeSourceOp", "AggOp", "ResultSinkOp"]
+        kelvin_agg = next(
+            n.op
+            for n in split.after_blocking.nodes.values()
+            if isinstance(n.op, AggOp)
+        )
+        assert kelvin_agg.mode == "finalize"
+        assert [b.kind for b in split.bridges] == [AGG_STATE_MERGE]
+
+    def test_rows_bridge_for_plain_sink(self):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="t"))
+        flt = p.add(
+            FilterOp(
+                FuncCall(
+                    "equal", (ColumnRef("a"), Literal(1, DataType.INT64))
+                )
+            ),
+            [src],
+        )
+        p.add(ResultSinkOp("out"), [flt])
+        split = Splitter().split(p)
+        assert [b.kind for b in split.bridges] == [ROW_GATHER]
+        assert _ops(split.before_blocking) == [
+            "MemorySourceOp",
+            "FilterOp",
+            "BridgeSinkOp",
+        ]
+
+    def test_limit_local_and_global(self):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="t"))
+        lim = p.add(LimitOp(10), [src])
+        p.add(ResultSinkOp("out"), [lim])
+        split = Splitter().split(p)
+        pem_limits = [
+            n.op
+            for n in split.before_blocking.nodes.values()
+            if isinstance(n.op, LimitOp)
+        ]
+        kelvin_limits = [
+            n.op
+            for n in split.after_blocking.nodes.values()
+            if isinstance(n.op, LimitOp)
+        ]
+        assert len(pem_limits) == 1 and len(kelvin_limits) == 1
+
+    def test_join_of_two_aggs_runs_on_kelvin(self):
+        p = Plan()
+        s1 = p.add(MemorySourceOp(table="t"))
+        a1 = p.add(
+            AggOp(("k",), (AggExpr("n", "count", (ColumnRef("k"),)),)), [s1]
+        )
+        s2 = p.add(MemorySourceOp(table="t"))
+        a2 = p.add(
+            AggOp(("k",), (AggExpr("m", "count", (ColumnRef("k"),)),)), [s2]
+        )
+        j = p.add(JoinOp(("k",), ("k",)), [a1, a2])
+        p.add(ResultSinkOp("out"), [j])
+        split = Splitter().split(p)
+        assert [b.kind for b in split.bridges] == [AGG_STATE_MERGE] * 2
+        kelvin_types = {
+            type(n.op).__name__ for n in split.after_blocking.nodes.values()
+        }
+        assert "JoinOp" in kelvin_types
+        pem_types = {
+            type(n.op).__name__ for n in split.before_blocking.nodes.values()
+        }
+        assert "JoinOp" not in pem_types
+
+    def test_map_after_agg_is_kelvin_side(self):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="t"))
+        agg = p.add(
+            AggOp(("k",), (AggExpr("n", "count", (ColumnRef("k"),)),)), [src]
+        )
+        m = p.add(MapOp(exprs=(("n2", ColumnRef("n")),)), [agg])
+        p.add(ResultSinkOp("out"), [m])
+        split = Splitter().split(p)
+        assert "MapOp" in {
+            type(n.op).__name__ for n in split.after_blocking.nodes.values()
+        }
+
+
+class TestCoordinator:
+    def test_prunes_agents_without_table(self):
+        state = DistributedState(
+            agents=[
+                AgentInfo("pem-0", tables=frozenset({"http_events"})),
+                AgentInfo("pem-1", tables=frozenset({"other"})),
+                AgentInfo(
+                    "kelvin-0", processes_data=False, accepts_remote_sources=True
+                ),
+            ]
+        )
+        dplan = DistributedPlanner().plan(_filter_agg_plan(), state)
+        assert dplan.data_agent_ids == ("pem-0",)
+        assert dplan.pruned_agent_ids == ("pem-1",)
+        assert dplan.kelvin_agent_ids == ("kelvin-0",)
+
+    def test_no_agent_has_table_raises(self):
+        state = DistributedState(
+            agents=[AgentInfo("pem-0", tables=frozenset({"other"}))]
+        )
+        with pytest.raises(PlanningError):
+            DistributedPlanner().plan(_filter_agg_plan(), state)
+
+    def test_kelvinless_degrades_to_data_agent(self):
+        state = DistributedState(agents=[AgentInfo("pem-0")])
+        dplan = DistributedPlanner().plan(_filter_agg_plan(), state)
+        assert dplan.kelvin_agent_ids == ("pem-0",)
+
+    def test_cluster_covers_homogeneous_agents(self):
+        state = DistributedState.homogeneous(8, 1)
+        dplan = DistributedPlanner().plan(_filter_agg_plan(), state)
+        assert dplan.n_data_shards == 8
+        assert len(dplan.clusters) == 1  # one SPMD program
+
+
+class TestStitcher:
+    def test_bridges_get_mesh_axes(self):
+        dplan = DistributedPlanner().plan(
+            _filter_agg_plan(), DistributedState.homogeneous(8, 1)
+        )
+        assert all(b.axes == ("agents",) for b in dplan.split.bridges)
+
+    def test_two_kelvins_add_axis(self):
+        dplan = DistributedPlanner().plan(
+            _filter_agg_plan(), DistributedState.homogeneous(8, 2)
+        )
+        assert all(b.axes == ("agents", "kelvin") for b in dplan.split.bridges)
+
+
+class TestDistributedEngineReplan:
+    def test_engine_replans_per_query(self):
+        from pixie_tpu.parallel.executor import DistributedEngine
+
+        state = DistributedState.homogeneous(8, 1)
+        e = DistributedEngine(n_agents=8, distributed_state=state)
+        rng = np.random.default_rng(0)
+        e.append_data(
+            "http_events",
+            {
+                "time_": np.arange(4096, dtype=np.int64),
+                "resp_status": rng.choice(np.array([200, 404]), 4096),
+                "service": [f"s{i % 3}" for i in range(4096)],
+            },
+        )
+        out = e.execute_query(
+            "import px\n"
+            "df = px.DataFrame(table='http_events')\n"
+            "df = df[df.resp_status >= 400]\n"
+            "df = df.groupby('service').agg(n=('resp_status', px.count))\n"
+            "px.display(df, 'o')\n"
+        )
+        assert e.last_distributed_plan is not None
+        assert e.last_distributed_plan.n_data_shards == 8
+        d = out["o"].to_pydict()
+        assert sum(d["n"]) == int(
+            (
+                e.tables["http_events"].read_all().cols["resp_status"][0] >= 400
+            ).sum()
+        )
+
+    def test_pruned_agents_degrade_the_mesh(self):
+        from pixie_tpu.parallel.executor import DistributedEngine
+
+        # Only 4 of 8 agents hold the table: the query must execute on a
+        # 4-shard mesh matching the coordinator's pruning.
+        agents = [
+            AgentInfo(
+                f"pem-{i}",
+                tables=frozenset({"http_events"} if i < 4 else {"other"}),
+            )
+            for i in range(8)
+        ]
+        agents.append(
+            AgentInfo("kelvin-0", processes_data=False, accepts_remote_sources=True)
+        )
+        state = DistributedState(agents=agents)
+        e = DistributedEngine(n_agents=8, distributed_state=state)
+        rng = np.random.default_rng(1)
+        e.append_data(
+            "http_events",
+            {
+                "time_": np.arange(4096, dtype=np.int64),
+                "resp_status": rng.choice(np.array([200, 404]), 4096),
+                "service": [f"s{i % 3}" for i in range(4096)],
+            },
+        )
+        out = e.execute_query(
+            "import px\n"
+            "df = px.DataFrame(table='http_events')\n"
+            "df = df.groupby('service').agg(n=('resp_status', px.count))\n"
+            "px.display(df, 'o')\n"
+        )
+        dplan = e.last_distributed_plan
+        assert dplan.n_data_shards == 4
+        assert len(dplan.pruned_agent_ids) == 4
+        assert sum(out["o"].to_pydict()["n"]) == 4096
+        assert e.mesh.devices.size == 8  # engine mesh restored after query
+
+    def test_no_agent_for_table_raises_query_error(self):
+        from pixie_tpu.exec.engine import QueryError
+        from pixie_tpu.parallel.executor import DistributedEngine
+
+        state = DistributedState(
+            agents=[AgentInfo("pem-0", tables=frozenset({"other"}))]
+        )
+        e = DistributedEngine(n_agents=8, distributed_state=state)
+        e.append_data("http_events", {"time_": np.arange(4, dtype=np.int64)})
+        with pytest.raises(QueryError):
+            e.execute_query(
+                "import px\n"
+                "df = px.DataFrame(table='http_events')\n"
+                "px.display(df, 'o')\n"
+            )
