@@ -29,9 +29,11 @@ from ..types.dtypes import DataType, host_dtypes
 from ..types.relation import Relation
 from ..types.strings import NULL_ID, StringDictionary
 from ..udf.registry import Registry, default_registry
-from .fragment import compile_fragment
+from .fragment import ColumnMeta, compile_fragment
 from .plan import (
     AggOp,
+    BridgeSinkOp,
+    BridgeSourceOp,
     FilterOp,
     JoinOp,
     LimitOp,
@@ -41,6 +43,38 @@ from .plan import (
     ResultSinkOp,
     UnionOp,
 )
+
+
+@dataclass
+class AggStatePayload:
+    """Partial-agg state shipped across a bridge (agent mode).
+
+    The UDA ``Serialize``/``DeSerialize`` analog (``udf.h:99-100``): the
+    serialized form IS the carry pytree plus enough metadata for the
+    merge tier to recompile the identical fragment and realign string
+    dictionary ids. String-valued *carries* (e.g. ``any`` over a string
+    column) are not realigned — only group keys are; such UDAs need a
+    shared dictionary to cross agents.
+    """
+
+    chain: tuple  # fragment ops [pre..., AggOp]
+    input_relation: object  # Relation at fragment input
+    input_dicts: dict  # {col: StringDictionary} at fragment input
+    state: dict  # group-state pytree (numpy leaves)
+
+
+@dataclass
+class RowsPayload:
+    """Materialized rows shipped across a bridge (plain GRPCSink analog)."""
+
+    batch: HostBatch
+
+
+@dataclass
+class _PendingAggBridge:
+    """Agg-bridge payloads awaiting their finalize AggOp."""
+
+    payloads: list  # list[AggStatePayload]
 
 
 class QueryError(Exception):
@@ -179,9 +213,18 @@ class Engine:
         register_metadata_funcs(reg, state)
         self.registry = reg
 
-    def execute_plan(self, plan: Plan) -> dict:
+    def execute_plan(
+        self, plan: Plan, bridge_inputs: dict | None = None
+    ) -> dict:
+        """Execute a plan. Whole plans return {sink name: HostBatch}.
+
+        Split-fragment plans (from the distributed splitter, agent mode):
+        a plan ending in BridgeSinkOps additionally returns
+        {("bridge", id): payload}; a merge plan starting from
+        BridgeSourceOps reads ``bridge_inputs`` = {bridge id: [payloads]}.
+        """
         results: dict[int, object] = {}
-        outputs: dict[str, HostBatch] = {}
+        outputs: dict = {}
         consumers: dict[int, int] = {}
         for n in plan.nodes.values():
             for i in n.inputs:
@@ -214,7 +257,18 @@ class Engine:
                     base.relation, dict(base.dicts), chain, tablets, op
                 )
             elif isinstance(op, (MapOp, FilterOp, AggOp, LimitOp)):
-                st = self._as_stream(results[node.inputs[0]])
+                upstream = results[node.inputs[0]]
+                if isinstance(upstream, _PendingAggBridge):
+                    # The finalize half of a split aggregate: merge the
+                    # shipped partial states and finalize — the agent-mode
+                    # form of the bridge collective.
+                    if not (isinstance(op, AggOp) and op.mode == "finalize"):
+                        raise QueryError(
+                            "agg bridge must feed its finalize AggOp"
+                        )
+                    results[nid] = self._merge_agg_bridge(upstream)
+                    continue
+                st = self._as_stream(upstream)
                 if st.chain and isinstance(st.chain[-1], LimitOp):
                     # A limit terminates its fragment: apply the cap at its
                     # plan position, then keep chaining on the result.
@@ -237,12 +291,139 @@ class Engine:
                 results[nid] = _union_host(mats)
             elif isinstance(op, ResultSinkOp):
                 outputs[op.name] = mat_input(node.inputs[0])
+            elif isinstance(op, BridgeSinkOp):
+                outputs[("bridge", op.bridge_id)] = self._bridge_payload(
+                    results[node.inputs[0]]
+                )
+            elif isinstance(op, BridgeSourceOp):
+                if not bridge_inputs or op.bridge_id not in bridge_inputs:
+                    raise QueryError(f"no input for bridge {op.bridge_id}")
+                results[nid] = self._bind_bridge(bridge_inputs[op.bridge_id])
             else:
                 raise QueryError(f"unsupported operator {op}")
             # Fan-out of a stream: materialize once, share the batch.
             if consumers.get(nid, 0) > 1 and isinstance(results[nid], _Stream):
                 results[nid] = self._materialize(results[nid])
         return outputs
+
+    # -- bridge (agent-mode) machinery ----------------------------------------
+    def _fold_agg_state(self, stream: "_Stream", frag):
+        """Stream the source through the fragment's window fold, returning
+        the accumulated (unfinalized) group state."""
+        init_state, agg_step, _ = self._compile_steps(frag)
+        state = init_state()
+        for hb in self._windows(stream):
+            cols, valid = self._stage(hb, self._window_capacity(hb.length))
+            state = agg_step(state, cols, valid)
+        return state
+
+    def _bridge_payload(self, res):
+        """Produce a BridgeSink payload: partial-agg state for agg chains,
+        materialized rows otherwise (GRPCSinkNode's two modes)."""
+        if isinstance(res, _Stream) and any(
+            isinstance(o, AggOp) for o in res.chain
+        ):
+            import jax
+
+            frag = compile_fragment(
+                res.chain, res.relation, res.dicts, self.registry
+            )
+            state = self._fold_agg_state(res, frag)
+            return AggStatePayload(
+                chain=tuple(res.chain),
+                input_relation=res.relation,
+                input_dicts=dict(res.dicts),
+                state=jax.tree_util.tree_map(np.asarray, state),
+            )
+        return RowsPayload(batch=self._materialize(res))
+
+    def _bind_bridge(self, payloads):
+        payloads = payloads if isinstance(payloads, list) else [payloads]
+        if not payloads:
+            raise QueryError("bridge received no payloads")
+        if all(isinstance(p, RowsPayload) for p in payloads):
+            return _union_host([p.batch for p in payloads])
+        if all(isinstance(p, AggStatePayload) for p in payloads):
+            return _PendingAggBridge(payloads)
+        raise QueryError("mixed payload kinds on one bridge")
+
+    def _merge_agg_bridge(self, pending: _PendingAggBridge) -> HostBatch:
+        """Merge shipped partial-agg states and finalize.
+
+        The agent-mode replacement for the on-mesh collective: states from
+        k agents fold through the fragment's associative merge, after the
+        group-key string ids of every agent are remapped into one
+        canonical dictionary (the reference ships raw strings over GRPC,
+        so alignment is implicit there; here ids must be reconciled).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from .fragment import _bind_pre_stage, _split_chain
+
+        p0 = pending.payloads[0]
+        frag = compile_fragment(
+            list(p0.chain), p0.input_relation, dict(p0.input_dicts), self.registry
+        )
+        key_plane_index = frag.key_plane_index
+        group_rel = frag.group_relation
+        pre, _agg, _post, _limit = _split_chain(list(p0.chain))
+        # Per-agent post-pre-stage dictionaries for the group columns.
+        per_agent_dicts = []
+        for p in pending.payloads:
+            _, rel1_a, dicts1 = _bind_pre_stage(
+                list(pre), p.input_relation, dict(p.input_dicts), self.registry
+            )
+            if tuple(rel1_a.items()) != tuple(group_rel.items()):
+                raise QueryError(
+                    f"bridge schema mismatch: {rel1_a} vs {group_rel}"
+                )
+            per_agent_dicts.append(dicts1)
+        # Canonical dictionary + id remap per string group column.
+        canonical: dict[str, StringDictionary] = {}
+        states = []
+        for p, dicts1 in zip(pending.payloads, per_agent_dicts):
+            keys = list(p.state["keys"])
+            for pi, (c, i) in enumerate(key_plane_index):
+                if group_rel.col_type(c) != DataType.STRING or i != 0:
+                    continue
+                src = dicts1.get(c)
+                if src is None:
+                    continue
+                dst = canonical.setdefault(c, StringDictionary())
+                remap = np.fromiter(
+                    (dst.get_or_add(s) for s in src.strings),
+                    dtype=np.int32,
+                    count=len(src),
+                )
+                ids = np.asarray(keys[pi])
+                if len(remap) == 0:
+                    # Empty dictionary (agent had no rows): every slot is
+                    # already the null id — nothing to remap.
+                    keys[pi] = np.full_like(ids, NULL_ID, dtype=np.int32)
+                else:
+                    keys[pi] = np.where(
+                        ids >= 0, remap[np.clip(ids, 0, None)], NULL_ID
+                    ).astype(np.int32)
+            states.append({**p.state, "keys": tuple(keys)})
+        merge = jax.jit(frag.merge_states)
+        acc = jax.tree_util.tree_map(jnp.asarray, states[0])
+        for s in states[1:]:
+            acc = merge(acc, jax.tree_util.tree_map(jnp.asarray, s))
+        cols, valid, overflow = frag.finalize(acc)
+        if bool(overflow):
+            raise QueryError(
+                "group-by overflow merging bridge states; raise max_groups"
+            )
+        meta = [
+            (
+                ColumnMeta(m.name, m.dtype, dict=canonical[m.name])
+                if m.name in canonical
+                else m
+            )
+            for m in frag.out_meta
+        ]
+        return _to_host_batch(meta, cols, np.asarray(valid))
 
     # -- internals -----------------------------------------------------------
     def _as_stream(self, res) -> _Stream:
@@ -306,13 +487,10 @@ class Engine:
         frag = compile_fragment(
             stream.chain, stream.relation, stream.dicts, self.registry
         )
-        init_state, agg_step, rows_step = self._compile_steps(frag)
+        _, _, rows_step = self._compile_steps(frag)
 
         if frag.is_agg:
-            state = init_state()
-            for hb in self._windows(stream):
-                cols, valid = self._stage(hb, self._window_capacity(hb.length))
-                state = agg_step(state, cols, valid)
+            state = self._fold_agg_state(stream, frag)
             cols, valid, overflow = frag.finalize(state)
             if bool(overflow):
                 raise QueryError(

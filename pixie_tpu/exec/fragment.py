@@ -58,6 +58,11 @@ class CompiledFragment:
     window_state: object = None  # (cols, valid) -> per-window group state
     merge_states: object = None  # (state_a, state_b) -> merged state
     apply_rows: object = None  # (cols, valid) -> (cols, valid), non-agg chain
+    # (col, plane_i) per entry of state["keys"], and the post-pre-stage
+    # relation the group columns are typed against (agg only) — consumed by
+    # the agent-mode bridge merge to realign string key dictionaries.
+    key_plane_index: tuple = ()
+    group_relation: Relation = None
 
 
 def _bind_pre_stage(ops, relation, dicts, registry):
@@ -317,4 +322,6 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
         window_state=window_state,
         merge_states=merge_states,
         apply_rows=apply_pre,
+        key_plane_index=tuple(key_plane_index),
+        group_relation=rel1,
     )

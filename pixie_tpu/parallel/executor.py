@@ -103,7 +103,7 @@ class DistributedEngine(Engine):
         self.distributed_state = distributed_state
         self.last_distributed_plan = None
 
-    def execute_plan(self, plan):
+    def execute_plan(self, plan, bridge_inputs=None):
         """Replan against the live agent set before executing (the
         reference pulls DistributedState fresh per query —
         ``query_executor.go:415``).
@@ -114,7 +114,7 @@ class DistributedEngine(Engine):
         plan), and bridges are stitched against that executing mesh.
         """
         if self.distributed_state is None:
-            return super().execute_plan(plan)
+            return super().execute_plan(plan, bridge_inputs=bridge_inputs)
 
         from ..exec.engine import QueryError
         from ..planner.distributed import DistributedPlanner
@@ -142,7 +142,7 @@ class DistributedEngine(Engine):
         saved = (self.mesh, self.n_devices)
         self.mesh, self.n_devices = mesh, int(np.prod(mesh.devices.shape))
         try:
-            return super().execute_plan(plan)
+            return super().execute_plan(plan, bridge_inputs=bridge_inputs)
         finally:
             self.mesh, self.n_devices = saved
 

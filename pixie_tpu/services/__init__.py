@@ -1,0 +1,26 @@
+"""Service shell: agents, control plane, query brokering.
+
+Reference parity: ``src/vizier/services`` — the agent runtime (PEM/Kelvin
+managers over NATS, ``agent/manager/manager.h:102``), the metadata
+service's agent tracker (``controllers/agent/agent.go``), and the query
+broker (``query_broker/controllers/server.go``). The control plane here
+is an in-process message bus with NATS semantics (topics, fan-out,
+queued async delivery); the data plane passes payload objects in-process
+where the reference streams protobuf over gRPC.
+"""
+
+from .agent import Agent, KelvinAgent, PEMAgent
+from .msgbus import MessageBus
+from .query_broker import QueryBroker, QueryResultForwarder, QueryTimeout
+from .tracker import AgentTracker
+
+__all__ = [
+    "Agent",
+    "AgentTracker",
+    "KelvinAgent",
+    "MessageBus",
+    "PEMAgent",
+    "QueryBroker",
+    "QueryResultForwarder",
+    "QueryTimeout",
+]

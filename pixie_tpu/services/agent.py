@@ -1,0 +1,230 @@
+"""Agent runtime: PEM (data) and Kelvin (merge) agents over the bus.
+
+Reference parity: ``src/vizier/services/agent/manager/manager.h:102`` —
+an agent connects to the control plane, registers, heartbeats every 5s,
+and handles execute-query messages (``exec.h:38`` ->
+``Carnot::ExecutePlan``). A PEM owns a local engine + table store and
+runs data fragments; every agent can also host merge fragments (the
+Kelvin role, ``kelvin_manager.h:31``), receiving bridge payloads the way
+Kelvin's GRPCRouter receives ``TransferResultChunk`` streams
+(``grpc_router.h:53,159``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+
+from ..exec.engine import Engine, QueryError
+from .msgbus import MessageBus
+from .tracker import TOPIC_HEARTBEAT, TOPIC_REGISTER
+
+DEFAULT_HEARTBEAT_INTERVAL_S = 5.0
+
+
+class Agent:
+    """Base manager: registration, heartbeats, execute + bridge handlers."""
+
+    processes_data = True
+    accepts_remote_sources = False
+
+    def __init__(
+        self,
+        bus: MessageBus,
+        agent_id: str,
+        engine: Engine | None = None,
+        heartbeat_interval_s: float = DEFAULT_HEARTBEAT_INTERVAL_S,
+    ):
+        self.bus = bus
+        self.agent_id = agent_id
+        self.engine = engine or Engine()
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.asid = None
+        self._registered = threading.Event()
+        self._stop = threading.Event()
+        self._subs = []
+        self._lock = threading.Lock()
+        # qid -> {"expect": {(bridge_id, agent_id)}, "got": {bid: [payload]},
+        #         "plan": merge plan, "reply_to": topic}
+        self._pending_merges: dict = {}
+        # Bounded memory of cancelled query ids (late bridge chunks for a
+        # cancelled query must be dropped, not backlogged forever).
+        self._cancelled: "dict[str, None]" = {}
+        self._max_cancelled = 1024
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Agent":
+        a = self.agent_id
+        self._subs = [
+            self.bus.subscribe(f"agent.{a}.registered", self._on_registered),
+            self.bus.subscribe(f"agent.{a}.reregister", lambda m: self._register()),
+            self.bus.subscribe(f"agent.{a}.execute", self._on_execute),
+            self.bus.subscribe(f"agent.{a}.merge", self._on_merge),
+            self.bus.subscribe(f"agent.{a}.bridge", self._on_bridge),
+            self.bus.subscribe("query.cancel", self._on_cancel),
+        ]
+        self._register()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._hb_thread.start()
+        return self
+
+    def stop(self):
+        """Simulate agent death: no more heartbeats or message handling."""
+        self._stop.set()
+        for s in self._subs:
+            s.unsubscribe()
+        self._subs = []
+
+    def _register(self):
+        self.bus.publish(
+            TOPIC_REGISTER,
+            {
+                "agent_id": self.agent_id,
+                "processes_data": self.processes_data,
+                "accepts_remote_sources": self.accepts_remote_sources,
+                "schemas": self._schemas(),
+            },
+        )
+
+    def _on_registered(self, msg):
+        self.asid = msg["asid"]
+        self._registered.set()
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.heartbeat_interval_s):
+            self.bus.publish(
+                TOPIC_HEARTBEAT,
+                {"agent_id": self.agent_id, "schemas": self._schemas()},
+            )
+
+    def _schemas(self) -> dict:
+        return {
+            name: t.relation
+            for name, t in self.engine.tables.items()
+            if t is not None and len(t.relation)
+        }
+
+    # -- data push (Stirling's RegisterDataPushCallback target) --------------
+    def append_data(self, table: str, data, time_cols=("time_",)):
+        return self.engine.append_data(table, data, time_cols=time_cols)
+
+    # -- query execution -----------------------------------------------------
+    def _on_cancel(self, msg):
+        with self._lock:
+            self._cancelled[msg["qid"]] = None
+            while len(self._cancelled) > self._max_cancelled:
+                self._cancelled.pop(next(iter(self._cancelled)))
+            self._pending_merges.pop(msg["qid"], None)
+
+    def _on_execute(self, msg):
+        """Run a data fragment; ship bridge payloads to the merge agent."""
+        qid, plan = msg["qid"], msg["plan"]
+        if qid in self._cancelled:
+            return
+        try:
+            t0 = time.perf_counter()
+            outputs = self.engine.execute_plan(plan)
+            elapsed = time.perf_counter() - t0
+        except Exception as e:
+            self.bus.publish(
+                f"query.{qid}.results",
+                {"error": f"{self.agent_id}: {e}", "trace": traceback.format_exc()},
+            )
+            return
+        merge_agent = msg.get("merge_agent")
+        for key, val in outputs.items():
+            if isinstance(key, tuple) and key[0] == "bridge":
+                self.bus.publish(
+                    f"agent.{merge_agent}.bridge",
+                    {
+                        "qid": qid,
+                        "bridge_id": key[1],
+                        "from_agent": self.agent_id,
+                        "payload": val,
+                    },
+                )
+            else:  # whole plan executed locally (no split)
+                self.bus.publish(
+                    f"query.{qid}.results",
+                    {"table": key, "batch": val, "agent": self.agent_id},
+                )
+        self.bus.publish(
+            f"query.{qid}.agent_done",
+            {"agent": self.agent_id, "exec_time_s": elapsed},
+        )
+
+    def _on_merge(self, msg):
+        """Install a merge fragment; runs once all bridge payloads land."""
+        qid = msg["qid"]
+        if qid in self._cancelled:
+            return
+        with self._lock:
+            # Bridge payloads may already be backlogged for this query —
+            # merge the plan into the existing record, never replace it.
+            pm = self._pending_merges.setdefault(
+                qid, {"plan": None, "expect": None, "got": {}, "got_keys": set()}
+            )
+            pm["plan"] = msg["plan"]
+            pm["expect"] = {
+                (bid, aid)
+                for bid in msg["bridge_ids"]
+                for aid in msg["data_agents"]
+            }
+        self._maybe_finish_merge(qid)
+
+    def _on_bridge(self, msg):
+        qid = msg["qid"]
+        with self._lock:
+            if qid in self._cancelled:
+                return
+            pm = self._pending_merges.get(qid)
+            if pm is None:
+                # Bridge chunks can arrive before the merge plan (the
+                # GRPCRouter backlogs early TransferResultChunks).
+                pm = self._pending_merges.setdefault(
+                    qid, {"plan": None, "expect": None, "got": {}, "got_keys": set()}
+                )
+            pm["got"].setdefault(msg["bridge_id"], []).append(msg["payload"])
+            pm["got_keys"].add((msg["bridge_id"], msg["from_agent"]))
+        self._maybe_finish_merge(qid)
+
+    def _maybe_finish_merge(self, qid):
+        with self._lock:
+            pm = self._pending_merges.get(qid)
+            if (
+                pm is None
+                or pm["expect"] is None
+                or not pm["expect"] <= pm["got_keys"]
+            ):
+                return
+            del self._pending_merges[qid]
+        try:
+            outputs = self.engine.execute_plan(pm["plan"], bridge_inputs=pm["got"])
+        except Exception as e:
+            self.bus.publish(
+                f"query.{qid}.results",
+                {"error": f"{self.agent_id}: {e}", "trace": traceback.format_exc()},
+            )
+            return
+        for name, batch in outputs.items():
+            self.bus.publish(
+                f"query.{qid}.results",
+                {"table": name, "batch": batch, "agent": self.agent_id},
+            )
+        self.bus.publish(f"query.{qid}.results", {"eos": True})
+
+
+class PEMAgent(Agent):
+    """Per-node data agent: ingest push target + data fragments
+    (``pem_manager.h:39``)."""
+
+    processes_data = True
+    accepts_remote_sources = False
+
+
+class KelvinAgent(Agent):
+    """Compute-only merge agent (``kelvin_manager.h:31``)."""
+
+    processes_data = False
+    accepts_remote_sources = True

@@ -1,0 +1,86 @@
+"""In-process message bus with NATS pub/sub semantics.
+
+Reference parity: ``src/common/event/nats.h:36-60`` (C++ NATS connector)
+and the Go ``msgbus`` wrapper (``src/shared/services/msgbus``) — topics,
+fan-out to every subscriber, asynchronous delivery. Each subscription
+owns a queue + dispatcher thread so a slow handler never blocks
+publishers or sibling subscribers (NATS's per-subscription pending
+buffer). Swapping in a real NATS/gRPC transport means reimplementing
+this one class against sockets; everything above it is transport-blind.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+
+class Subscription:
+    def __init__(self, bus: "MessageBus", topic: str, fn: Callable):
+        self.bus = bus
+        self.topic = topic
+        self.fn = fn
+        self._q: queue.Queue = queue.Queue()
+        self._alive = True
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            msg = self._q.get()
+            if msg is _CLOSE:
+                return
+            try:
+                self.fn(msg)
+            except Exception as e:  # handler errors must not kill delivery
+                self.bus._on_handler_error(self.topic, e)
+
+    def _deliver(self, msg):
+        if self._alive:
+            self._q.put(msg)
+
+    def unsubscribe(self):
+        self._alive = False
+        self.bus._remove(self)
+        self._q.put(_CLOSE)
+
+
+_CLOSE = object()
+
+
+class MessageBus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: dict[str, list[Subscription]] = {}
+        self.handler_errors: list[tuple[str, Exception]] = []
+
+    def subscribe(self, topic: str, fn: Callable) -> Subscription:
+        sub = Subscription(self, topic, fn)
+        with self._lock:
+            self._subs.setdefault(topic, []).append(sub)
+        return sub
+
+    def publish(self, topic: str, msg: dict) -> int:
+        """Fan out to all subscribers; returns the number delivered to."""
+        with self._lock:
+            subs = list(self._subs.get(topic, []))
+        for s in subs:
+            s._deliver(msg)
+        return len(subs)
+
+    def _remove(self, sub: Subscription):
+        with self._lock:
+            lst = self._subs.get(sub.topic, [])
+            if sub in lst:
+                lst.remove(sub)
+
+    def _on_handler_error(self, topic: str, e: Exception):
+        with self._lock:
+            self.handler_errors.append((topic, e))
+
+    def close(self):
+        with self._lock:
+            subs = [s for lst in self._subs.values() for s in lst]
+        for s in subs:
+            s.unsubscribe()

@@ -1,0 +1,162 @@
+"""Query broker: compile, plan, dispatch, forward results.
+
+Reference parity: ``src/vizier/services/query_broker`` — ExecuteScript
+(``controllers/server.go:325``) compiles via the planner against the
+live agent set, LaunchQuery publishes per-agent plans over the control
+plane (``launch_query.go:36``), and a per-query QueryResultForwarder
+(``query_result_forwarder.go:108,241,364``) streams results to the
+client with producer/consumer watchdog timeouts and cancellation.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+
+from ..exec.engine import QueryError
+from ..planner import CompilerState, compile_pxl
+from ..planner.distributed import DistributedPlanner
+from ..planner.distributed.coordinator import PlanningError
+from ..udf.registry import Registry, default_registry
+from .msgbus import MessageBus
+from .tracker import AgentTracker
+
+
+class QueryTimeout(QueryError):
+    pass
+
+
+class QueryResultForwarder:
+    """Per-query result stream assembly with watchdog timeouts."""
+
+    def __init__(self, bus: MessageBus):
+        self.bus = bus
+        self._lock = threading.Lock()
+        self._active: dict[str, dict] = {}
+
+    def register_query(self, qid: str, expected_data_agents: int):
+        q: queue.Queue = queue.Queue()
+        sub = self.bus.subscribe(f"query.{qid}.results", q.put)
+        done_sub = self.bus.subscribe(f"query.{qid}.agent_done", q.put)
+        with self._lock:
+            self._active[qid] = {
+                "queue": q,
+                "subs": [sub, done_sub],
+                "expected": expected_data_agents,
+            }
+
+    def wait(self, qid: str, timeout_s: float) -> dict:
+        """Blocks until eos/error/timeout. Returns {table: HostBatch} plus
+        per-agent exec stats; raises on error or watchdog expiry."""
+        with self._lock:
+            st = self._active[qid]
+        outputs: dict = {}
+        stats: dict = {}
+        eos = False
+        try:
+            while True:
+                if eos and len(stats) >= st["expected"]:
+                    return {"tables": outputs, "agent_stats": stats}
+                # After eos, per-agent stats may still be in flight on
+                # their own dispatcher threads — drain with a short grace
+                # window instead of returning a partial stats map.
+                wait_s = min(timeout_s, 1.0) if eos else timeout_s
+                try:
+                    msg = st["queue"].get(timeout=wait_s)
+                except queue.Empty:
+                    if eos:
+                        return {"tables": outputs, "agent_stats": stats}
+                    # Watchdog fired (query_result_forwarder.go:241):
+                    # cancel the query everywhere and fail the stream.
+                    self.cancel(qid)
+                    raise QueryTimeout(
+                        f"query {qid} timed out after {timeout_s}s "
+                        f"(stats so far: {sorted(stats)})"
+                    ) from None
+                if "error" in msg:
+                    self.cancel(qid)
+                    raise QueryError(msg["error"])
+                if "exec_time_s" in msg:
+                    stats[msg["agent"]] = {"exec_time_s": msg["exec_time_s"]}
+                elif msg.get("eos"):
+                    eos = True
+                elif "table" in msg:
+                    outputs[msg["table"]] = msg["batch"]
+        finally:
+            self._deregister(qid)
+
+    def cancel(self, qid: str):
+        self.bus.publish("query.cancel", {"qid": qid})
+
+    def _deregister(self, qid: str):
+        with self._lock:
+            st = self._active.pop(qid, None)
+        if st:
+            for s in st["subs"]:
+                s.unsubscribe()
+
+
+class QueryBroker:
+    def __init__(
+        self,
+        bus: MessageBus,
+        tracker: AgentTracker,
+        registry: Registry | None = None,
+    ):
+        self.bus = bus
+        self.tracker = tracker
+        self.registry = registry or default_registry()
+        self.forwarder = QueryResultForwarder(bus)
+        self.planner = DistributedPlanner()
+
+    def execute_script(
+        self,
+        query: str,
+        timeout_s: float = 30.0,
+        now_ns: int = 0,
+        max_output_rows: int = 10_000,
+    ) -> dict:
+        """The VizierService.ExecuteScript flow, end to end."""
+        state = self.tracker.distributed_state()  # fresh per query
+        compiler_state = CompilerState(
+            schemas=self.tracker.schemas(),
+            registry=self.registry,
+            now_ns=now_ns,
+            max_output_rows=max_output_rows,
+        )
+        compiled = compile_pxl(query, compiler_state)
+        try:
+            dplan = self.planner.plan(compiled.plan, state)
+        except PlanningError as e:
+            raise QueryError(str(e)) from e
+
+        qid = uuid.uuid4().hex[:12]
+        data_agents = list(dplan.data_agent_ids)
+        merge_agent = dplan.kelvin_agent_ids[0]
+        self.forwarder.register_query(qid, len(data_agents))
+
+        # LaunchQuery: merge fragment first (so the router can accept
+        # early bridge chunks), then the per-agent data fragments.
+        self.bus.publish(
+            f"agent.{merge_agent}.merge",
+            {
+                "qid": qid,
+                "plan": dplan.merge_plan,
+                "bridge_ids": [b.bridge_id for b in dplan.split.bridges],
+                "data_agents": data_agents,
+            },
+        )
+        for aid in data_agents:
+            self.bus.publish(
+                f"agent.{aid}.execute",
+                {
+                    "qid": qid,
+                    "plan": dplan.split.before_blocking,
+                    "merge_agent": merge_agent,
+                },
+            )
+        result = self.forwarder.wait(qid, timeout_s)
+        result["qid"] = qid
+        result["distributed_plan"] = dplan
+        return result

@@ -1,0 +1,130 @@
+"""Agent tracker: registration, heartbeats, expiry, live-state snapshots.
+
+Reference parity: the metadata service's agent manager + topic listener
+(``src/vizier/services/metadata/controllers/agent/agent.go:100``,
+``agent_topic_listener.go:41,305-322``): agents register and get an ASID,
+heartbeat every few seconds, and are expired + deleted after a minute of
+silence — at which point the planner stops scheduling to them. Agents
+report their table schemas here (the schema-tracker role), which feeds
+the query broker's CompilerState.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..planner.distributed import AgentInfo, DistributedState
+from .msgbus import MessageBus
+
+TOPIC_REGISTER = "agent.register"
+TOPIC_HEARTBEAT = "agent.heartbeat"
+TOPIC_EXPIRED = "agent.expired"
+
+DEFAULT_EXPIRY_S = 60.0
+DEFAULT_CHECK_INTERVAL_S = 5.0
+
+
+class _Record:
+    def __init__(self, info: AgentInfo, schemas: dict):
+        self.info = info
+        self.schemas = schemas  # {table name: Relation}
+        self.last_heartbeat = time.monotonic()
+
+
+class AgentTracker:
+    def __init__(
+        self,
+        bus: MessageBus,
+        expiry_s: float = DEFAULT_EXPIRY_S,
+        check_interval_s: float = DEFAULT_CHECK_INTERVAL_S,
+    ):
+        self.bus = bus
+        self.expiry_s = expiry_s
+        self.check_interval_s = check_interval_s
+        self._lock = threading.Lock()
+        self._agents: dict[str, _Record] = {}
+        self._next_asid = 1
+        self._subs = [
+            bus.subscribe(TOPIC_REGISTER, self._on_register),
+            bus.subscribe(TOPIC_HEARTBEAT, self._on_heartbeat),
+        ]
+        self._stop = threading.Event()
+        self._expiry_thread = threading.Thread(target=self._expiry_loop, daemon=True)
+        self._expiry_thread.start()
+
+    # -- message handlers ----------------------------------------------------
+    def _on_register(self, msg: dict):
+        agent_id = msg["agent_id"]
+        with self._lock:
+            asid = self._next_asid
+            self._next_asid += 1
+            info = AgentInfo(
+                agent_id=agent_id,
+                processes_data=msg.get("processes_data", True),
+                accepts_remote_sources=msg.get("accepts_remote_sources", False),
+                tables=frozenset(msg.get("schemas", {})),
+                asid=asid,
+            )
+            self._agents[agent_id] = _Record(info, dict(msg.get("schemas", {})))
+        self.bus.publish(f"agent.{agent_id}.registered", {"asid": asid})
+
+    def _on_heartbeat(self, msg: dict):
+        agent_id = msg["agent_id"]
+        with self._lock:
+            rec = self._agents.get(agent_id)
+            if rec is None:
+                # Unknown agent (e.g. expired): tell it to re-register —
+                # the reference's heartbeat-NACK resync path
+                # (``manager.h:207`` re-register hook).
+                self.bus.publish(f"agent.{agent_id}.reregister", {})
+                return
+            rec.last_heartbeat = time.monotonic()
+            if "schemas" in msg:
+                rec.schemas = dict(msg["schemas"])
+                rec.info = AgentInfo(
+                    agent_id=rec.info.agent_id,
+                    processes_data=rec.info.processes_data,
+                    accepts_remote_sources=rec.info.accepts_remote_sources,
+                    tables=frozenset(msg["schemas"]),
+                    asid=rec.info.asid,
+                )
+
+    # -- expiry --------------------------------------------------------------
+    def _expiry_loop(self):
+        while not self._stop.wait(self.check_interval_s):
+            self.expire_silent()
+
+    def expire_silent(self) -> list[str]:
+        now = time.monotonic()
+        expired = []
+        with self._lock:
+            for aid, rec in list(self._agents.items()):
+                if now - rec.last_heartbeat > self.expiry_s:
+                    del self._agents[aid]
+                    expired.append(aid)
+        for aid in expired:
+            self.bus.publish(TOPIC_EXPIRED, {"agent_id": aid})
+        return expired
+
+    # -- queries -------------------------------------------------------------
+    def distributed_state(self) -> DistributedState:
+        with self._lock:
+            return DistributedState(agents=[r.info for r in self._agents.values()])
+
+    def schemas(self) -> dict:
+        """Union of table schemas across live agents."""
+        out: dict = {}
+        with self._lock:
+            for rec in self._agents.values():
+                out.update(rec.schemas)
+        return out
+
+    def agent_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._agents)
+
+    def close(self):
+        self._stop.set()
+        for s in self._subs:
+            s.unsubscribe()
