@@ -34,6 +34,7 @@ from .plan import (
     AggOp,
     BridgeSinkOp,
     BridgeSourceOp,
+    EmptySourceOp,
     FilterOp,
     JoinOp,
     LimitOp,
@@ -41,6 +42,7 @@ from .plan import (
     MemorySourceOp,
     Plan,
     ResultSinkOp,
+    UDTFSourceOp,
     UnionOp,
 )
 
@@ -256,6 +258,12 @@ class Engine:
                 results[nid] = _Stream(
                     base.relation, dict(base.dicts), chain, tablets, op
                 )
+            elif isinstance(op, UDTFSourceOp):
+                results[nid] = self._run_udtf(op)
+            elif isinstance(op, EmptySourceOp):
+                results[nid] = _empty_host_batch(
+                    Relation(list(op.relation_items))
+                )
             elif isinstance(op, (MapOp, FilterOp, AggOp, LimitOp)):
                 upstream = results[node.inputs[0]]
                 if isinstance(upstream, _PendingAggBridge):
@@ -305,6 +313,16 @@ class Engine:
             if consumers.get(nid, 0) > 1 and isinstance(results[nid], _Stream):
                 results[nid] = self._materialize(results[nid])
         return outputs
+
+    def _run_udtf(self, op: UDTFSourceOp) -> HostBatch:
+        """Execute a UDTF source (``udtf_source_node.h`` analog): call its
+        fn with this engine as context and shape the rows to the declared
+        relation."""
+        udtf = self.registry.get_udtf(op.name)
+        data = udtf.fn(self, **dict(op.args))
+        rel = Relation(list(udtf.relation))
+        hb = HostBatch.from_pydict(data, relation=rel, time_cols=())
+        return hb
 
     # -- bridge (agent-mode) machinery ----------------------------------------
     def _fold_agg_state(self, stream: "_Stream", frag):
@@ -487,7 +505,6 @@ class Engine:
         frag = compile_fragment(
             stream.chain, stream.relation, stream.dicts, self.registry
         )
-        _, _, rows_step = self._compile_steps(frag)
 
         if frag.is_agg:
             state = self._fold_agg_state(stream, frag)
@@ -501,6 +518,7 @@ class Engine:
             return _apply_limit(out, frag.limit)
 
         # Non-agg: stream windows, stop early once a limit is satisfied.
+        _, _, rows_step = self._compile_steps(frag)
         pieces, total = [], 0
         for hb in self._windows(stream):
             cols, valid = self._stage(hb, self._window_capacity(hb.length))

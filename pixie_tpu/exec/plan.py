@@ -141,6 +141,27 @@ class UnionOp(Op):
 
 
 @dataclass(frozen=True)
+class UDTFSourceOp(Op):
+    """Run a registered UDTF as a source.
+
+    Reference: ``src/carnot/exec/udtf_source_node.h`` — used for cluster
+    introspection (agent status, schema listing, registry listing).
+    ``args`` are the compile-time init args (udtf.h UDTFInitArgs).
+    """
+
+    name: str
+    args: tuple = ()  # tuple[(name, value)]
+
+
+@dataclass(frozen=True)
+class EmptySourceOp(Op):
+    """Zero-row source with a declared relation
+    (``src/carnot/exec/empty_source_node.h``)."""
+
+    relation_items: tuple = ()  # tuple[(name, DataType)]
+
+
+@dataclass(frozen=True)
 class BridgeSinkOp(Op):
     """End of a per-agent fragment: hand the fragment's output to a
     cross-fragment bridge. GRPCSinkNode analog

@@ -75,12 +75,37 @@ class DistributedPlan:
 
 
 class Coordinator:
+    def __init__(self, registry=None):
+        self.registry = registry
+
+    def _wants_all_agents(self, plan: Plan) -> bool:
+        """True if the fragment holds an ALL_AGENTS UDTF — such fragments
+        run on Kelvins too, not just PEMs (udtf.h executor semantics)."""
+        from ...exec.plan import UDTFSourceOp
+        from ...udf.udtf import UDTFExecutor
+
+        if self.registry is None:
+            return False
+        for n in plan.nodes.values():
+            if isinstance(n.op, UDTFSourceOp) and self.registry.has_udtf(
+                n.op.name
+            ):
+                ex = self.registry.get_udtf(n.op.name).executor
+                if ex == UDTFExecutor.ALL_AGENTS:
+                    return True
+        return False
+
     def assign(
         self, split: BlockingSplitPlan, state: DistributedState
     ) -> DistributedPlan:
         needed = source_tables(split.before_blocking)
+        candidates = (
+            state.agents
+            if self._wants_all_agents(split.before_blocking)
+            else state.pems
+        )
         eligible, pruned = [], []
-        for a in state.pems:
+        for a in candidates:
             missing = {t for t in needed if not a.has_table(t)}
             (eligible if not missing else pruned).append(a.agent_id)
         if not eligible and needed:
@@ -91,7 +116,9 @@ class Coordinator:
             # reference runs Kelvin-less in standalone mode).
             kelvins = tuple(eligible[:1])
         clusters = (
-            [PlanCluster(tuple(eligible), split.before_blocking)] if eligible else []
+            [PlanCluster(tuple(eligible), split.before_blocking)]
+            if eligible and split.before_blocking.nodes
+            else []
         )
         return DistributedPlan(
             split=split,

@@ -21,9 +21,9 @@ class DistributedPlanner:
     """Combines splitter + coordinator + stitcher (logical_planner.h:40
     drives this from the query broker's compile path)."""
 
-    def __init__(self):
-        self.splitter = Splitter()
-        self.coordinator = Coordinator()
+    def __init__(self, registry=None):
+        self.splitter = Splitter(registry)
+        self.coordinator = Coordinator(registry)
 
     def plan(
         self, logical_plan: Plan, state: DistributedState, mesh=None

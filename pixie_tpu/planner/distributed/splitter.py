@@ -24,12 +24,14 @@ from ...exec.plan import (
     AggOp,
     BridgeSinkOp,
     BridgeSourceOp,
+    EmptySourceOp,
     JoinOp,
     LimitOp,
     MemorySourceOp,
     Op,
     Plan,
     ResultSinkOp,
+    UDTFSourceOp,
     UnionOp,
 )
 
@@ -66,7 +68,19 @@ def _is_blocking(op: Op) -> bool:
 
 
 class Splitter:
-    """Splits one logical plan. Stateless; per-query use."""
+    """Splits one logical plan; ``registry`` resolves UDTF executor
+    classes (udtf.h UDTFSourceExecutor -> which tier runs the source)."""
+
+    def __init__(self, registry=None):
+        self.registry = registry
+
+    def _udtf_runs_on_pem(self, op: UDTFSourceOp) -> bool:
+        from ...udf.udtf import UDTFExecutor
+
+        if self.registry is None or not self.registry.has_udtf(op.name):
+            return False  # default: one merge-tier instance
+        ex = self.registry.get_udtf(op.name).executor
+        return ex in (UDTFExecutor.ALL_AGENTS, UDTFExecutor.ALL_PEM)
 
     def split(self, plan: Plan) -> BlockingSplitPlan:
         before, after = Plan(), Plan()
@@ -99,8 +113,13 @@ class Splitter:
             node = plan.nodes[nid]
             op = node.op
             inputs_kelvin = any(placed[i][0] == "kelvin" for i in node.inputs)
-            if isinstance(op, MemorySourceOp):
+            if isinstance(op, (MemorySourceOp, EmptySourceOp)):
                 placed[nid] = ("pem", before.add(op))
+            elif isinstance(op, UDTFSourceOp):
+                if self._udtf_runs_on_pem(op):
+                    placed[nid] = ("pem", before.add(op))
+                else:
+                    placed[nid] = ("kelvin", after.add(op))
             elif isinstance(op, AggOp) and not inputs_kelvin:
                 # Split: prepare (partial) stays on the PEM side; when the
                 # result is consumed downstream it bridges as a carry

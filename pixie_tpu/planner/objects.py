@@ -30,6 +30,7 @@ from ..exec.plan import (
     MemorySourceOp,
     Plan,
     ResultSinkOp,
+    UDTFSourceOp,
     UnionOp,
 )
 from ..types.dtypes import DataType
@@ -474,6 +475,51 @@ class PlanBuilder:
         if select is not None:
             df = df.project(list(select), lineno)
         return df
+
+    def udtf_source(self, name: str, lineno=None, **kwargs) -> DataFrameObj:
+        """px.<UDTFName>(...) -> DataFrame (udtf.h source surface)."""
+        from ..types.relation import Relation as _Relation
+
+        import inspect
+
+        udtf = self.registry.get_udtf(name)
+        declared = {n for n, _t in udtf.init_args}
+        unknown = set(kwargs) - declared
+        if unknown:
+            raise PxLError(
+                f"px.{name}: unknown arguments {sorted(unknown)}; "
+                f"declared: {sorted(declared)}", lineno)
+        # Required-arg + type check at compile time (udtf.h checks init
+        # args during planning, not at the remote source node).
+        params = inspect.signature(udtf.fn).parameters
+        for arg_name, arg_type in udtf.init_args:
+            p = params.get(arg_name)
+            required = p is not None and p.default is inspect.Parameter.empty
+            if required and arg_name not in kwargs:
+                raise PxLError(
+                    f"px.{name}: missing required argument {arg_name!r}", lineno
+                )
+            if arg_name in kwargs:
+                v = kwargs[arg_name]
+                ok = (
+                    isinstance(v, bool)
+                    if arg_type == DataType.BOOLEAN
+                    else isinstance(v, int) and not isinstance(v, bool)
+                    if arg_type in (DataType.INT64, DataType.TIME64NS)
+                    else isinstance(v, (int, float)) and not isinstance(v, bool)
+                    if arg_type == DataType.FLOAT64
+                    else isinstance(v, str)
+                    if arg_type == DataType.STRING
+                    else True
+                )
+                if not ok:
+                    raise PxLError(
+                        f"px.{name}: argument {arg_name!r} must be "
+                        f"{arg_type.name}, got {type(v).__name__}", lineno)
+        rel = _Relation(list(udtf.relation))
+        op = UDTFSourceOp(name=name, args=tuple(sorted(kwargs.items())))
+        nid = self.plan.add(op, [], relation=rel)
+        return DataFrameObj(self, nid, rel)
 
     def display(self, df: DataFrameObj, name: str = "output", lineno=None):
         if not isinstance(df, DataFrameObj):

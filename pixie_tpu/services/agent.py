@@ -39,6 +39,15 @@ class Agent:
         self.bus = bus
         self.agent_id = agent_id
         self.engine = engine or Engine()
+        # Per-agent registry with service UDTFs bound to this bus (the
+        # VizierFuncFactoryContext analog) — cloned so the process-wide
+        # default registry stays untouched.
+        from .vizier_funcs import register_vizier_udtfs
+
+        self.engine.registry = self.engine.registry.clone(
+            f"agent-{agent_id}", exclude=("GetAgentStatus",)
+        )
+        register_vizier_udtfs(self.engine.registry, bus)
         self.heartbeat_interval_s = heartbeat_interval_s
         self.asid = None
         self._registered = threading.Event()

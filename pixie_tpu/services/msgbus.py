@@ -69,6 +69,25 @@ class MessageBus:
             s._deliver(msg)
         return len(subs)
 
+    def request(self, topic: str, msg: dict, timeout_s: float = 5.0) -> dict:
+        """NATS request/reply: publish with a one-shot ``_reply_to`` inbox
+        and block for the response (the UDTF -> MDS stub call pattern)."""
+        import queue as _queue
+        import uuid as _uuid
+
+        inbox = f"_inbox.{_uuid.uuid4().hex}"
+        q: _queue.Queue = _queue.Queue()
+        sub = self.subscribe(inbox, q.put)
+        try:
+            n = self.publish(topic, {**msg, "_reply_to": inbox})
+            if n == 0:
+                raise TimeoutError(f"no responder on {topic!r}")
+            return q.get(timeout=timeout_s)
+        except _queue.Empty:
+            raise TimeoutError(f"no reply from {topic!r} in {timeout_s}s") from None
+        finally:
+            sub.unsubscribe()
+
     def _remove(self, sub: Subscription):
         with self._lock:
             lst = self._subs.get(sub.topic, [])

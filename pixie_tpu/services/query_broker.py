@@ -106,9 +106,14 @@ class QueryBroker:
     ):
         self.bus = bus
         self.tracker = tracker
-        self.registry = registry or default_registry()
+        from .vizier_funcs import register_vizier_udtfs
+
+        self.registry = (registry or default_registry()).clone(
+            "broker", exclude=("GetAgentStatus",)
+        )
+        register_vizier_udtfs(self.registry, bus)
         self.forwarder = QueryResultForwarder(bus)
-        self.planner = DistributedPlanner()
+        self.planner = DistributedPlanner(self.registry)
 
     def execute_script(
         self,
@@ -133,6 +138,8 @@ class QueryBroker:
 
         qid = uuid.uuid4().hex[:12]
         data_agents = list(dplan.data_agent_ids)
+        if not dplan.kelvin_agent_ids:
+            raise QueryError("no live agent available to run the query")
         merge_agent = dplan.kelvin_agent_ids[0]
         self.forwarder.register_query(qid, len(data_agents))
 

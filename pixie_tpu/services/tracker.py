@@ -48,6 +48,7 @@ class AgentTracker:
         self._subs = [
             bus.subscribe(TOPIC_REGISTER, self._on_register),
             bus.subscribe(TOPIC_HEARTBEAT, self._on_heartbeat),
+            bus.subscribe("mds.agent_status", self._on_agent_status_request),
         ]
         self._stop = threading.Event()
         self._expiry_thread = threading.Thread(target=self._expiry_loop, daemon=True)
@@ -89,6 +90,25 @@ class AgentTracker:
                     tables=frozenset(msg["schemas"]),
                     asid=rec.info.asid,
                 )
+
+    def _on_agent_status_request(self, msg: dict):
+        """MDS stub service for the GetAgentStatus UDTF
+        (``md_udtfs_impl.h:258`` hits MDS the same way)."""
+        now = time.monotonic()
+        with self._lock:
+            rows = [
+                {
+                    "agent_id": aid,
+                    "asid": rec.info.asid,
+                    "kind": (
+                        "kelvin" if rec.info.accepts_remote_sources else "pem"
+                    ),
+                    "last_heartbeat_s": now - rec.last_heartbeat,
+                    "num_tables": len(rec.schemas),
+                }
+                for aid, rec in sorted(self._agents.items())
+            ]
+        self.bus.publish(msg["_reply_to"], {"agents": rows})
 
     # -- expiry --------------------------------------------------------------
     def _expiry_loop(self):

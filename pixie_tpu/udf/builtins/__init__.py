@@ -3,7 +3,17 @@
 Reference parity: ``src/carnot/funcs/funcs.cc:30`` RegisterFuncsOrDie.
 """
 
-from . import collections, conditionals, json_ops, math_ops, math_sketches, regex_ops, sql_ops, string_ops
+from . import (
+    collections,
+    conditionals,
+    introspection,
+    json_ops,
+    math_ops,
+    math_sketches,
+    regex_ops,
+    sql_ops,
+    string_ops,
+)
 
 
 def register_all(reg):
@@ -15,3 +25,4 @@ def register_all(reg):
     json_ops.register(reg)
     regex_ops.register(reg)
     sql_ops.register(reg)
+    introspection.register_introspection(reg)

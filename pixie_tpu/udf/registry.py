@@ -18,6 +18,7 @@ class Registry:
         self.name = name
         self._scalar: dict[str, list[ScalarUDFDef]] = {}
         self._uda: dict[str, list[UDADef]] = {}
+        self._udtf: dict[str, object] = {}  # name -> UDTFDef
 
     # -- registration --------------------------------------------------------
     def register_scalar(self, udf: ScalarUDFDef) -> None:
@@ -87,6 +88,25 @@ class Registry:
         self.register_uda(d)
         return d
 
+    def register_udtf(self, udtf) -> None:
+        if udtf.name in self._udtf:
+            raise ValueError(f"duplicate UDTF {udtf.name!r}")
+        self._udtf[udtf.name] = udtf
+
+    def udtf(self, name, relation, fn, executor=None, init_args=(), doc=""):
+        from .udtf import UDTFDef, UDTFExecutor
+
+        d = UDTFDef(
+            name=name,
+            relation=tuple(relation),
+            fn=fn,
+            executor=executor or UDTFExecutor.ONE_KELVIN,
+            init_args=tuple(init_args),
+            doc=doc,
+        )
+        self.register_udtf(d)
+        return d
+
     # -- lookup --------------------------------------------------------------
     def has_scalar(self, name: str) -> bool:
         return name in self._scalar
@@ -104,11 +124,22 @@ class Registry:
             raise SignatureError(f"no UDA named {name!r}")
         return resolve_overload(self._uda[name], tuple(arg_types))
 
+    def has_udtf(self, name: str) -> bool:
+        return name in self._udtf
+
+    def get_udtf(self, name: str):
+        if name not in self._udtf:
+            raise SignatureError(f"no UDTF named {name!r}")
+        return self._udtf[name]
+
     def scalar_names(self) -> list[str]:
         return sorted(self._scalar)
 
     def uda_names(self) -> list[str]:
         return sorted(self._uda)
+
+    def udtf_names(self) -> list[str]:
+        return sorted(self._udtf)
 
     def clone(self, name: str | None = None, exclude=()) -> "Registry":
         """Shallow copy (defs are frozen), optionally dropping some names —
@@ -118,6 +149,7 @@ class Registry:
         ex = set(exclude)
         out._scalar = {n: list(v) for n, v in self._scalar.items() if n not in ex}
         out._uda = {n: list(v) for n, v in self._uda.items() if n not in ex}
+        out._udtf = {n: v for n, v in self._udtf.items() if n not in ex}
         return out
 
     def docs(self) -> dict[str, str]:

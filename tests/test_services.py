@@ -97,7 +97,8 @@ class TestClusterQuery:
             "import px\n"
             "df = px.DataFrame(table='http_events')\n"
             "df = df.agg(p=('latency_ns', px.quantiles))\n"
-            "px.display(df, 'out')\n"
+            "px.display(df, 'out')\n",
+            timeout_s=300.0,  # cold t-digest JIT compile alone is ~1min
         )
         import json
 

@@ -1,0 +1,202 @@
+"""UDTF framework + introspection tests (md_udtfs parity)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec import Engine
+from pixie_tpu.types.dtypes import DataType
+from pixie_tpu.udf.udtf import UDTFExecutor
+
+
+def _engine_with_data():
+    e = Engine()
+    e.append_data(
+        "http_events",
+        {
+            "time_": np.arange(100, dtype=np.int64),
+            "resp_status": np.full(100, 200, dtype=np.int64),
+        },
+    )
+    return e
+
+
+class TestUDTFEngine:
+    def test_get_tables(self):
+        e = _engine_with_data()
+        out = e.execute_query(
+            "import px\npx.display(px.GetTables(), 'o')\n"
+        )["o"].to_pydict()
+        assert list(out["table_name"]) == ["http_events"]
+        assert out["num_rows"][0] == 100
+
+    def test_get_table_schemas(self):
+        e = _engine_with_data()
+        out = e.execute_query(
+            "import px\npx.display(px.GetTableSchemas(), 'o')\n"
+        )["o"].to_pydict()
+        cols = dict(zip(out["column_name"], out["column_type"]))
+        assert cols == {"time_": "TIME64NS", "resp_status": "INT64"}
+
+    def test_registry_listings(self):
+        e = _engine_with_data()
+        out = e.execute_query(
+            "import px\n"
+            "px.display(px.GetUDFList(), 'udfs')\n"
+            "px.display(px.GetUDAList(), 'udas')\n"
+            "px.display(px.GetUDTFList(), 'udtfs')\n"
+        )
+        udfs = out["udfs"].to_pydict()
+        assert "add" in list(udfs["name"])
+        sig = json.loads(udfs["signature"][0])
+        assert {"args", "return", "executor"} <= set(sig)
+        udas = out["udas"].to_pydict()
+        assert "mean" in list(udas["name"])
+        udtfs = out["udtfs"].to_pydict()
+        assert "GetTables" in list(udtfs["name"])
+
+    def test_udtf_composes_with_ops(self):
+        e = _engine_with_data()
+        out = e.execute_query(
+            "import px\n"
+            "df = px.GetTableSchemas()\n"
+            "df = df[df.column_type == 'INT64']\n"
+            "px.display(df, 'o')\n"
+        )["o"].to_pydict()
+        assert list(out["column_name"]) == ["resp_status"]
+
+    def test_debug_table_info(self):
+        e = _engine_with_data()
+        e.tables["http_events"].compact()
+        out = e.execute_query(
+            "import px\npx.display(px.GetDebugTableInfo(), 'o')\n"
+        )["o"].to_pydict()
+        assert out["compacted_batches"][0] >= 1
+
+    def test_custom_udtf_with_args(self):
+        e = Engine()
+        e.registry = e.registry.clone("t")
+        e.registry.udtf(
+            "Range",
+            [("x", DataType.INT64)],
+            lambda engine, n=5: {"x": list(range(n))},
+            executor=UDTFExecutor.ONE_KELVIN,
+            init_args=(("n", DataType.INT64),),
+        )
+        out = e.execute_query(
+            "import px\npx.display(px.Range(n=3), 'o')\n"
+        )["o"].to_pydict()
+        assert list(out["x"]) == [0, 1, 2]
+
+    def test_unknown_udtf_arg_rejected(self):
+        from pixie_tpu.planner.objects import PxLError
+
+        e = _engine_with_data()
+        with pytest.raises(PxLError):
+            e.execute_query(
+                "import px\npx.display(px.GetTables(bogus=1), 'o')\n"
+            )
+
+    def test_missing_required_arg_and_bad_type_rejected_at_compile(self):
+        from pixie_tpu.planner.objects import PxLError
+
+        e = Engine()
+        e.registry = e.registry.clone("t")
+        e.registry.udtf(
+            "NeedsArg",
+            [("x", DataType.INT64)],
+            lambda engine, n: {"x": list(range(n))},  # n has no default
+            init_args=(("n", DataType.INT64),),
+        )
+        with pytest.raises(PxLError, match="missing required"):
+            e.execute_query("import px\npx.display(px.NeedsArg(), 'o')\n")
+        with pytest.raises(PxLError, match="must be INT64"):
+            e.execute_query(
+                "import px\npx.display(px.NeedsArg(n='x'), 'o')\n"
+            )
+
+
+class TestUDTFCluster:
+    def test_agent_status_over_bus(self):
+        import time
+
+        from pixie_tpu.services import (
+            AgentTracker,
+            KelvinAgent,
+            MessageBus,
+            PEMAgent,
+            QueryBroker,
+        )
+
+        bus = MessageBus()
+        tracker = AgentTracker(bus, expiry_s=60, check_interval_s=60)
+        pems = [
+            PEMAgent(bus, f"pem-{i}", heartbeat_interval_s=0.05).start()
+            for i in range(2)
+        ]
+        kelvin = KelvinAgent(bus, "kelvin-0", heartbeat_interval_s=0.05).start()
+        pems[0].append_data(
+            "http_events", {"time_": np.arange(10, dtype=np.int64)}
+        )
+        pems[0]._register()
+        deadline = time.time() + 5
+        while time.time() < deadline and len(tracker.agent_ids()) < 3:
+            time.sleep(0.01)
+        broker = QueryBroker(bus, tracker)
+        try:
+            res = broker.execute_script(
+                "import px\npx.display(px.GetAgentStatus(), 'o')\n"
+            )
+            out = res["tables"]["o"].to_pydict()
+            assert set(out["agent_id"]) == {"pem-0", "pem-1", "kelvin-0"}
+            kinds = dict(zip(out["agent_id"], out["kind"]))
+            assert kinds["kelvin-0"] == "kelvin" and kinds["pem-0"] == "pem"
+            # ONE_KELVIN UDTF: no data fragments dispatched.
+            assert res["distributed_plan"].n_data_shards == 0
+        finally:
+            for a in pems + [kelvin]:
+                a.stop()
+            tracker.close()
+            bus.close()
+
+    def test_all_agents_udtf_gathers_from_pems(self):
+        import time
+
+        from pixie_tpu.services import (
+            AgentTracker,
+            KelvinAgent,
+            MessageBus,
+            PEMAgent,
+            QueryBroker,
+        )
+
+        bus = MessageBus()
+        tracker = AgentTracker(bus, expiry_s=60, check_interval_s=60)
+        pems = [
+            PEMAgent(bus, f"pem-{i}", heartbeat_interval_s=0.05).start()
+            for i in range(2)
+        ]
+        kelvin = KelvinAgent(bus, "kelvin-0", heartbeat_interval_s=0.05).start()
+        for i, pem in enumerate(pems):
+            pem.append_data(
+                "http_events",
+                {"time_": np.arange(10 * (i + 1), dtype=np.int64)},
+            )
+            pem._register()
+        deadline = time.time() + 5
+        while time.time() < deadline and len(tracker.schemas()) < 1:
+            time.sleep(0.01)
+        broker = QueryBroker(bus, tracker)
+        try:
+            res = broker.execute_script(
+                "import px\npx.display(px.GetTables(), 'o')\n"
+            )
+            out = res["tables"]["o"].to_pydict()
+            # One row per PEM instance, gathered on the merge tier.
+            assert sorted(out["num_rows"]) == [10, 20]
+        finally:
+            for a in pems + [kelvin]:
+                a.stop()
+            tracker.close()
+            bus.close()
