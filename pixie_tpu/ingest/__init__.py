@@ -1,0 +1,27 @@
+"""Ingest edge: source connectors feeding the table store.
+
+Reference parity: ``src/stirling`` core (SURVEY.md §2.3). The eBPF
+collectors themselves stay out of scope (they are the kernel-facing
+edge); what this package rebuilds is everything Stirling exposes to the
+rest of the system: the ``SourceConnector`` lifecycle, per-source
+``DataTable`` buffers, the sampling/push ``FrequencyManager`` poll loop,
+``RegisterDataPushCallback`` semantics, the synthetic ``seq_gen`` source
+the test strategy leans on, procfs-based process stats, and the
+benchmark replay loader. A native collector pushes through the same
+C ABI the table store exposes (``pixie_tpu/native/table_ring.cc``).
+"""
+
+from .core import DataTable, FrequencyManager, SourceConnector
+from .collector import Collector
+from .connectors import ProcessStatsConnector, SeqGenConnector
+from .replay import gen_http_events, replay_into
+
+__all__ = [
+    "Collector",
+    "DataTable",
+    "FrequencyManager",
+    "ProcessStatsConnector",
+    "SeqGenConnector",
+    "gen_http_events",
+    "replay_into",
+]

@@ -1,0 +1,131 @@
+"""Builtin source connectors.
+
+Reference parity:
+- ``SeqGenConnector`` (``source_connectors/seq_gen``): deterministic
+  synthetic sequences — the reference test strategy's stand-in for real
+  eBPF sources (SURVEY.md §4).
+- ``ProcessStatsConnector`` (``source_connectors/process_stats``):
+  per-process CPU/memory counters scraped from procfs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..types.dtypes import DataType
+from ..types.relation import Relation
+from .core import SourceConnector
+
+I, F, S, T = DataType.INT64, DataType.FLOAT64, DataType.STRING, DataType.TIME64NS
+
+
+class SeqGenConnector(SourceConnector):
+    """Deterministic sequence generator, one table of counters.
+
+    Reference: ``seq_gen_connector.h`` — linear/modulo/square sequences
+    keyed off a monotone counter, used to validate the push path without
+    kernel probes.
+    """
+
+    name = "seq_gen"
+    tables = [
+        (
+            "sequences",
+            Relation(
+                [
+                    ("time_", T),
+                    ("x", I),
+                    ("linear", I),
+                    ("modulo10", I),
+                    ("square", I),
+                    ("fibonacci", I),
+                ]
+            ),
+        )
+    ]
+
+    def __init__(self, rows_per_transfer: int = 64, **kw):
+        super().__init__(**kw)
+        self.rows_per_transfer = rows_per_transfer
+        self._x = 0
+        self._fib = (0, 1)
+
+    def transfer_data(self, ctx, data_tables) -> None:
+        n = self.rows_per_transfer
+        xs = np.arange(self._x, self._x + n, dtype=np.int64)
+        fibs = np.empty(n, dtype=np.int64)
+        a, b = self._fib
+        for i in range(n):
+            fibs[i] = a
+            a, b = b, (a + b) % (1 << 62)
+        self._fib = (a, b)
+        self._x += n
+        now = time.time_ns()
+        data_tables["sequences"].append(
+            {
+                "time_": np.full(n, now, dtype=np.int64),
+                "x": xs,
+                "linear": 2 * xs + 1,
+                "modulo10": xs % 10,
+                "square": xs * xs,
+                "fibonacci": fibs,
+            }
+        )
+
+
+class ProcessStatsConnector(SourceConnector):
+    """Per-process CPU/memory from /proc (``process_stats`` parity)."""
+
+    name = "process_stats"
+    tables = [
+        (
+            "process_stats",
+            Relation(
+                [
+                    ("time_", T),
+                    ("pid", I),
+                    ("cmd", S),
+                    ("utime_ticks", I),
+                    ("stime_ticks", I),
+                    ("vsize_bytes", I),
+                    ("rss_bytes", I),
+                ]
+            ),
+        )
+    ]
+
+    def __init__(self, max_procs: int = 256, **kw):
+        super().__init__(**kw)
+        self.max_procs = max_procs
+        self._page = os.sysconf("SC_PAGE_SIZE")
+
+    def transfer_data(self, ctx, data_tables) -> None:
+        rows = {k: [] for k, _ in self.tables[0][1].items()}
+        now = time.time_ns()
+        count = 0
+        for pid_s in os.listdir("/proc"):
+            if not pid_s.isdigit():
+                continue
+            if count >= self.max_procs:
+                break
+            try:
+                with open(f"/proc/{pid_s}/stat") as f:
+                    stat = f.read()
+            except OSError:
+                continue  # process exited mid-scan
+            # comm may contain spaces/parens: split around the last ')'.
+            lpar, rpar = stat.find("("), stat.rfind(")")
+            comm = stat[lpar + 1 : rpar]
+            fields = stat[rpar + 2 :].split()
+            rows["time_"].append(now)
+            rows["pid"].append(int(pid_s))
+            rows["cmd"].append(comm)
+            rows["utime_ticks"].append(int(fields[11]))
+            rows["stime_ticks"].append(int(fields[12]))
+            rows["vsize_bytes"].append(int(fields[20]))
+            rows["rss_bytes"].append(int(fields[21]) * self._page)
+            count += 1
+        data_tables["process_stats"].append(rows)

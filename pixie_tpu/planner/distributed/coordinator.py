@@ -78,31 +78,14 @@ class Coordinator:
     def __init__(self, registry=None):
         self.registry = registry
 
-    def _wants_all_agents(self, plan: Plan) -> bool:
-        """True if the fragment holds an ALL_AGENTS UDTF — such fragments
-        run on Kelvins too, not just PEMs (udtf.h executor semantics)."""
-        from ...exec.plan import UDTFSourceOp
-        from ...udf.udtf import UDTFExecutor
-
-        if self.registry is None:
-            return False
-        for n in plan.nodes.values():
-            if isinstance(n.op, UDTFSourceOp) and self.registry.has_udtf(
-                n.op.name
-            ):
-                ex = self.registry.get_udtf(n.op.name).executor
-                if ex == UDTFExecutor.ALL_AGENTS:
-                    return True
-        return False
-
     def assign(
         self, split: BlockingSplitPlan, state: DistributedState
     ) -> DistributedPlan:
         needed = source_tables(split.before_blocking)
+        # The splitter already resolved the data tier (udtf.h executor
+        # semantics: ALL_AGENTS fragments run on Kelvins too).
         candidates = (
-            state.agents
-            if self._wants_all_agents(split.before_blocking)
-            else state.pems
+            state.agents if split.data_tier == "all_agents" else state.pems
         )
         eligible, pruned = [], []
         for a in candidates:

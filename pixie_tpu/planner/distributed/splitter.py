@@ -57,6 +57,10 @@ class BlockingSplitPlan:
     before_blocking: Plan
     after_blocking: Plan
     bridges: list = field(default_factory=list)  # list[BridgeSpec]
+    # Which agents run the data fragment: "pem" (data agents only) or
+    # "all_agents" (Kelvins too — an ALL_AGENTS UDTF is present). The
+    # splitter decides once; the coordinator only reads it.
+    data_tier: str = "pem"
 
     def bridge(self, bridge_id: int) -> BridgeSpec:
         return next(b for b in self.bridges if b.bridge_id == bridge_id)
@@ -74,17 +78,17 @@ class Splitter:
     def __init__(self, registry=None):
         self.registry = registry
 
-    def _udtf_runs_on_pem(self, op: UDTFSourceOp) -> bool:
+    def _udtf_executor(self, op: UDTFSourceOp):
         from ...udf.udtf import UDTFExecutor
 
         if self.registry is None or not self.registry.has_udtf(op.name):
-            return False  # default: one merge-tier instance
-        ex = self.registry.get_udtf(op.name).executor
-        return ex in (UDTFExecutor.ALL_AGENTS, UDTFExecutor.ALL_PEM)
+            return UDTFExecutor.ONE_KELVIN  # default: one merge instance
+        return self.registry.get_udtf(op.name).executor
 
     def split(self, plan: Plan) -> BlockingSplitPlan:
         before, after = Plan(), Plan()
         bridges: list[BridgeSpec] = []
+        data_tier = "pem"
         # logical node id -> ('pem', new_id) | ('kelvin', new_id)
         placed: dict[int, tuple[str, int]] = {}
 
@@ -116,8 +120,13 @@ class Splitter:
             if isinstance(op, (MemorySourceOp, EmptySourceOp)):
                 placed[nid] = ("pem", before.add(op))
             elif isinstance(op, UDTFSourceOp):
-                if self._udtf_runs_on_pem(op):
+                from ...udf.udtf import UDTFExecutor
+
+                ex = self._udtf_executor(op)
+                if ex in (UDTFExecutor.ALL_AGENTS, UDTFExecutor.ALL_PEM):
                     placed[nid] = ("pem", before.add(op))
+                    if ex == UDTFExecutor.ALL_AGENTS:
+                        data_tier = "all_agents"
                 else:
                     placed[nid] = ("kelvin", after.add(op))
             elif isinstance(op, AggOp) and not inputs_kelvin:
@@ -146,4 +155,4 @@ class Splitter:
                     "pem",
                     before.add(op, [placed[i][1] for i in node.inputs]),
                 )
-        return BlockingSplitPlan(before, after, bridges)
+        return BlockingSplitPlan(before, after, bridges, data_tier=data_tier)

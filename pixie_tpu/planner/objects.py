@@ -480,22 +480,20 @@ class PlanBuilder:
         """px.<UDTFName>(...) -> DataFrame (udtf.h source surface)."""
         from ..types.relation import Relation as _Relation
 
-        import inspect
-
         udtf = self.registry.get_udtf(name)
-        declared = {n for n, _t in udtf.init_args}
+        declared = {e[0] for e in udtf.init_args}
         unknown = set(kwargs) - declared
         if unknown:
             raise PxLError(
                 f"px.{name}: unknown arguments {sorted(unknown)}; "
                 f"declared: {sorted(declared)}", lineno)
         # Required-arg + type check at compile time (udtf.h checks init
-        # args during planning, not at the remote source node).
-        params = inspect.signature(udtf.fn).parameters
-        for arg_name, arg_type in udtf.init_args:
-            p = params.get(arg_name)
-            required = p is not None and p.default is inspect.Parameter.empty
-            if required and arg_name not in kwargs:
+        # args during planning, not at the remote source node). Required-
+        # ness comes from the declaration — (name, type) is required,
+        # (name, type, default) optional — never from fn introspection.
+        for entry in udtf.init_args:
+            arg_name, arg_type = entry[0], entry[1]
+            if udtf.arg_required(arg_name) and arg_name not in kwargs:
                 raise PxLError(
                     f"px.{name}: missing required argument {arg_name!r}", lineno
                 )

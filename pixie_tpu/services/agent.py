@@ -42,12 +42,11 @@ class Agent:
         # Per-agent registry with service UDTFs bound to this bus (the
         # VizierFuncFactoryContext analog) — cloned so the process-wide
         # default registry stays untouched.
-        from .vizier_funcs import register_vizier_udtfs
+        from .vizier_funcs import bind_service_registry
 
-        self.engine.registry = self.engine.registry.clone(
-            f"agent-{agent_id}", exclude=("GetAgentStatus",)
+        self.engine.registry = bind_service_registry(
+            self.engine.registry, bus, f"agent-{agent_id}"
         )
-        register_vizier_udtfs(self.engine.registry, bus)
         self.heartbeat_interval_s = heartbeat_interval_s
         self.asid = None
         self._registered = threading.Event()

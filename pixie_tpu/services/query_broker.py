@@ -106,12 +106,11 @@ class QueryBroker:
     ):
         self.bus = bus
         self.tracker = tracker
-        from .vizier_funcs import register_vizier_udtfs
+        from .vizier_funcs import bind_service_registry
 
-        self.registry = (registry or default_registry()).clone(
-            "broker", exclude=("GetAgentStatus",)
+        self.registry = bind_service_registry(
+            registry or default_registry(), bus, "broker"
         )
-        register_vizier_udtfs(self.registry, bus)
         self.forwarder = QueryResultForwarder(bus)
         self.planner = DistributedPlanner(self.registry)
 

@@ -17,6 +17,19 @@ I = DataType.INT64
 F = DataType.FLOAT64
 
 
+# Names owned by this module; bind_service_registry excludes them before
+# re-registering so clones never collide.
+SERVICE_UDTF_NAMES = ("GetAgentStatus",)
+
+
+def bind_service_registry(registry, bus: MessageBus, name: str):
+    """Clone ``registry`` and (re)bind every service UDTF to ``bus``.
+    The one place that knows the service UDTF name list."""
+    reg = registry.clone(name, exclude=SERVICE_UDTF_NAMES)
+    register_vizier_udtfs(reg, bus)
+    return reg
+
+
 def register_vizier_udtfs(registry, bus: MessageBus) -> None:
     """Bind service UDTFs to a control-plane connection. Called by agents
     at startup (the VizierFuncFactoryContext analog)."""

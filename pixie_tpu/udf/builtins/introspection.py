@@ -46,7 +46,7 @@ def _get_table_schemas(engine):
 def _get_udf_list(engine):
     names, sigs = [], []
     for n in engine.registry.scalar_names():
-        for ov in engine.registry._scalar[n]:
+        for ov in engine.registry.scalar_overloads(n):
             names.append(n)
             sigs.append(
                 json.dumps(
@@ -63,7 +63,7 @@ def _get_udf_list(engine):
 def _get_uda_list(engine):
     names, sigs = [], []
     for n in engine.registry.uda_names():
-        for ov in engine.registry._uda[n]:
+        for ov in engine.registry.uda_overloads(n):
             names.append(n)
             sigs.append(
                 json.dumps(

@@ -138,6 +138,12 @@ class Registry:
     def uda_names(self) -> list[str]:
         return sorted(self._uda)
 
+    def scalar_overloads(self, name: str) -> list[ScalarUDFDef]:
+        return list(self._scalar.get(name, []))
+
+    def uda_overloads(self, name: str) -> list[UDADef]:
+        return list(self._uda.get(name, []))
+
     def udtf_names(self) -> list[str]:
         return sorted(self._udtf)
 

@@ -34,6 +34,14 @@ class UDTFDef:
     # engine (tables + registry) plus whatever the registrar closed over.
     fn: Callable
     executor: UDTFExecutor = UDTFExecutor.ONE_KELVIN
-    # Declared init args: {name: DataType} (checked at compile time).
+    # Declared init args, checked at compile time: each entry is
+    # (name, DataType) for a required arg or (name, DataType, default)
+    # for an optional one (udtf.h UDTFArg semantics).
     init_args: tuple = ()
     doc: str = ""
+
+    def arg_required(self, name: str) -> bool:
+        for entry in self.init_args:
+            if entry[0] == name:
+                return len(entry) == 2
+        return False

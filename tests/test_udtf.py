@@ -117,6 +117,26 @@ class TestUDTFEngine:
             )
 
 
+class TestEmptySource:
+    def test_empty_source_yields_zero_rows(self):
+        from pixie_tpu.exec.plan import (
+            EmptySourceOp,
+            Plan,
+            ResultSinkOp,
+        )
+
+        e = Engine()
+        p = Plan()
+        src = p.add(
+            EmptySourceOp(relation_items=(("time_", DataType.TIME64NS),
+                                          ("v", DataType.INT64)))
+        )
+        p.add(ResultSinkOp("o"), [src])
+        out = e.execute_plan(p)["o"]
+        assert out.length == 0
+        assert out.relation.column_names == ("time_", "v")
+
+
 class TestUDTFCluster:
     def test_agent_status_over_bus(self):
         import time
