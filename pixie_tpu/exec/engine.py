@@ -319,7 +319,11 @@ class Engine:
         fn with this engine as context and shape the rows to the declared
         relation."""
         udtf = self.registry.get_udtf(op.name)
-        data = udtf.fn(self, **dict(op.args))
+        args = dict(op.args)
+        for entry in udtf.init_args:  # declared defaults (3-tuples)
+            if len(entry) == 3 and entry[0] not in args:
+                args[entry[0]] = entry[2]
+        data = udtf.fn(self, **args)
         rel = Relation(list(udtf.relation))
         hb = HostBatch.from_pydict(data, relation=rel, time_cols=())
         return hb

@@ -93,8 +93,10 @@ class Collector:
             self._stop.wait(timeout=max(0.0, wake - time.monotonic()))
 
     def _push(self, dt: DataTable) -> None:
+        if self._push_cb is None:
+            return  # keep buffering until a callback is wired
         records = dt.drain()
-        if records is None or self._push_cb is None:
+        if records is None:
             return
         n = len(next(iter(records.values())))
         self._push_cb(dt.name, dt.relation, records)

@@ -75,9 +75,6 @@ class DistributedPlan:
 
 
 class Coordinator:
-    def __init__(self, registry=None):
-        self.registry = registry
-
     def assign(
         self, split: BlockingSplitPlan, state: DistributedState
     ) -> DistributedPlan:

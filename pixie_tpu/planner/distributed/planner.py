@@ -23,7 +23,7 @@ class DistributedPlanner:
 
     def __init__(self, registry=None):
         self.splitter = Splitter(registry)
-        self.coordinator = Coordinator(registry)
+        self.coordinator = Coordinator()
 
     def plan(
         self, logical_plan: Plan, state: DistributedState, mesh=None

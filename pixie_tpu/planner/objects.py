@@ -404,10 +404,11 @@ class GroupbyObj:
         aggs = []
         registry = self.df.builder.registry
         for out_name, spec in kwargs.items():
-            if not (isinstance(spec, tuple) and len(spec) == 2):
+            if not (isinstance(spec, tuple) and len(spec) >= 2):
                 raise PxLError(
-                    f"agg {out_name}= must be a ('column', px.fn) tuple", lineno)
-            col, fn = spec
+                    f"agg {out_name}= must be a ('column', px.fn[, args...]) "
+                    "tuple", lineno)
+            col, fn, *extra = spec
             if isinstance(fn, ScalarFuncMarker):
                 fn = AggFuncMarker(fn.name)
             if not isinstance(fn, AggFuncMarker):
@@ -418,12 +419,20 @@ class GroupbyObj:
                 arg = self.df.col(col, lineno).expr
             else:
                 arg = self.df.resolve_expr(col, what=f"agg {out_name}", lineno=lineno)
-            arg_t = infer_type(arg, self.df.relation, registry)
+            # Extra positional args for multi-arg UDAs, e.g.
+            # out=('lat', px.kmeans, 2) (ml_ops.h KMeansUDA's k).
+            args = [arg] + [
+                self.df.resolve_expr(e, what=f"agg {out_name}", lineno=lineno)
+                for e in extra
+            ]
+            arg_ts = [
+                infer_type(a, self.df.relation, registry) for a in args
+            ]
             try:
-                uda = registry.get_uda(fn.name, [arg_t])
+                uda = registry.get_uda(fn.name, arg_ts)
             except SignatureError as e:
                 raise PxLError(str(e), lineno)
-            aggs.append((AggExpr(out_name, fn.name, (arg,)), uda.return_type))
+            aggs.append((AggExpr(out_name, fn.name, tuple(args)), uda.return_type))
 
         items = [(c, self.df.relation.col_type(c)) for c in self.by]
         items += [(ae.out_name, rt) for ae, rt in aggs]

@@ -10,6 +10,7 @@ from . import (
     json_ops,
     math_ops,
     math_sketches,
+    ml_ops,
     regex_ops,
     sql_ops,
     string_ops,
@@ -25,4 +26,5 @@ def register_all(reg):
     json_ops.register(reg)
     regex_ops.register(reg)
     sql_ops.register(reg)
+    ml_ops.register(reg)
     introspection.register_introspection(reg)

@@ -1,0 +1,98 @@
+"""ML UDAs: streaming k-means and reservoir sampling.
+
+Reference parity: ``src/carnot/funcs/builtins/ml_ops.h`` — ``KMeansUDA``
+(:88: coreset update/merge, finalize runs kmeans and emits the centroids
+as a string) and ``ReservoirSampleUDA`` (:145: uniform sample with
+count-weighted merge). The transformer/sentencepiece UDFs (:52,:68) wrap
+a TFLite model pool and stay out of scope — they are model-serving, not
+engine, surface.
+
+The carries are bottom-k priority sketches (``pixie_tpu.ops.ml``):
+associative merges, so partial aggregation and cross-device folds work
+unchanged.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops import ml
+from ..udf import FLOAT64, INT64, STRING
+
+KMEANS_K_MAX = 8
+KMEANS_FIELDS = tuple(f"c{i}" for i in range(KMEANS_K_MAX))
+CORESET_CAPACITY = 256
+
+
+def _kmeans_init(g):
+    res = ml.reservoir_init(g, CORESET_CAPACITY)
+    return (*res, jnp.zeros((g,), dtype=jnp.int32))  # + per-group k
+
+
+def _kmeans_update(carry, gids, mask, values, k):
+    import jax
+
+    *res, k_carry = carry
+    g = carry[0].shape[0]
+    res = ml.reservoir_update(tuple(res), gids, mask, values)
+    k_new = jnp.maximum(
+        k_carry,
+        jax.ops.segment_max(
+            jnp.where(mask, k, 0).astype(jnp.int32),
+            jnp.where(mask, gids, g),
+            num_segments=g + 1,
+        )[:-1],
+    )
+    return (*res, k_new)
+
+
+def _kmeans_merge(a, b):
+    *ra, ka = a
+    *rb, kb = b
+    return (*ml.reservoir_merge(tuple(ra), tuple(rb)), jnp.maximum(ka, kb))
+
+
+def _kmeans_finalize(carry):
+    vals, prio, _count, k = carry
+    k = jnp.clip(k, 1, KMEANS_K_MAX)
+    return ml.kmeans_groups(vals, prio < ml._EMPTY, KMEANS_K_MAX, k)
+
+
+def _reservoir_update(carry, gids, mask, values):
+    return ml.reservoir_update(carry, gids, mask, values)
+
+
+def register(reg):
+    reg.uda(
+        "kmeans",
+        (FLOAT64, INT64),
+        STRING,
+        init=_kmeans_init,
+        update=_kmeans_update,
+        merge=_kmeans_merge,
+        finalize=_kmeans_finalize,
+        struct_fields=KMEANS_FIELDS,
+        doc=(
+            "Streaming 1-D k-means over the group: kmeans(value, k). "
+            f"Centroids beyond k (max {KMEANS_K_MAX}) are NaN; the carry "
+            "is a mergeable bottom-k coreset."
+        ),
+    )
+    # Samples must be bit-exact elements of the data: the INT64 overload
+    # keeps an int64 reservoir (no float32 round trip).
+    for dt, jdt, empty in (
+        (FLOAT64, jnp.float32, jnp.nan),
+        (INT64, jnp.int64, 0),
+    ):
+        reg.uda(
+            "reservoir_sample",
+            (dt,),
+            dt,
+            init=lambda g, _jdt=jdt: ml.reservoir_init(g, 1, _jdt),
+            update=_reservoir_update,
+            merge=ml.reservoir_merge,
+            finalize=lambda c, _e=empty: jnp.where(
+                c[1][:, 0] < ml._EMPTY, c[0][:, 0], _e
+            ),
+            doc="Uniform random sample of one group element (mergeable).",
+        )

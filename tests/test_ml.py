@@ -1,0 +1,138 @@
+"""ML exec tests: kmeans, reservoir sketches, UDAs (ml_ops parity)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec import Engine
+from pixie_tpu.ops import ml
+
+
+class TestReservoir:
+    def test_bottom_k_is_uniformish(self):
+        import jax.numpy as jnp
+
+        g, c, n = 1, 64, 8192
+        vals = np.arange(n, dtype=np.float64)
+        carry = ml.reservoir_init(g, c)
+        carry = ml.reservoir_update(
+            carry, jnp.zeros(n, dtype=jnp.int32), jnp.ones(n, dtype=bool), jnp.asarray(vals)
+        )
+        sampled = np.asarray(carry[0][0])
+        assert float(carry[2][0]) == n
+        # Uniform sample of 64 from [0, 8192): mean near 4096.
+        assert 2500 < sampled.mean() < 5700
+
+    def test_merge_associative(self):
+        import jax.numpy as jnp
+
+        g, c = 2, 8
+        rng = np.random.default_rng(0)
+
+        def mk(seed):
+            n = 500
+            v = jnp.asarray(rng.normal(seed, 1, n).astype(np.float32))
+            gid = jnp.asarray(rng.integers(0, g, n).astype(np.int32))
+            return ml.reservoir_update(
+                ml.reservoir_init(g, c), gid, jnp.ones(n, bool), v
+            )
+
+        a, b, d = mk(0), mk(5), mk(10)
+        left = ml.reservoir_merge(ml.reservoir_merge(a, b), d)
+        right = ml.reservoir_merge(a, ml.reservoir_merge(b, d))
+        np.testing.assert_allclose(
+            np.sort(np.asarray(left[1])), np.sort(np.asarray(right[1])), rtol=1e-6
+        )
+        np.testing.assert_array_equal(np.asarray(left[2]), np.asarray(right[2]))
+
+
+class TestKMeans:
+    def test_kmeans_fit_separated_clusters(self):
+        rng = np.random.default_rng(1)
+        pts = np.concatenate(
+            [
+                rng.normal([0, 0], 0.1, (100, 2)),
+                rng.normal([5, 5], 0.1, (100, 2)),
+                rng.normal([0, 5], 0.1, (100, 2)),
+            ]
+        ).astype(np.float32)
+        cent = np.asarray(ml.kmeans_fit(pts, k=3))
+        found = {tuple(np.round(c).astype(int)) for c in cent}
+        assert found == {(0, 0), (5, 5), (0, 5)}
+
+    def test_kmeans_groups_1d(self):
+        import jax.numpy as jnp
+
+        samples = jnp.asarray(
+            [[1.0, 1.1, 0.9, 10.0, 10.1, 9.9, 0, 0]], dtype=jnp.float32
+        )
+        mask = jnp.asarray([[1, 1, 1, 1, 1, 1, 0, 0]], dtype=bool)
+        cent = np.asarray(ml.kmeans_groups(samples, mask, 4, jnp.asarray([2])))
+        real = cent[0][~np.isnan(cent[0])]
+        np.testing.assert_allclose(sorted(real), [1.0, 10.0], atol=0.2)
+
+
+class TestMLUdas:
+    @pytest.fixture
+    def engine(self):
+        e = Engine()
+        rng = np.random.default_rng(2)
+        n = 5000
+        svc = np.array([f"s{i%2}" for i in range(n)])
+        lat = np.where(
+            svc == "s0",
+            rng.choice([10.0, 100.0], n),
+            rng.choice([1000.0, 5000.0], n),
+        )
+        e.append_data(
+            "events",
+            {
+                "time_": np.arange(n, dtype=np.int64),
+                "service": list(svc),
+                "lat": lat,
+            },
+        )
+        return e
+
+    def test_kmeans_uda(self, engine):
+        out = engine.execute_query(
+            "import px\n"
+            "df = px.DataFrame(table='events')\n"
+            "df = df.groupby('service').agg(c=('lat', px.kmeans, 2))\n"
+            "px.display(df, 'o')\n"
+        )["o"].to_pydict()
+        by_svc = dict(zip(out["service"], out["c"]))
+        c0 = json.loads(by_svc["s0"])
+        got = sorted(v for v in c0.values() if v == v)  # drop NaN
+        np.testing.assert_allclose(got, [10.0, 100.0], atol=5)
+        c1 = json.loads(by_svc["s1"])
+        got1 = sorted(v for v in c1.values() if v == v)
+        np.testing.assert_allclose(got1, [1000.0, 5000.0], atol=200)
+
+    def test_reservoir_sample_int64_bit_exact(self):
+        e = Engine()
+        big = 10**15 + 7  # not representable in float32
+        e.append_data(
+            "t",
+            {"time_": np.arange(4, dtype=np.int64),
+             "v": np.full(4, big, dtype=np.int64)},
+        )
+        out = e.execute_query(
+            "import px\n"
+            "df = px.DataFrame(table='t')\n"
+            "df = df.agg(s=('v', px.reservoir_sample))\n"
+            "px.display(df, 'o')\n"
+        )["o"].to_pydict()
+        assert int(out["s"][0]) == big
+
+    def test_reservoir_sample_uda(self, engine):
+        out = engine.execute_query(
+            "import px\n"
+            "df = px.DataFrame(table='events')\n"
+            "df = df.groupby('service').agg(s=('lat', px.reservoir_sample))\n"
+            "px.display(df, 'o')\n"
+        )["o"].to_pydict()
+        by_svc = dict(zip(out["service"], out["s"]))
+        assert by_svc["s0"] in (10.0, 100.0)
+        assert by_svc["s1"] in (1000.0, 5000.0)
