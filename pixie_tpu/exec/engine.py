@@ -40,6 +40,7 @@ from .plan import (
     LimitOp,
     MapOp,
     MemorySourceOp,
+    OTelExportSinkOp,
     Plan,
     ResultSinkOp,
     UDTFSourceOp,
@@ -299,6 +300,11 @@ class Engine:
                 results[nid] = _union_host(mats)
             elif isinstance(op, ResultSinkOp):
                 outputs[op.name] = mat_input(node.inputs[0])
+            elif isinstance(op, OTelExportSinkOp):
+                from .otel import batch_to_otlp
+
+                payload = batch_to_otlp(mat_input(node.inputs[0]), op.spec)
+                self.export_otel(payload, op.spec.endpoint)
             elif isinstance(op, BridgeSinkOp):
                 outputs[("bridge", op.bridge_id)] = self._bridge_payload(
                     results[node.inputs[0]]
@@ -313,6 +319,14 @@ class Engine:
             if consumers.get(nid, 0) > 1 and isinstance(results[nid], _Stream):
                 results[nid] = self._materialize(results[nid])
         return outputs
+
+    def export_otel(self, payload: dict, endpoint) -> None:
+        """OTel egress. Default: collect in-memory (``otel_exports``);
+        deployments override/replace with an OTLP pusher (the reference
+        ships over OTLP gRPC — grpc is gated in this environment)."""
+        if not hasattr(self, "otel_exports"):
+            self.otel_exports = []
+        self.otel_exports.append({"endpoint": endpoint, "payload": payload})
 
     def _run_udtf(self, op: UDTFSourceOp) -> HostBatch:
         """Execute a UDTF source (``udtf_source_node.h`` analog): call its

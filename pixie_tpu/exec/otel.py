@@ -1,0 +1,193 @@
+"""OTel export: result batches -> OTLP-shaped payloads.
+
+Reference parity: ``src/carnot/exec/otel_export_sink_node.{h,cc}``
+(``:40``) — converts RowBatches into OpenTelemetry metrics/spans and
+ships them over OTLP gRPC; the planner side is the ``px.otel`` module
+(``planner/objects/otel.h:35``). Payloads here are the OTLP JSON
+encoding (ResourceMetrics / ResourceSpans dicts); the transport is a
+pluggable exporter callback — in-memory collection by default, an OTLP
+HTTP/gRPC pusher where the deployment provides one (grpc is gated: not
+part of the baked environment).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class OTelEndpointConfig:
+    url: str = ""
+    headers: tuple = ()  # tuple[(k, v)]
+    insecure: bool = False
+
+
+@dataclass(frozen=True)
+class OTelMetricGauge:
+    name: str
+    value_column: str
+    attributes: tuple = ()  # tuple[(attr name, column name)]
+    unit: str = ""
+    description: str = ""
+
+
+@dataclass(frozen=True)
+class OTelMetricSummary:
+    """Quantile summary metric: columns per quantile point."""
+
+    name: str
+    count_column: str
+    quantile_columns: tuple = ()  # tuple[(q float, column name)]
+    attributes: tuple = ()
+    unit: str = ""
+    description: str = ""
+
+
+@dataclass(frozen=True)
+class OTelSpan:
+    name: str  # literal name or (when name_is_column) a column
+    start_time_column: str = "time_"
+    end_time_column: str = "end_time"
+    attributes: tuple = ()
+    name_is_column: bool = False
+
+
+@dataclass(frozen=True)
+class OTelDataSpec:
+    endpoint: OTelEndpointConfig = field(default_factory=OTelEndpointConfig)
+    resource: tuple = ()  # tuple[(attr, literal str or ("column", name))]
+    data: tuple = ()  # tuple[Gauge | Summary | Span]
+
+    def referenced_columns(self) -> set:
+        cols = set()
+        for _a, v in self.resource:
+            if isinstance(v, tuple) and v[0] == "column":
+                cols.add(v[1])
+        for d in self.data:
+            if isinstance(d, OTelMetricGauge):
+                cols.add(d.value_column)
+            elif isinstance(d, OTelMetricSummary):
+                cols.add(d.count_column)
+                cols.update(c for _q, c in d.quantile_columns)
+            elif isinstance(d, OTelSpan):
+                cols.update({d.start_time_column, d.end_time_column})
+                if d.name_is_column:
+                    cols.add(d.name)
+            cols.update(c for _a, c in getattr(d, "attributes", ()))
+        return cols
+
+
+def _attr_kvs(pairs):
+    return [
+        {"key": k, "value": {"stringValue": str(v)}} for k, v in pairs
+    ]
+
+
+def batch_to_otlp(hb, spec: OTelDataSpec) -> dict:
+    """One HostBatch -> {'resourceMetrics': [...], 'resourceSpans': [...]}.
+
+    Rows are split by their resource-attribute values — one
+    ResourceMetrics/ResourceSpans entry per distinct resource, as the
+    reference sink does (otel_export_sink_node.cc groups by resource).
+    """
+    d = hb.to_pydict()
+    n = hb.length
+
+    res_cols = [
+        v[1]
+        for _a, v in spec.resource
+        if isinstance(v, tuple) and v[0] == "column"
+    ]
+    groups: dict[tuple, list[int]] = {}
+    for i in range(n):
+        groups.setdefault(tuple(d[c][i] for c in res_cols), []).append(i)
+    if not groups:
+        groups[()] = []
+
+    def resource_attrs(key: tuple):
+        out, it = [], iter(key)
+        for attr, v in spec.resource:
+            if isinstance(v, tuple) and v[0] == "column":
+                out.append((attr, next(it)))
+            else:
+                out.append((attr, v))
+        return _attr_kvs(out)
+
+    payload: dict = {}
+    for key, rows in groups.items():
+        gauges, summaries, spans = [], [], []
+        for item in spec.data:
+            if isinstance(item, OTelMetricGauge):
+                pts = [
+                    {
+                        "timeUnixNano": int(d["time_"][i]) if "time_" in d else 0,
+                        "asDouble": float(d[item.value_column][i]),
+                        "attributes": _attr_kvs(
+                            (a, d[c][i]) for a, c in item.attributes
+                        ),
+                    }
+                    for i in rows
+                ]
+                gauges.append(
+                    {
+                        "name": item.name,
+                        "unit": item.unit,
+                        "description": item.description,
+                        "gauge": {"dataPoints": pts},
+                    }
+                )
+            elif isinstance(item, OTelMetricSummary):
+                pts = [
+                    {
+                        "timeUnixNano": int(d["time_"][i]) if "time_" in d else 0,
+                        "count": int(d[item.count_column][i]),
+                        "quantileValues": [
+                            {"quantile": q, "value": float(d[c][i])}
+                            for q, c in item.quantile_columns
+                        ],
+                        "attributes": _attr_kvs(
+                            (a, d[c][i]) for a, c in item.attributes
+                        ),
+                    }
+                    for i in rows
+                ]
+                summaries.append(
+                    {
+                        "name": item.name,
+                        "unit": item.unit,
+                        "description": item.description,
+                        "summary": {"dataPoints": pts},
+                    }
+                )
+            elif isinstance(item, OTelSpan):
+                for i in rows:
+                    spans.append(
+                        {
+                            "name": (
+                                str(d[item.name][i])
+                                if item.name_is_column
+                                else item.name
+                            ),
+                            "startTimeUnixNano": int(d[item.start_time_column][i]),
+                            "endTimeUnixNano": int(d[item.end_time_column][i]),
+                            "attributes": _attr_kvs(
+                                (a, d[c][i]) for a, c in item.attributes
+                            ),
+                        }
+                    )
+        metrics = gauges + summaries
+        if metrics:
+            payload.setdefault("resourceMetrics", []).append(
+                {
+                    "resource": {"attributes": resource_attrs(key)},
+                    "scopeMetrics": [{"metrics": metrics}],
+                }
+            )
+        if spans:
+            payload.setdefault("resourceSpans", []).append(
+                {
+                    "resource": {"attributes": resource_attrs(key)},
+                    "scopeSpans": [{"spans": spans}],
+                }
+            )
+    return payload

@@ -180,6 +180,17 @@ class BridgeSourceOp(Op):
 
 
 @dataclass(frozen=True)
+class OTelExportSinkOp(Op):
+    """Export result rows as OTel metrics/spans.
+
+    Reference: ``src/carnot/exec/otel_export_sink_node.h:40``; ``spec``
+    is an ``exec.otel.OTelDataSpec``.
+    """
+
+    spec: object = None
+
+
+@dataclass(frozen=True)
 class ResultSinkOp(Op):
     """Terminal sink: materialize to the client result stream.
 

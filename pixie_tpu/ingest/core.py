@@ -46,10 +46,24 @@ class DataTable:
     the push period fires (or the buffer crosses its size threshold).
     """
 
-    def __init__(self, name: str, relation: Relation, push_threshold_rows: int = 1 << 16):
+    def __init__(
+        self,
+        name: str,
+        relation: Relation,
+        push_threshold_rows: int = 1 << 16,
+        max_buffer_rows: int | None = None,
+    ):
         self.name = name
         self.relation = relation
         self.push_threshold_rows = push_threshold_rows
+        # Hard cap when no consumer drains us (e.g. collector started
+        # before a push callback is wired): drop oldest, count the loss
+        # (the reference DataTable expires oldest on occupancy too).
+        self.max_buffer_rows = (
+            max_buffer_rows if max_buffer_rows is not None
+            else 4 * push_threshold_rows
+        )
+        self.rows_dropped = 0
         # append runs on the collector thread, drain on flush callers —
         # guard both (records landing mid-drain must not be lost).
         self._lock = threading.Lock()
@@ -63,6 +77,11 @@ class DataTable:
         with self._lock:
             self._pending.append(records)
             self._pending_rows += n
+            while self._pending_rows > self.max_buffer_rows and len(self._pending) > 1:
+                dropped = self._pending.pop(0)
+                m = len(next(iter(dropped.values())))
+                self._pending_rows -= m
+                self.rows_dropped += m
 
     @property
     def pending_rows(self) -> int:

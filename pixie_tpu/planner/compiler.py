@@ -64,10 +64,11 @@ def compile_pxl(query: str, state: CompilerState) -> CompiledScript:
     px = PxModule(builder, state.now_ns)
     visitor = ASTVisitor(px)
     visitor.run(tree)
-    if not builder.sinks:
+    if not builder.sinks and not builder.n_exports:
         raise PxLError(
-            "script produced no output tables; call px.display(df) (or the "
-            "script only defines functions — call one and display its result)"
+            "script produced no output tables; call px.display(df) or "
+            "px.export(df, ...) (or the script only defines functions — "
+            "call one and display its result)"
         )
     run_rules(builder.plan, state.max_output_rows)
     return CompiledScript(

@@ -68,7 +68,11 @@ class BlockingSplitPlan:
 
 def _is_blocking(op: Op) -> bool:
     """Blocking = cannot run shard-local without a cross-agent exchange."""
-    return isinstance(op, (AggOp, JoinOp, UnionOp, LimitOp, ResultSinkOp))
+    from ...exec.plan import OTelExportSinkOp
+
+    return isinstance(
+        op, (AggOp, JoinOp, UnionOp, LimitOp, ResultSinkOp, OTelExportSinkOp)
+    )
 
 
 class Splitter:

@@ -469,6 +469,7 @@ class PlanBuilder:
     registry: object
     max_groups: int = 4096
     sinks: list = field(default_factory=list)  # output names in display order
+    n_exports: int = 0  # OTel export sinks (outputs without a name)
 
     def source(self, table: str, select=None, start_time=None, stop_time=None,
                lineno=None) -> DataFrameObj:
@@ -535,3 +536,20 @@ class PlanBuilder:
             raise PxLError(f"duplicate output table name {name!r}", lineno)
         self.plan.add(ResultSinkOp(name), [df.node_id])
         self.sinks.append(name)
+
+    def export_otel(self, df: DataFrameObj, spec, lineno=None):
+        from ..exec.plan import OTelExportSinkOp
+
+        if not isinstance(df, DataFrameObj):
+            raise PxLError("px.export() expects a DataFrame", lineno)
+        missing = {
+            c
+            for c in spec.referenced_columns()
+            if not df.relation.has_column(c)
+        }
+        if missing:
+            raise PxLError(
+                f"px.export: columns {sorted(missing)} not in dataframe "
+                f"{df.relation}", lineno)
+        self.plan.add(OTelExportSinkOp(spec), [df.node_id])
+        self.n_exports += 1

@@ -121,6 +121,17 @@ class PxModule:
     def display(self, df, name: str = "output"):
         self._builder.display(df, name)
 
+    def export(self, df, spec):
+        """px.export(df, px.otel.Data(...)) — OTel exporter surface
+        (``planner/objects/exporter.h``)."""
+        self._builder.export_otel(df, spec)
+
+    @property
+    def otel(self):
+        from .otel_module import OTelModule
+
+        return OTelModule()
+
     def debug(self, df, name: str = "debug"):
         self._builder.display(df, "_" + name)
 

@@ -78,10 +78,11 @@ def register(reg):
             "is a mergeable bottom-k coreset."
         ),
     )
-    # Samples must be bit-exact elements of the data: the INT64 overload
-    # keeps an int64 reservoir (no float32 round trip).
+    # Samples must be bit-exact elements of the data: each overload keeps
+    # a reservoir of the input's full-precision dtype (x64 is enabled —
+    # no float32 round trip).
     for dt, jdt, empty in (
-        (FLOAT64, jnp.float32, jnp.nan),
+        (FLOAT64, jnp.float64, jnp.nan),
         (INT64, jnp.int64, 0),
     ):
         reg.uda(

@@ -1,0 +1,13 @@
+"""Shared utilities: datastore, bloom filter, UPID."""
+
+from .bloomfilter import BloomFilter
+from .datastore import Datastore, MemoryDatastore, SqliteDatastore
+from .upid import UPID
+
+__all__ = [
+    "BloomFilter",
+    "Datastore",
+    "MemoryDatastore",
+    "SqliteDatastore",
+    "UPID",
+]

@@ -1,0 +1,163 @@
+"""OTel export sink tests (otel_export_sink_node + px.otel parity)."""
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec import Engine
+
+
+@pytest.fixture
+def engine():
+    e = Engine()
+    rng = np.random.default_rng(0)
+    n = 1000
+    lat = rng.integers(1000, 1_000_000, n)
+    e.append_data(
+        "http_events",
+        {
+            "time_": np.arange(n, dtype=np.int64),
+            "latency_ns": lat,
+            "end_time": np.arange(n, dtype=np.int64) + lat,
+            "resp_status": rng.choice(np.array([200, 500]), n),
+            "service": [f"svc-{i % 3}" for i in range(n)],
+        },
+        time_cols=("time_", "end_time"),
+    )
+    return e
+
+
+QUERY = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.groupby('service').agg(
+    count=('latency_ns', px.count),
+    lat=('latency_ns', px.quantiles),
+)
+df.p50 = px.pluck_float64(df.lat, 'p50')
+df.p99 = px.pluck_float64(df.lat, 'p99')
+df = df[['service', 'count', 'p50', 'p99']]
+px.export(df, px.otel.Data(
+    endpoint=px.otel.Endpoint(url='otel.example.com:4317'),
+    resource={'service.name': df.service, 'k8s.cluster.name': 'test'},
+    data=[
+        px.otel.metric.Summary(
+            name='http.latency',
+            count=df.count,
+            quantile_values={0.5: df.p50, 0.99: df.p99},
+        ),
+    ],
+))
+"""
+
+
+class TestOTelExport:
+    def test_summary_metrics_per_resource(self, engine):
+        engine.execute_query(QUERY)
+        exports = engine.otel_exports
+        assert len(exports) == 1
+        assert exports[0]["endpoint"].url == "otel.example.com:4317"
+        rms = exports[0]["payload"]["resourceMetrics"]
+        # One resource per distinct service.
+        assert len(rms) == 3
+        attrs = {
+            kv["key"]: kv["value"]["stringValue"]
+            for kv in rms[0]["resource"]["attributes"]
+        }
+        assert attrs["k8s.cluster.name"] == "test"
+        assert attrs["service.name"].startswith("svc-")
+        m = rms[0]["scopeMetrics"][0]["metrics"][0]
+        assert m["name"] == "http.latency"
+        pt = m["summary"]["dataPoints"][0]
+        assert pt["count"] > 0
+        assert [q["quantile"] for q in pt["quantileValues"]] == [0.5, 0.99]
+
+    def test_gauge_and_span(self, engine):
+        engine.execute_query(
+            "import px\n"
+            "df = px.DataFrame(table='http_events')\n"
+            "df = df.head(50)\n"
+            "px.export(df, px.otel.Data(\n"
+            "    resource={'service.name': df.service},\n"
+            "    data=[\n"
+            "        px.otel.metric.Gauge(name='http.latency', value=df.latency_ns,\n"
+            "                             attributes={'status': df.resp_status}),\n"
+            "        px.otel.trace.Span(name='http.request', start_time=df.time_,\n"
+            "                           end_time=df.end_time),\n"
+            "    ],\n"
+            "))\n"
+        )
+        payload = engine.otel_exports[0]["payload"]
+        n_pts = sum(
+            len(m["gauge"]["dataPoints"])
+            for rm in payload["resourceMetrics"]
+            for m in rm["scopeMetrics"][0]["metrics"]
+        )
+        n_spans = sum(
+            len(ss["spans"])
+            for rs in payload["resourceSpans"]
+            for ss in rs["scopeSpans"]
+        )
+        assert n_pts == 50 and n_spans == 50
+        span = payload["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+        assert span["endTimeUnixNano"] > span["startTimeUnixNano"]
+
+    def test_unknown_column_rejected(self, engine):
+        from pixie_tpu.planner.objects import PxLError
+
+        with pytest.raises(PxLError, match="does not exist|not in dataframe"):
+            engine.execute_query(
+                "import px\n"
+                "df = px.DataFrame(table='http_events')\n"
+                "px.export(df, px.otel.Data(\n"
+                "    data=[px.otel.metric.Gauge(name='x', value=df.nope)],\n"
+                "))\n"
+            )
+
+    def test_export_through_cluster(self):
+        """OTel sink runs on the merge tier in agent mode."""
+        import time
+
+        from pixie_tpu.services import (
+            AgentTracker,
+            KelvinAgent,
+            MessageBus,
+            PEMAgent,
+            QueryBroker,
+        )
+
+        bus = MessageBus()
+        tracker = AgentTracker(bus, expiry_s=60, check_interval_s=60)
+        pem = PEMAgent(bus, "pem-0", heartbeat_interval_s=0.05).start()
+        kelvin = KelvinAgent(bus, "kelvin-0", heartbeat_interval_s=0.05).start()
+        pem.append_data(
+            "http_events",
+            {
+                "time_": np.arange(100, dtype=np.int64),
+                "latency_ns": np.arange(100, dtype=np.int64) * 1000,
+                "service": ["a"] * 100,
+            },
+        )
+        pem._register()
+        deadline = time.time() + 5
+        while time.time() < deadline and len(tracker.schemas()) < 1:
+            time.sleep(0.01)
+        broker = QueryBroker(bus, tracker)
+        try:
+            broker.execute_script(
+                "import px\n"
+                "df = px.DataFrame(table='http_events')\n"
+                "df = df.groupby('service').agg(n=('latency_ns', px.count))\n"
+                "px.export(df, px.otel.Data(\n"
+                "    resource={'service.name': df.service},\n"
+                "    data=[px.otel.metric.Gauge(name='n', value=df.n)],\n"
+                "))\n"
+                "px.display(df, 'o')\n",
+                timeout_s=60,
+            )
+            assert len(kelvin.engine.otel_exports) == 1
+            assert not hasattr(pem.engine, "otel_exports") or not pem.engine.otel_exports
+        finally:
+            for a in (pem, kelvin):
+                a.stop()
+            tracker.close()
+            bus.close()

@@ -1,0 +1,90 @@
+"""Datastore, bloom filter, UPID tests."""
+
+import numpy as np
+import pytest
+
+from pixie_tpu.utils import BloomFilter, MemoryDatastore, SqliteDatastore, UPID
+from pixie_tpu.utils.upid import pack_planes, unpack_planes
+
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite"])
+class TestDatastore:
+    def _mk(self, backend, tmp_path):
+        if backend == "memory":
+            return MemoryDatastore()
+        return SqliteDatastore(str(tmp_path / "kv.db"))
+
+    def test_crud(self, backend, tmp_path):
+        ds = self._mk(backend, tmp_path)
+        assert ds.get("a") is None
+        ds.set("a", b"1")
+        ds.set("a", b"2")  # upsert
+        assert ds.get("a") == b"2"
+        ds.delete("a")
+        assert ds.get("a") is None
+
+    def test_prefix_scan(self, backend, tmp_path):
+        ds = self._mk(backend, tmp_path)
+        for k in ("agent/1", "agent/2", "tracepoint/1"):
+            ds.set(k, k.encode())
+        got = ds.get_with_prefix("agent/")
+        assert [k for k, _ in got] == ["agent/1", "agent/2"]
+        ds.delete_with_prefix("agent/")
+        assert ds.get_with_prefix("agent/") == []
+        assert ds.get("tracepoint/1") == b"tracepoint/1"
+
+
+def test_sqlite_persists(tmp_path):
+    p = str(tmp_path / "kv.db")
+    ds = SqliteDatastore(p)
+    ds.set("cron/1", b"script")
+    ds.close()
+    ds2 = SqliteDatastore(p)
+    assert ds2.get("cron/1") == b"script"
+
+
+class TestBloomFilter:
+    def test_membership(self):
+        bf = BloomFilter(1000, 0.01)
+        items = [f"pod-{i}" for i in range(500)]
+        for it in items:
+            bf.insert(it)
+        assert all(bf.contains(it) for it in items)
+        fp = sum(bf.contains(f"other-{i}") for i in range(2000))
+        assert fp < 2000 * 0.05  # within a few x of the 1% target
+
+    def test_serialization_round_trip(self):
+        bf = BloomFilter(100)
+        bf.insert("svc/default/frontend")
+        data = bf.to_bytes()
+        bf2 = BloomFilter.from_bytes(data)
+        assert bf2.contains("svc/default/frontend")
+        assert not bf2.contains("svc/default/backend")
+
+
+class TestUPID:
+    def test_pack_unpack(self):
+        u = UPID(asid=7, pid=1234, start_ts=1_700_000_000_000_000_000)
+        v = u.value()
+        assert UPID.from_value(v) == u
+        assert UPID.parse(str(u)) == u
+
+    def test_planes_round_trip(self):
+        ups = [UPID(1, 2, 3), UPID(0xFFFFFFFF, 0xFFFFFFFF, 2**64 - 1)]
+        hi, lo = pack_planes(ups)
+        assert hi.dtype == np.uint64
+        assert unpack_planes(hi, lo) == ups
+
+    def test_device_column_round_trip(self):
+        from pixie_tpu.types.batch import HostBatch
+        from pixie_tpu.types.dtypes import DataType
+        from pixie_tpu.types.relation import Relation
+
+        ups = [UPID(5, 99, 123456789), UPID(6, 100, 987654321)]
+        hi, lo = pack_planes(ups)
+        hb = HostBatch.from_pydict(
+            {"upid": np.stack([hi, lo], axis=1)},
+            relation=Relation([("upid", DataType.UINT128)]),
+        )
+        back = hb.to_device().to_host().to_pydict()["upid"]
+        assert unpack_planes(back[:, 0], back[:, 1]) == ups
