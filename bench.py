@@ -1,18 +1,29 @@
-"""Headline benchmark: px/http_stats-class query throughput (rows/sec).
+"""Headline benchmark: the five BASELINE query shapes (rows/sec).
 
-Runs BASELINE.json configs[0] — filter + group-by aggregate over an
-http_events replay — through the single-chip engine, streaming fixed-size
-windows device-side, and compares against a vectorized numpy CPU baseline
-(stand-in for CPU Carnot, whose repo publishes no absolute numbers —
-SURVEY.md §6).
+Runs every BASELINE.json config through the real PxL frontend
+(``Engine.execute_query``) over synthetic replays pushed through the
+table-store ingest path, cross-checks each result against a vectorized
+numpy implementation (stand-in for CPU Carnot, whose repo publishes no
+absolute numbers — SURVEY.md §6), and prints ONE JSON line:
 
-Prints ONE JSON line:
-  {"metric": ..., "value": rows/sec, "unit": "rows/s", "vs_baseline": x}
+  {"metric": "http_stats_rows_per_sec", "value": rows/s, "unit": "rows/s",
+   "vs_baseline": x, "device": "tpu"|"cpu", "shapes": {per-shape results}}
+
+Self-configuring for the driver environment: the default invocation is a
+launcher that runs the actual benchmark in a subprocess — first against
+the TPU backend (with retries: the axon tunnel can be transiently
+UNAVAILABLE, see BENCH_r01.json), then falling back to CPU with the axon
+plugin disabled (PALLAS_AXON_POOL_IPS must be cleared before interpreter
+boot; clearing it in-process is too late — tests/conftest.py).
 
 Environment knobs:
-  PIXIE_TPU_BENCH_ROWS    total replay rows (default 16M)
-  PIXIE_TPU_BENCH_WINDOW  window rows per device dispatch (default 2^21)
+  PIXIE_TPU_BENCH_ROWS     http_events replay rows (default 16M TPU / 2M CPU)
+  PIXIE_TPU_BENCH_WINDOW   window rows per device dispatch (default 2^21)
+  PIXIE_TPU_BENCH_BUDGET   launcher wall-clock budget in seconds (default 540)
+  PIXIE_TPU_BENCH_SHAPES   comma list of shapes to run (default all five)
 """
+
+from __future__ import annotations
 
 import json
 import os
@@ -21,170 +32,549 @@ import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+from pixie_tpu.utils.cache import jax_cache_dir  # noqa: E402
+
+CACHE_DIR = os.environ.get("JAX_COMPILATION_CACHE_DIR", jax_cache_dir())
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def gen_http_events(n, window):
-    """Pre-encoded http_events replay, chunked into HostBatch windows."""
+# ---------------------------------------------------------------------------
+# Launcher: subprocess orchestration so one bad backend never zeroes the run.
+# ---------------------------------------------------------------------------
+
+
+def _inner_env(platform: str, deadline_s: float) -> dict:
+    from pixie_tpu.utils.cache import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env() if platform == "cpu" else dict(os.environ)
+    if platform != "cpu":
+        env.pop("JAX_PLATFORMS", None)
+        env["JAX_COMPILATION_CACHE_DIR"] = CACHE_DIR
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    env["PIXIE_TPU_BENCH_INNER"] = "1"
+    env["PIXIE_TPU_BENCH_DEADLINE"] = str(int(deadline_s))
+    return env
+
+
+def _try_run(platform: str, timeout_s: float):
+    """Run the inner benchmark on `platform`; return parsed JSON or None."""
+    import subprocess
+
+    deadline = max(60.0, timeout_s - 30.0)
+    log(f"[bench] launching inner ({platform}, timeout {timeout_s:.0f}s)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_inner_env(platform, deadline),
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=None,  # stream live
+            timeout=timeout_s,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"[bench] inner ({platform}) timed out after {timeout_s:.0f}s")
+        return None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    log(f"[bench] inner ({platform}) rc={proc.returncode}, no JSON line")
+    return None
+
+
+def launcher() -> int:
+    budget = float(os.environ.get("PIXIE_TPU_BENCH_BUDGET", 540))
+    t0 = time.monotonic()
+    result = None
+    # TPU attempts: transient UNAVAILABLE from the tunnel is common; retry.
+    for attempt in range(2):
+        remaining = budget - (time.monotonic() - t0)
+        if remaining < 150:
+            break
+        tpu_timeout = min(420.0, remaining - 120.0)
+        if tpu_timeout < 90:
+            break
+        result = _try_run("tpu", tpu_timeout)
+        if result is not None:
+            break
+        if attempt == 0:
+            log("[bench] TPU attempt 1 failed; retrying")
+            time.sleep(10)
+        else:
+            log("[bench] TPU attempts exhausted")
+    if result is None:
+        remaining = budget - (time.monotonic() - t0)
+        cpu_timeout = max(90.0, remaining - 5.0)
+        os.environ.setdefault("PIXIE_TPU_BENCH_ROWS", str(2 * 1024 * 1024))
+        result = _try_run("cpu", cpu_timeout)
+    if result is None:
+        log("[bench] all backends failed")
+        return 1
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Inner benchmark: generate replays, run the five PxL shapes, cross-check.
+# ---------------------------------------------------------------------------
+
+
+def _codes(rng, n, vocab_len):
+    return rng.integers(0, vocab_len, n).astype(np.int32)
+
+
+def _push_encoded(eng, name, rel, col_fn, n, window, dicts):
+    """Push pre-encoded windows through the ingest path (append_data).
+
+    String columns arrive as dictionary ids sharing one StringDictionary —
+    the state a live collector's staging produces (strings are encoded at
+    the edge, SURVEY.md §7 stage 1); the first append makes the table
+    adopt these dictionaries so later windows append with zero remapping.
+    """
     from pixie_tpu.types.batch import HostBatch
-    from pixie_tpu.types.relation import Relation
+
+    for off in range(0, n, window):
+        m = min(window, n - off)
+        hb = HostBatch(
+            relation=rel, cols=col_fn(off, m), length=m, dicts=dicts
+        )
+        eng.append_data(name, hb)
+
+
+def _time_query(eng, query, n_rows, warm_eng=None):
+    """(rows/s, secs, result) for the steady-state run of a query.
+
+    Warm-up (trace + XLA compile, persisted in the compilation cache)
+    runs against ``warm_eng`` — a single-window clone of the replay — so
+    the full table is scanned once, not twice.
+    """
+    (warm_eng or eng).execute_query(query)
+    t0 = time.perf_counter()
+    out = eng.execute_query(query)
+    dt = time.perf_counter() - t0
+    return n_rows / dt, dt, out
+
+
+def _build_engines(name, rel, col_fn, n, window, dicts):
+    """(full engine, single-window warm engine) over the same replay."""
+    from pixie_tpu.exec.engine import Engine
+
+    eng = Engine(window_rows=window)
+    eng.create_table(name)
+    _push_encoded(eng, name, rel, col_fn, n, window, dicts)
+    warm = Engine(window_rows=window)
+    warm.create_table(name)
+    _push_encoded(warm, name, rel, col_fn, min(n, window), window, dicts)
+    return eng, warm
+
+
+def _shape_http_stats(n, window):
+    """configs[0]: filter + groupby-agg over http_events; also returns the
+    engine so service_stats reuses the same replay."""
     from pixie_tpu.types.dtypes import DataType
+    from pixie_tpu.types.relation import Relation
     from pixie_tpu.types.strings import StringDictionary
 
     rng = np.random.default_rng(7)
     services = [f"svc-{i}" for i in range(32)]
     paths = [f"/api/v1/ep{i}" for i in range(8)]
     svc_dict, path_dict = StringDictionary(services), StringDictionary(paths)
-    rel = Relation(
-        [
-            ("time_", DataType.TIME64NS),
-            ("latency_ns", DataType.INT64),
-            ("resp_status", DataType.INT64),
-            ("service", DataType.STRING),
-            ("req_path", DataType.STRING),
-        ]
-    )
-    batches = []
-    for off in range(0, n, window):
-        m = min(window, n - off)
-        cols = {
+    rel = Relation([
+        ("time_", DataType.TIME64NS),
+        ("latency_ns", DataType.INT64),
+        ("resp_status", DataType.INT64),
+        ("service", DataType.STRING),
+        ("req_path", DataType.STRING),
+    ])
+    statuses = np.array([200, 200, 200, 200, 404, 500])
+    svc_codes = _codes(rng, n, len(services))
+    path_codes = _codes(rng, n, len(paths))
+    lat = rng.integers(1_000, 100_000_000, n)
+    status = statuses[rng.integers(0, len(statuses), n)].astype(np.int64)
+
+    def cols(off, m):
+        s = slice(off, off + m)
+        return {
             "time_": (np.arange(off, off + m, dtype=np.int64),),
-            "latency_ns": (rng.integers(1_000, 100_000_000, m),),
-            "resp_status": (
-                rng.choice(np.array([200, 200, 200, 200, 404, 500]), m),
-            ),
-            "service": (rng.integers(0, len(services), m).astype(np.int32),),
-            "req_path": (rng.integers(0, len(paths), m).astype(np.int32),),
+            "latency_ns": (lat[s],),
+            "resp_status": (status[s],),
+            "service": (svc_codes[s],),
+            "req_path": (path_codes[s],),
         }
-        batches.append(
-            HostBatch(
-                relation=rel,
-                cols=cols,
-                length=m,
-                dicts={"service": svc_dict, "req_path": path_dict},
-            )
-        )
-    return rel, batches
 
+    eng, warm = _build_engines("http_events", rel, cols, n, window,
+                               {"service": svc_dict, "req_path": path_dict})
 
-def build_plan():
-    from pixie_tpu.exec.plan import (
-        AggExpr, AggOp, ColumnRef, FilterOp, FuncCall, Literal,
-        MemorySourceOp, Plan, ResultSinkOp,
-    )
-    from pixie_tpu.types.dtypes import DataType
+    query = """
+import px
+df = px.DataFrame(table='http_events')
+df = df[df.resp_status < 400]
+df = df.groupby(['service', 'req_path']).agg(
+    n=('latency_ns', px.count),
+    lat_mean=('latency_ns', px.mean),
+    lat_max=('latency_ns', px.max),
+)
+px.display(df)
+"""
+    rps, dt, out = _time_query(eng, query, n, warm_eng=warm)
 
-    p = Plan()
-    src = p.add(MemorySourceOp(table="http_events"))
-    flt = p.add(
-        FilterOp(
-            predicate=FuncCall(
-                "lessThan", (ColumnRef("resp_status"), Literal(400, DataType.INT64))
-            )
-        ),
-        [src],
-    )
-    agg = p.add(
-        AggOp(
-            group_cols=("service", "req_path"),
-            aggs=(
-                AggExpr("n", "count", (ColumnRef("latency_ns"),)),
-                AggExpr("lat_mean", "mean", (ColumnRef("latency_ns"),)),
-                AggExpr("lat_max", "max", (ColumnRef("latency_ns"),)),
-            ),
-            max_groups=512,
-        ),
-        [flt],
-    )
-    p.add(ResultSinkOp("out"), [agg])
-    return p
-
-
-def numpy_baseline(batches):
-    """Vectorized single-core CPU implementation of the same query."""
+    # numpy baseline (timed: this is the vs_baseline denominator).
     t0 = time.perf_counter()
-    key_acc, lat_acc = [], []
-    for hb in batches:
-        ok = hb.cols["resp_status"][0] < 400
-        key = (
-            hb.cols["service"][0][ok].astype(np.int64) * 1024
-            + hb.cols["req_path"][0][ok]
-        )
-        key_acc.append(key)
-        lat_acc.append(hb.cols["latency_ns"][0][ok])
-    key = np.concatenate(key_acc)
-    lat = np.concatenate(lat_acc)
+    ok = status < 400
+    key = svc_codes[ok].astype(np.int64) * 64 + path_codes[ok]
     uniq, inv = np.unique(key, return_inverse=True)
-    n = np.bincount(inv)
-    s = np.bincount(inv, weights=lat.astype(np.float64))
+    cnt = np.bincount(inv)
+    mean = np.bincount(inv, weights=lat[ok].astype(np.float64)) / cnt
     mx = np.full(len(uniq), -np.inf)
-    np.maximum.at(mx, inv, lat)
-    dt = time.perf_counter() - t0
-    return {"n": n, "mean": s / n, "max": mx, "uniq": uniq}, dt
+    np.maximum.at(mx, inv, lat[ok])
+    base_dt = time.perf_counter() - t0
+
+    got = out["output"].to_pydict(decode_strings=False)
+    gkey = got["service"].astype(np.int64) * 64 + got["req_path"]
+    order = np.argsort(gkey)
+    assert np.array_equal(np.sort(uniq), gkey[order]), "http_stats keys mismatch"
+    ro = np.argsort(uniq)
+    assert np.array_equal(got["n"][order], cnt[ro].astype(got["n"].dtype))
+    np.testing.assert_allclose(got["lat_mean"][order], mean[ro], rtol=1e-5)
+    np.testing.assert_allclose(got["lat_max"][order], mx[ro])
+    return (eng, warm), (lat, status, svc_codes), {
+        "rows": n, "rows_per_sec": round(rps), "secs": round(dt, 3),
+        "vs_baseline": round(rps / (n / base_dt), 3), "checked": True,
+    }
 
 
-def main():
-    n_rows = int(os.environ.get("PIXIE_TPU_BENCH_ROWS", 16 * 1024 * 1024))
-    window = int(os.environ.get("PIXIE_TPU_BENCH_WINDOW", 1 << 21))
+def _shape_service_stats(engines, data, n):
+    """configs[1]: p50/p99 t-digest + error-rate agg per service (reuses the
+    http_events replay already in the engine)."""
+    eng, warm = engines
+    lat, status, svc_codes = data
+    query = """
+import px
+df = px.DataFrame(table='http_events')
+df.failure = df.resp_status >= 400
+per_svc = df.groupby('service').agg(
+    lat_q=('latency_ns', px.quantiles),
+    error_rate=('failure', px.mean),
+    throughput=('latency_ns', px.count),
+)
+per_svc.p50 = px.pluck_float64(per_svc.lat_q, 'p50')
+per_svc.p99 = px.pluck_float64(per_svc.lat_q, 'p99')
+per_svc = per_svc[['service', 'p50', 'p99', 'error_rate', 'throughput']]
+px.display(per_svc)
+"""
+    rps, dt, out = _time_query(eng, query, n, warm_eng=warm)
+
+    t0 = time.perf_counter()
+    ref = {}
+    for s in np.unique(svc_codes):
+        m = svc_codes == s
+        ref[int(s)] = (
+            np.quantile(lat[m], 0.5), np.quantile(lat[m], 0.99),
+            float(np.mean(status[m] >= 400)), int(m.sum()),
+        )
+    base_dt = time.perf_counter() - t0
+
+    got = out["output"].to_pydict(decode_strings=False)
+    for s, p50, p99, err, thr in zip(
+        got["service"], got["p50"], got["p99"], got["error_rate"], got["throughput"]
+    ):
+        r50, r99, rerr, rthr = ref[int(s)]
+        assert abs(p50 - r50) / r50 < 0.15, f"p50 off: {p50} vs {r50}"
+        assert abs(p99 - r99) / r99 < 0.15, f"p99 off: {p99} vs {r99}"
+        np.testing.assert_allclose(err, rerr, rtol=1e-4)
+        assert thr == rthr
+    return {
+        "rows": n, "rows_per_sec": round(rps), "secs": round(dt, 3),
+        "vs_baseline": round(rps / (n / base_dt), 3), "checked": True,
+    }
+
+
+def _shape_net_flow_graph(n, window):
+    """configs[2]: conn_stats self-join + groupby over src/dst pod pairs."""
+    from pixie_tpu.types.dtypes import DataType
+    from pixie_tpu.types.relation import Relation
+    from pixie_tpu.types.strings import StringDictionary
+
+    rng = np.random.default_rng(11)
+    n_pods = 48
+    pods = [f"ns/pod-{i}" for i in range(n_pods)]
+    addrs = [f"10.1.{i // 250}.{i % 250}" for i in range(n_pods)]
+    pod_dict, addr_dict = StringDictionary(pods), StringDictionary(addrs)
+    rel = Relation([
+        ("time_", DataType.TIME64NS),
+        ("src_addr", DataType.STRING),
+        ("src_pod", DataType.STRING),
+        ("dst_addr", DataType.STRING),
+        ("bytes_sent", DataType.INT64),
+        ("bytes_recv", DataType.INT64),
+    ])
+    src = _codes(rng, n, n_pods)
+    dst = _codes(rng, n, n_pods)
+    sent = rng.integers(64, 1 << 20, n)
+    recv = rng.integers(64, 1 << 20, n)
+
+    def cols(off, m):
+        s = slice(off, off + m)
+        return {
+            "time_": (np.arange(off, off + m, dtype=np.int64),),
+            "src_addr": (src[s],),   # pod i owns addr i
+            "src_pod": (src[s],),
+            "dst_addr": (dst[s],),
+            "bytes_sent": (sent[s],),
+            "bytes_recv": (recv[s],),
+        }
+
+    eng, warm = _build_engines("conn_stats", rel, cols, n, window,
+                               {"src_addr": addr_dict, "src_pod": pod_dict,
+                                "dst_addr": addr_dict})
+
+    query = """
+import px
+df = px.DataFrame(table='conn_stats')
+flows = df.groupby(['src_pod', 'dst_addr']).agg(
+    bytes_sent=('bytes_sent', px.sum),
+    bytes_recv=('bytes_recv', px.sum),
+)
+addrs = df.groupby(['src_addr', 'src_pod']).agg(m=('bytes_sent', px.count))
+addrs = addrs[['src_addr', 'src_pod']]
+g = flows.merge(addrs, how='inner', left_on=['dst_addr'],
+                right_on=['src_addr'], suffixes=['', '_dst'])
+out = g.groupby(['src_pod', 'src_pod_dst']).agg(
+    bytes_sent=('bytes_sent', px.sum),
+    bytes_recv=('bytes_recv', px.sum),
+)
+px.display(out)
+"""
+    rps, dt, out = _time_query(eng, query, n, warm_eng=warm)
+
+    t0 = time.perf_counter()
+    # Inner-join semantics: flows whose dst pod never appears as a source
+    # are dropped by the query; mirror that (matters at tiny row counts).
+    m = np.isin(dst, np.unique(src))
+    key = src[m].astype(np.int64) * n_pods + dst[m]
+    uniq, inv = np.unique(key, return_inverse=True)
+    ref_sent = np.bincount(inv, weights=sent[m].astype(np.float64))
+    ref_recv = np.bincount(inv, weights=recv[m].astype(np.float64))
+    base_dt = time.perf_counter() - t0
+
+    got = out["output"].to_pydict(decode_strings=False)
+    gkey = got["src_pod"].astype(np.int64) * n_pods + got["src_pod_dst"]
+    order = np.argsort(gkey)
+    assert np.array_equal(np.sort(uniq), gkey[order]), "net_flow keys mismatch"
+    ro = np.argsort(uniq)
+    np.testing.assert_allclose(got["bytes_sent"][order], ref_sent[ro], rtol=1e-6)
+    np.testing.assert_allclose(got["bytes_recv"][order], ref_recv[ro], rtol=1e-6)
+    return {
+        "rows": n, "rows_per_sec": round(rps), "secs": round(dt, 3),
+        "vs_baseline": round(rps / (n / base_dt), 3), "checked": True,
+    }
+
+
+def _shape_sql_stats(n, window):
+    """configs[3]: SQL-normalize (dictionary-side regex UDF) + windowed agg."""
+    from pixie_tpu.types.dtypes import DataType
+    from pixie_tpu.types.relation import Relation
+    from pixie_tpu.types.strings import StringDictionary
+    from pixie_tpu.udf.builtins.sql_ops import normalize_sql
+
+    rng = np.random.default_rng(13)
+    tables = ["users", "orders", "items", "carts", "sessions"]
+    raw = []
+    for i in range(400):  # 400 raw strings -> ~10 normalized shapes
+        t = tables[i % len(tables)]
+        raw.append(f"SELECT * FROM {t} WHERE id = {i} AND name = 'u{i}'")
+        raw.append(f"UPDATE {t} SET v = {i * 3} WHERE id IN ({i}, {i + 1})")
+    q_dict = StringDictionary(raw)
+    rel = Relation([
+        ("time_", DataType.TIME64NS),
+        ("query_str", DataType.STRING),
+        ("latency_ns", DataType.INT64),
+    ])
+    qc = _codes(rng, n, len(raw))
+    lat = rng.integers(10_000, 50_000_000, n)
+    # ~64 one-second windows across the replay.
+    tns = ((np.arange(n, dtype=np.int64) * 64) // max(n, 1)) * 1_000_000_000
+
+    def cols(off, m):
+        s = slice(off, off + m)
+        return {"time_": (tns[s],), "query_str": (qc[s],), "latency_ns": (lat[s],)}
+
+    eng, warm = _build_engines("mysql_events", rel, cols, n, window,
+                               {"query_str": q_dict})
+
+    query = """
+import px
+df = px.DataFrame(table='mysql_events')
+df.query_norm = px.normalize_mysql(df.query_str)
+df.window = px.bin(df.time_, px.DurationNanos(1000000000))
+out = df.groupby(['query_norm', 'window']).agg(
+    n=('latency_ns', px.count),
+    lat_mean=('latency_ns', px.mean),
+)
+px.display(out)
+"""
+    rps, dt, out = _time_query(eng, query, n, warm_eng=warm)
+
+    t0 = time.perf_counter()
+    norm_vocab = np.array([normalize_sql(s) for s in raw])
+    norms, norm_inv = np.unique(norm_vocab, return_inverse=True)
+    nq = norm_inv[qc].astype(np.int64)
+    win = tns // 1_000_000_000
+    key = nq * 1_000 + win
+    uniq, inv = np.unique(key, return_inverse=True)
+    ref_n = np.bincount(inv)
+    ref_mean = np.bincount(inv, weights=lat.astype(np.float64)) / ref_n
+    base_dt = time.perf_counter() - t0
+
+    got = out["output"].to_pydict()
+    g_nq = np.array([np.searchsorted(norms, s) for s in got["query_norm"]],
+                    dtype=np.int64)
+    gkey = g_nq * 1_000 + got["window"] // 1_000_000_000
+    order = np.argsort(gkey)
+    assert np.array_equal(np.sort(uniq), gkey[order]), "sql_stats keys mismatch"
+    ro = np.argsort(uniq)
+    assert np.array_equal(got["n"][order], ref_n[ro].astype(got["n"].dtype))
+    np.testing.assert_allclose(got["lat_mean"][order], ref_mean[ro], rtol=1e-5)
+    return {
+        "rows": n, "rows_per_sec": round(rps), "secs": round(dt, 3),
+        "vs_baseline": round(rps / (n / base_dt), 3), "checked": True,
+    }
+
+
+def _shape_perf_flamegraph(n, window):
+    """configs[4]: stack-trace groupby-count (continuous profiler shape)."""
+    from pixie_tpu.types.dtypes import DataType
+    from pixie_tpu.types.relation import Relation
+    from pixie_tpu.types.strings import StringDictionary
+
+    rng = np.random.default_rng(17)
+    frames = ["main", "run", "poll", "parse", "exec", "gc", "alloc", "read"]
+    stacks = []
+    for i in range(2000):
+        depth = 2 + i % 6
+        stacks.append(";".join(frames[(i + d) % len(frames)] + f"_{(i * 7 + d) % 97}"
+                               for d in range(depth)))
+    st_dict = StringDictionary(stacks)
+    rel = Relation([
+        ("time_", DataType.TIME64NS),
+        ("stack_trace", DataType.STRING),
+        ("cnt", DataType.INT64),
+    ])
+    sc = _codes(rng, n, len(stacks))
+    cnt = rng.integers(1, 50, n)
+
+    def cols(off, m):
+        s = slice(off, off + m)
+        return {
+            "time_": (np.arange(off, off + m, dtype=np.int64),),
+            "stack_trace": (sc[s],),
+            "cnt": (cnt[s],),
+        }
+
+    eng, warm = _build_engines("stack_traces", rel, cols, n, window,
+                               {"stack_trace": st_dict})
+
+    query = """
+import px
+df = px.DataFrame(table='stack_traces')
+out = df.groupby('stack_trace').agg(count=('cnt', px.sum))
+px.display(out)
+"""
+    rps, dt, out = _time_query(eng, query, n, warm_eng=warm)
+
+    t0 = time.perf_counter()
+    ref = np.bincount(sc, weights=cnt.astype(np.float64), minlength=len(stacks))
+    base_dt = time.perf_counter() - t0
+
+    got = out["output"].to_pydict(decode_strings=False)
+    order = np.argsort(got["stack_trace"])
+    present = np.nonzero(ref)[0]
+    assert np.array_equal(got["stack_trace"][order], present), "stack keys mismatch"
+    np.testing.assert_allclose(got["count"][order], ref[present], rtol=1e-6)
+    return {
+        "rows": n, "rows_per_sec": round(rps), "secs": round(dt, 3),
+        "vs_baseline": round(rps / (n / base_dt), 3), "checked": True,
+    }
+
+
+def inner() -> int:
+    t_start = time.monotonic()
+    deadline = float(os.environ.get("PIXIE_TPU_BENCH_DEADLINE", 420))
 
     import jax
 
-    log(f"devices: {jax.devices()}")
-    from pixie_tpu.exec.engine import Engine
+    platform = jax.devices()[0].platform
+    log(f"[bench] devices: {jax.devices()}")
+    default_rows = 16 * 1024 * 1024 if platform == "tpu" else 2 * 1024 * 1024
+    n = int(os.environ.get("PIXIE_TPU_BENCH_ROWS", default_rows))
+    window = int(os.environ.get("PIXIE_TPU_BENCH_WINDOW", 1 << 21))
+    want = [
+        s.strip()
+        for s in os.environ.get(
+            "PIXIE_TPU_BENCH_SHAPES",
+            "http_stats,service_stats,net_flow_graph,sql_stats,perf_flamegraph",
+        ).split(",")
+        if s.strip()
+    ]
 
-    log(f"generating {n_rows:,} rows ...")
-    rel, batches = gen_http_events(n_rows, window)
+    shapes: dict = {}
 
-    eng = Engine(window_rows=window)
-    t = eng.create_table("http_events", rel)
-    for hb in batches:
-        t.dicts.update(hb.dicts)
-        t.batches.append(hb)
+    def time_left():
+        return deadline - (time.monotonic() - t_start)
 
-    plan = build_plan()
-    # Warmup: one pass over a single window to compile.
-    warm = Engine(window_rows=window)
-    tw = warm.create_table("http_events", rel)
-    tw.dicts.update(batches[0].dicts)
-    tw.batches.append(batches[0])
-    t0 = time.perf_counter()
-    warm.execute_plan(plan)
-    log(f"warmup (compile + first window): {time.perf_counter() - t0:.1f}s")
+    # http_stats always runs: it is the headline metric.
+    log(f"[bench] http_stats: generating {n:,} rows ...")
+    engines, data, shapes["http_stats"] = _shape_http_stats(n, window)
+    log(f"[bench] http_stats: {shapes['http_stats']}")
 
-    t0 = time.perf_counter()
-    out = eng.execute_plan(plan)["out"]
-    elapsed = time.perf_counter() - t0
-    rows_per_sec = n_rows / elapsed
-    log(f"engine: {elapsed:.3f}s  {rows_per_sec:,.0f} rows/s  ({out.length} groups)")
+    rest = [
+        ("service_stats", lambda: _shape_service_stats(engines, data, n)),
+        ("net_flow_graph", lambda: _shape_net_flow_graph(n // 2, window)),
+        ("sql_stats", lambda: _shape_sql_stats(n // 4, window)),
+        ("perf_flamegraph", lambda: _shape_perf_flamegraph(n // 4, window)),
+    ]
+    unknown = [s for s in want if s != "http_stats" and s not in dict(rest)]
+    if unknown:
+        log(f"[bench] unknown shapes in PIXIE_TPU_BENCH_SHAPES: {unknown}")
+    for name, fn in rest:
+        if name not in want:
+            log(f"[bench] {name}: not selected, skipping")
+            shapes[name] = {"skipped": "not selected"}
+            continue
+        if time_left() < 45:
+            log(f"[bench] skipping {name}: {time_left():.0f}s left")
+            shapes[name] = {"skipped": "deadline"}
+            continue
+        log(f"[bench] {name} ...")
+        try:
+            shapes[name] = fn()
+            log(f"[bench] {name}: {shapes[name]}")
+        except Exception as e:  # a broken shape must not zero the headline
+            log(f"[bench] {name} FAILED: {e!r}")
+            shapes[name] = {"error": repr(e)[:200]}
 
-    ref, ref_dt = numpy_baseline(batches)
-    ref_rows_per_sec = n_rows / ref_dt
-    log(f"numpy baseline: {ref_dt:.3f}s  {ref_rows_per_sec:,.0f} rows/s")
-
-    # Correctness cross-check vs the baseline.
-    got = out.to_pydict(decode_strings=False)
-    order = np.argsort(got["service"].astype(np.int64) * 1024 + got["req_path"])
-    assert np.array_equal(np.sort(ref["uniq"]),
-                          (got["service"].astype(np.int64) * 1024 + got["req_path"])[order])
-    ref_order = np.argsort(ref["uniq"])
-    assert np.array_equal(got["n"][order], ref["n"][ref_order].astype(got["n"].dtype))
-    np.testing.assert_allclose(got["lat_mean"][order], ref["mean"][ref_order], rtol=1e-6)
-    np.testing.assert_allclose(got["lat_max"][order], ref["max"][ref_order])
-    log("correctness vs baseline: OK")
-
-    print(
-        json.dumps(
-            {
-                "metric": "http_stats_rows_per_sec",
-                "value": round(rows_per_sec),
-                "unit": "rows/s",
-                "vs_baseline": round(rows_per_sec / ref_rows_per_sec, 3),
-            }
-        )
-    )
+    head = shapes["http_stats"]
+    print(json.dumps({
+        "metric": "http_stats_rows_per_sec",
+        "value": head["rows_per_sec"],
+        "unit": "rows/s",
+        "vs_baseline": head["vs_baseline"],
+        "device": platform,
+        "shapes": shapes,
+    }), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("PIXIE_TPU_BENCH_INNER"):
+        sys.exit(inner())
+    sys.exit(launcher())

@@ -1,0 +1,61 @@
+"""Persistent-XLA-cache location, keyed by host CPU features.
+
+XLA:CPU AOT results embed target machine features; loading a cache entry
+compiled on a different host warns "could lead to execution errors such
+as SIGILL". Benchmark/driver entry points in this repo may run on
+different machines that share /tmp, so the cache directory name includes
+a hash of the host's CPU flags — a foreign-host cache simply misses.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+
+def jax_cache_dir() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next((ln for ln in f if ln.startswith("flags")), "")
+    except OSError:
+        flags = ""
+    key = hashlib.md5(flags.encode()).hexdigest()[:10]
+    return f"/tmp/pixie_tpu_jax_cache_{key}"
+
+
+def configure_jax_cache(env: dict | None = None) -> str:
+    """Point JAX's persistent compilation cache at the host-keyed dir.
+
+    Mutates ``env`` (default ``os.environ``); call before jax init.
+    """
+    env = os.environ if env is None else env
+    d = jax_cache_dir()
+    env["JAX_COMPILATION_CACHE_DIR"] = d
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    return d
+
+
+def scrubbed_cpu_env(n_devices: int | None = None, base: dict | None = None) -> dict:
+    """A fresh-subprocess env that runs jax on CPU with axon disabled.
+
+    The axon TPU-tunnel plugin registers at interpreter boot via
+    sitecustomize and claims an exclusive relay session in every process
+    that initializes jax — even under JAX_PLATFORMS=cpu — so CPU-only
+    subprocesses must clear PALLAS_AXON_POOL_IPS BEFORE the interpreter
+    starts (run_tests.sh / tests/conftest.py document the same rule).
+    """
+    env = dict(os.environ if base is None else base)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    if "JAX_COMPILATION_CACHE_DIR" not in env:
+        configure_jax_cache(env)
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    if n_devices is not None:
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env

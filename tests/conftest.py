@@ -25,9 +25,15 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 # XLA compiles are expensive in this environment (remote compile relay);
-# persist them across test runs.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/pixie_tpu_jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+# persist them across test runs. The cache dir is keyed by host CPU
+# features — XLA:CPU AOT entries from a different host risk SIGILL.
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from pixie_tpu.utils.cache import configure_jax_cache  # noqa: E402
+
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    configure_jax_cache()
 
 import pytest  # noqa: E402
 
