@@ -114,7 +114,8 @@ def launcher() -> int:
     if result is None:
         remaining = budget - (time.monotonic() - t0)
         cpu_timeout = max(90.0, remaining - 5.0)
-        os.environ.setdefault("PIXIE_TPU_BENCH_ROWS", str(2 * 1024 * 1024))
+        # A hung TPU attempt may leave only ~100s; keep the CPU run small.
+        os.environ.setdefault("PIXIE_TPU_BENCH_ROWS", str(1024 * 1024))
         result = _try_run("cpu", cpu_timeout)
     if result is None:
         log("[bench] all backends failed")

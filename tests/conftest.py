@@ -9,16 +9,19 @@ programs compile and run without TPU hardware. Hardware-tagged tests use
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# The axon TPU-tunnel plugin (wired in via sitecustomize at interpreter
-# boot) claims an exclusive relay session in EVERY python process that
-# initializes jax — even under JAX_PLATFORMS=cpu — which serializes/hangs
-# concurrent test runs and routes compiles through the relay (82s suite vs
-# 11s without). Clearing the var here is too late to stop registration
-# (sitecustomize already ran); use ./run_tests.sh, which clears it before
-# the interpreter starts. This line documents the requirement and helps
-# any subprocesses.
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
+if not os.environ.get("PIXIE_TPU_RUN_TPU_TESTS"):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # The axon TPU-tunnel plugin (wired in via sitecustomize at
+    # interpreter boot) claims an exclusive relay session in EVERY python
+    # process that initializes jax — even under JAX_PLATFORMS=cpu — which
+    # serializes/hangs concurrent test runs and routes compiles through
+    # the relay (82s suite vs 11s without). Clearing the var here is too
+    # late to stop registration (sitecustomize already ran); use
+    # ./run_tests.sh, which clears it before the interpreter starts. This
+    # line documents the requirement and helps any subprocesses.
+    # requires_tpu runs (PIXIE_TPU_RUN_TPU_TESTS=1) keep the ambient env:
+    # the axon plugin IS the TPU backend.
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,108 @@
+"""Hardware-tagged TPU tests (the reference's ``requires_bpf`` pattern,
+``src/stirling/source_connectors/socket_tracer/BUILD.bazel:159``: tests
+that need the real substrate are tagged and excluded by default).
+
+Run on the bench chip with:
+
+    PIXIE_TPU_RUN_TPU_TESTS=1 python -m pytest tests/test_tpu.py -v
+
+(keep the ambient env — the axon plugin is the TPU backend; do NOT use
+run_tests.sh, which disables it. One jax process at a time.)
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.requires_tpu
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    import jax
+
+    devs = jax.devices()
+    if devs[0].platform != "tpu":
+        pytest.skip(f"no TPU device (got {devs[0].platform})")
+    return devs[0]
+
+
+def _http_engine(n, window=1 << 18):
+    from pixie_tpu.exec.engine import Engine
+    from pixie_tpu.types.batch import HostBatch
+
+    rng = np.random.default_rng(5)
+    lat = rng.integers(1_000, 10_000_000, n)
+    status = rng.choice([200, 200, 200, 404, 500], n)
+    svc = rng.integers(0, 8, n).astype(np.int64)
+    eng = Engine(window_rows=window)
+    eng.create_table("http_events")
+    for off in range(0, n, window):
+        s = slice(off, min(off + window, n))
+        eng.append_data(
+            "http_events",
+            HostBatch.from_pydict({
+                "time_": np.arange(s.start, s.stop, dtype=np.int64),
+                "latency_ns": lat[s],
+                "resp_status": status[s],
+                "service": svc[s],
+            }),
+        )
+    return eng, (lat, status, svc)
+
+
+QUERY = """
+import px
+df = px.DataFrame(table='http_events')
+df = df[df.resp_status < 400]
+df = df.groupby('service').agg(
+    n=('latency_ns', px.count),
+    lat_mean=('latency_ns', px.mean),
+)
+px.display(df)
+"""
+
+
+def test_flagship_fragment_on_tpu(tpu):
+    """The driver's entry(): compile + run the flagship window step."""
+    import jax
+
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert bool(np.asarray(out["valid"]).any())
+
+
+def test_engine_query_on_tpu(tpu):
+    """End-to-end PxL query on the chip, checked against numpy."""
+    n = 1 << 18
+    eng, (lat, status, svc) = _http_engine(n)
+    out = eng.execute_query(QUERY)["output"].to_pydict(decode_strings=False)
+    ok = status < 400
+    for s, cnt, mean in zip(out["service"], out["n"], out["lat_mean"]):
+        m = ok & (svc == s)
+        assert cnt == m.sum()
+        np.testing.assert_allclose(mean, lat[m].mean(), rtol=1e-5)
+
+
+def test_window_throughput_on_tpu(tpu):
+    """Steady-state window-fold throughput floor on real hardware.
+
+    The floor is deliberately conservative (CPU XLA does ~0.7M rows/s on
+    this shape; a TPU chip must beat it comfortably) and overridable via
+    PIXIE_TPU_MIN_ROWS_PER_SEC for faster/slower parts.
+    """
+    floor = float(os.environ.get("PIXIE_TPU_MIN_ROWS_PER_SEC", 2e6))
+    n = 4 * 1024 * 1024
+    eng, _ = _http_engine(n, window=1 << 20)
+    eng.execute_query(QUERY)  # warm: trace + compile
+    t0 = time.perf_counter()
+    eng.execute_query(QUERY)
+    dt = time.perf_counter() - t0
+    rps = n / dt
+    print(f"tpu window throughput: {rps:,.0f} rows/s")
+    assert rps > floor, f"{rps:,.0f} rows/s below floor {floor:,.0f}"
