@@ -17,6 +17,7 @@ TPU execution model:
 
 from __future__ import annotations
 
+import functools
 import itertools
 import json
 from dataclasses import dataclass, field
@@ -294,7 +295,7 @@ class Engine:
             elif isinstance(op, JoinOp):
                 left = mat_input(node.inputs[0])
                 right = mat_input(node.inputs[1])
-                results[nid] = _join_host(left, right, op)
+                results[nid] = _join_dispatch(left, right, op)
             elif isinstance(op, UnionOp):
                 mats = [mat_input(i) for i in node.inputs]
                 results[nid] = _union_host(mats)
@@ -650,31 +651,230 @@ def _key_tuples(hb: HostBatch, on, remaps):
     return list(zip(*(list(k) for k in (keys + extra)))) if keys else []
 
 
-def _join_host(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
-    """N:1 equijoin on host (post-agg inputs are small).
+# Inputs smaller than this run the host dict join (when N:1 applies);
+# larger inputs and right/outer/N:M joins go to the device kernel.
+DEVICE_JOIN_MIN_ROWS = 1 << 15
 
-    Reference: ``src/carnot/exec/equijoin_node.cc`` build+probe — here the
-    build side must be unique on the key.
+
+def _join_dispatch(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
+    """Route a join to the host N:1 path or the device N:M kernel.
+
+    Reference: ``equijoin_node.cc`` always hash-joins; here small unique-
+    key inner/left joins (the post-agg common case) stay on host, and
+    everything else uses ``pixie_tpu.ops.join.device_join``.
     """
     if len(op.left_on) != len(op.right_on):
         raise QueryError("join key arity mismatch")
-    # Align string dictionaries between sides for key columns.
+    small = left.length + right.length < DEVICE_JOIN_MIN_ROWS
+    if op.how in ("inner", "left") and small:
+        try:
+            return _join_host(left, right, op)
+        except _BuildNotUnique:
+            pass  # N:M fan-out -> device kernel
+    if left.length == 0 or right.length == 0:
+        return _join_degenerate(left, right, op)
+    return _join_device(left, right, op)
+
+
+class _BuildNotUnique(Exception):
+    pass
+
+
+def _align_join_dicts(left, right, op):
+    """String-dictionary id remaps so key ids compare across sides.
+
+    Returns (l_remap, r_remap, key_dicts): key_dicts maps a left key
+    column to the merged dictionary (union preserves left ids, so pair
+    rows stay valid and coalesced build-side ids land past them).
+    """
     l_remap: dict = {}
     r_remap: dict = {}
+    key_dicts: dict = {}
     for lc, rc in zip(op.left_on, op.right_on):
         ld, rd = left.dicts.get(lc), right.dicts.get(rc)
         if ld is not None and rd is not None and ld is not rd:
             merged, rl, rr = ld.union(rd)
             l_remap[lc], r_remap[rc] = rl, rr
+            key_dicts[lc] = merged
+    return l_remap, r_remap, key_dicts
+
+
+def _join_out_schema(left, right, op):
+    """(out_rel, ordered (side, src_col) pairs) for join output columns."""
+    out_rel = left.relation.merge(
+        right.relation.select(
+            [c for c in right.relation.column_names if c not in op.right_on]
+        ),
+        suffix=op.suffix,
+    )
+    src = [("l", c) for c in left.relation.column_names] + [
+        ("r", c) for c in right.relation.column_names if c not in op.right_on
+    ]
+    return out_rel, src
+
+
+def _join_degenerate(left, right, op: JoinOp) -> HostBatch:
+    """Joins where one side is empty (device kernel needs real rows)."""
+    out_rel, src = _join_out_schema(left, right, op)
+    if op.how == "inner" or (op.how == "left" and left.length == 0) or (
+        op.how == "right" and right.length == 0
+    ):
+        keep_l = keep_r = np.zeros(0, dtype=np.int64)
+    elif op.how in ("left", "outer") and right.length == 0:
+        keep_l, keep_r = np.arange(left.length), np.full(left.length, -1)
+    elif op.how in ("right", "outer") and left.length == 0:
+        keep_l, keep_r = np.full(right.length, -1), np.arange(right.length)
+    else:  # outer with one side non-empty handled above; both empty:
+        keep_l = keep_r = np.zeros(0, dtype=np.int64)
+    _, r_remap, key_dicts = _align_join_dicts(left, right, op)
+    return _assemble_join(
+        left, right, op, out_rel, src,
+        keep_l, keep_l >= 0, keep_r, keep_r >= 0,
+        r_remap=r_remap, key_dicts=key_dicts,
+    )
+
+
+def _assemble_join(left, right, op, out_rel, src, l_idx, l_take, r_idx, r_take,
+                   r_remap=None, key_dicts=None):
+    """Gather output columns from per-row indices + take masks.
+
+    Join key columns coalesce (SQL USING semantics): a right/outer extra
+    row — whose probe side is null — takes its key from the build side,
+    remapped into the merged dictionary for strings.
+    """
+    r_remap = r_remap or {}
+    key_dicts = key_dicts or {}
+    key_map = dict(zip(op.left_on, op.right_on))
+    out_cols: dict = {}
+    out_dicts: dict = {}
+    names = iter(out_rel.column_names)
+    for side, c in src:
+        n = next(names)
+        hb = left if side == "l" else right
+        idx = l_idx if side == "l" else r_idx
+        take = l_take if side == "l" else r_take
+        rc = key_map.get(c) if side == "l" else None
+        nullv = NULL_ID if hb.relation.col_type(c) == DataType.STRING else 0
+        planes = []
+        for pi, p in enumerate(hb.cols[c]):
+            if len(p) == 0:
+                taken = np.full(len(idx), nullv, dtype=p.dtype)
+            else:
+                taken = p[np.clip(idx, 0, len(p) - 1)]
+            if not take.all():
+                if rc is not None:
+                    q = right.cols[rc][pi]
+                    if pi == 0 and rc in r_remap:
+                        q = np.where(
+                            q >= 0, r_remap[rc][np.clip(q, 0, None)], NULL_ID
+                        ).astype(q.dtype)
+                    alt = (
+                        np.full(len(r_idx), nullv, dtype=p.dtype)
+                        if len(q) == 0
+                        else q[np.clip(r_idx, 0, len(q) - 1)]
+                    )
+                    taken = np.where(
+                        take, taken, np.where(r_take, alt, nullv)
+                    ).astype(p.dtype)
+                else:
+                    taken = np.where(take, taken, nullv).astype(p.dtype)
+            planes.append(taken)
+        out_cols[n] = tuple(planes)
+        if c in hb.dicts:
+            out_dicts[n] = (
+                key_dicts.get(c, hb.dicts[c]) if side == "l" else hb.dicts[c]
+            )
+    return HostBatch(
+        relation=out_rel, cols=out_cols, length=len(l_idx), dicts=out_dicts
+    )
+
+
+def _join_key_planes(hb, cols, remaps):
+    planes = []
+    for c in cols:
+        for i, p in enumerate(hb.cols[c]):
+            if i == 0 and c in remaps:
+                p = np.where(
+                    p >= 0, remaps[c][np.clip(p, 0, None)], NULL_ID
+                ).astype(p.dtype)
+            planes.append(p)
+    return planes
+
+
+@functools.lru_cache(maxsize=64)
+def _device_join_cache(n_build, n_probe, dtypes, capacity, how):
+    """One jitted kernel per (bucketed shapes, key dtypes, capacity, how)."""
+    import jax
+
+    from ..ops.join import device_join
+
+    return jax.jit(
+        lambda bk, bv, pk, pv: device_join(bk, bv, pk, pv, capacity, how)
+    )
+
+
+def _join_device(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
+    """N:M device join: pad to bucketed capacities, run the sort-based
+    kernel, re-run doubled on overflow, gather columns host-side."""
+    l_remap, r_remap, key_dicts = _align_join_dicts(left, right, op)
+    probe_planes = _join_key_planes(left, op.left_on, l_remap)
+    build_planes = _join_key_planes(right, op.right_on, r_remap)
+    for bp, pp in zip(build_planes, probe_planes):
+        if bp.dtype != pp.dtype:
+            raise QueryError(
+                f"join key dtype mismatch: {bp.dtype} vs {pp.dtype}"
+            )
+
+    nb, np_ = bucket_capacity(right.length), bucket_capacity(left.length)
+
+    def pad(p, cap):
+        out = np.zeros(cap, dtype=p.dtype)
+        out[: len(p)] = p
+        return out
+
+    bk = [pad(p, nb) for p in build_planes]
+    pk = [pad(p, np_) for p in probe_planes]
+    bv = np.zeros(nb, dtype=bool)
+    bv[: right.length] = True
+    pv = np.zeros(np_, dtype=bool)
+    pv[: left.length] = True
+
+    capacity = bucket_capacity(max(left.length + right.length, 1))
+    while True:
+        fn = _device_join_cache(
+            nb, np_, tuple(str(p.dtype) for p in bk), capacity, op.how
+        )
+        p_idx, p_take, b_idx, b_take, out_valid, overflow = (
+            np.asarray(a) for a in fn(bk, bv, pk, pv)
+        )
+        if not bool(overflow):
+            break
+        capacity *= 2
+
+    sel = np.nonzero(out_valid)[0]
+    out_rel, src = _join_out_schema(left, right, op)
+    return _assemble_join(
+        left, right, op, out_rel, src,
+        p_idx[sel], p_take[sel], b_idx[sel], b_take[sel],
+        r_remap=r_remap, key_dicts=key_dicts,
+    )
+
+
+def _join_host(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
+    """N:1 equijoin on host (post-agg inputs are small).
+
+    Reference: ``src/carnot/exec/equijoin_node.cc`` build+probe — here the
+    build side must be unique on the key (raises _BuildNotUnique for the
+    dispatcher to fall through to the device kernel).
+    """
+    l_remap, r_remap, _ = _align_join_dicts(left, right, op)
 
     lk = _key_tuples(left, op.left_on, l_remap)
     rk = _key_tuples(right, op.right_on, r_remap)
     lookup: dict = {}
     for i, k in enumerate(rk):
         if k in lookup:
-            raise QueryError(
-                f"join build side not unique on key {op.right_on} (dup {k})"
-            )
+            raise _BuildNotUnique(op.right_on, k)
         lookup[k] = i
 
     match = np.fromiter((lookup.get(k, -1) for k in lk), dtype=np.int64, count=len(lk))

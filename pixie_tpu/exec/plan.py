@@ -113,12 +113,12 @@ class AggOp(Op):
 
 @dataclass(frozen=True)
 class JoinOp(Op):
-    """Equijoin; right side must be unique on the key (N:1).
+    """Equijoin of the left (probe) side against the right (build) side.
 
-    Reference: ``src/carnot/exec/equijoin_node.h:48``. General N:M
-    fan-out joins need data-dependent output sizes; the observability
-    workload joins aggregated (unique-key) tables, which is what this
-    covers. how: 'inner' | 'left'.
+    Reference: ``src/carnot/exec/equijoin_node.h:48``. Small unique-key
+    (N:1) inner/left joins run on host; everything else — N:M fan-out,
+    right/outer, large inputs — routes to the sort-based device join
+    (``pixie_tpu.ops.join``). how: 'inner' | 'left' | 'right' | 'outer'.
     """
 
     left_on: tuple

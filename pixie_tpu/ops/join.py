@@ -1,0 +1,151 @@
+"""Device-side N:M equijoin with static shapes.
+
+Reference parity: ``src/carnot/exec/equijoin_node.{h,cc}`` — build+probe
+hash join supporting inner/left/right/outer with N:M fan-out and chunked
+output. Hash maps are hostile to XLA, so the TPU design is sort-based,
+reusing the group-by machinery (``pixie_tpu.ops.groupby``):
+
+1. Both sides' key planes are mapped to one exact dense key-id space by
+   ``dense_group_ids`` over the concatenated rows (multi-key sort — no
+   hash collisions, static shapes).
+2. The build side is sorted by key id; ``searchsorted`` gives each probe
+   row its contiguous match range [lo, hi).
+3. Match ranges expand into a fixed-capacity output via exclusive prefix
+   sums + a scatter/cummax ownership scan; rows beyond ``capacity`` are
+   dropped and flagged (``overflow=True``) so the caller can re-run with
+   a doubled capacity — the static-shape analog of Carnot's growing
+   output chunks.
+
+The kernel returns gather indices + take-masks, not materialized columns:
+(probe_idx, probe_take, build_idx, build_take, out_valid, overflow).
+Unmatched sides emit take=False, which callers turn into nulls. Where a
+take-mask is False the paired index is arbitrary but always in-bounds,
+so unconditional gathers stay safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .groupby import dense_group_ids
+
+
+def _exclusive_cumsum(x):
+    """(exclusive cumsum, total) for an int32 vector."""
+    c = jnp.cumsum(x)
+    return jnp.concatenate([jnp.zeros(1, x.dtype), c[:-1]]), c[-1]
+
+
+def _cummax(x):
+    """Inclusive cumulative max (associative scan -> O(log n) on device)."""
+    return jax.lax.associative_scan(jnp.maximum, x)
+
+
+def _owners(slot_of, emitting, count, capacity):
+    """Per-output-slot owner row (1-based; 0 = no owner yet).
+
+    Scatter (row+1) at each emitting row's start slot, then cummax: every
+    slot inherits the nearest preceding start's row. Emitting rows have
+    strictly increasing starts, so scatters never collide.
+    """
+    marker = (
+        jnp.zeros(capacity + 1, dtype=jnp.int32)
+        .at[slot_of]
+        .max(jnp.arange(1, count + 1, dtype=jnp.int32) * emitting)[:capacity]
+    )
+    return _cummax(marker)
+
+
+def device_join(
+    build_keys,
+    build_valid,
+    probe_keys,
+    probe_valid,
+    capacity: int,
+    how: str = "inner",
+):
+    """Join probe (left) rows against build (right) rows on equal keys.
+
+    Args:
+      build_keys / probe_keys: lists of [B] / [N] key planes (same plane
+        count and dtypes per position; a UINT128 key contributes two).
+        Both sides must be non-empty arrays (mask rows invalid instead).
+      build_valid / probe_valid: bool masks.
+      capacity: static output row capacity C.
+      how: 'inner' | 'left' | 'right' | 'outer'.
+
+    Returns:
+      probe_idx int32[C], probe_take bool[C]  — left-side gather/null
+      build_idx int32[C], build_take bool[C]  — right-side gather/null
+      out_valid bool[C], overflow bool[]      — occupancy + truncation
+    """
+    if how not in ("inner", "left", "right", "outer"):
+        raise ValueError(f"unsupported join how={how!r}")
+    b = build_valid.shape[0]
+    n = probe_valid.shape[0]
+    c = capacity
+    if b == 0 or n == 0:
+        raise ValueError("device_join sides must be non-empty (mask instead)")
+
+    # 1. Shared exact key-id space. Invalid rows get id b+n from the
+    # group machinery; split that trash id per side so invalid build and
+    # invalid probe rows can never match each other.
+    cat_keys = [jnp.concatenate([bk, pk]) for bk, pk in zip(build_keys, probe_keys)]
+    cat_valid = jnp.concatenate([build_valid, probe_valid])
+    ids, _, _, _ = dense_group_ids(cat_keys, cat_valid, b + n)
+    kb = jnp.where(build_valid, ids[:b], b + n)
+    kp = jnp.where(probe_valid, ids[b:], b + n + 1)
+
+    # 2. Sort build by key id; per-probe match ranges.
+    perm = jnp.argsort(kb, stable=True).astype(jnp.int32)  # invalid last
+    skb = kb[perm]
+    lo = jnp.searchsorted(skb, kp, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(skb, kp, side="right").astype(jnp.int32)
+    m = hi - lo  # matches per probe row (0 for invalid probe rows)
+
+    # 3. Expansion: emitted rows per probe row.
+    pad_unmatched = how in ("left", "outer")
+    e = jnp.maximum(m, 1) if pad_unmatched else m
+    e = jnp.where(probe_valid, e, 0).astype(jnp.int32)
+    start, total_pairs = _exclusive_cumsum(e)
+
+    slot_of = jnp.where((e > 0) & (start < c), start, c)
+    owner1 = _owners(slot_of, (e > 0).astype(jnp.int32), n, c)
+    probe_idx = jnp.maximum(owner1 - 1, 0)
+
+    j = jnp.arange(c, dtype=jnp.int32)
+    t = j - start[probe_idx]
+    pair_valid = (j < total_pairs) & (owner1 > 0)
+    is_match = t < m[probe_idx]
+    build_idx = perm[
+        jnp.clip(lo[probe_idx] + jnp.minimum(t, m[probe_idx] - 1), 0, b - 1)
+    ]
+
+    probe_take = pair_valid
+    build_take = pair_valid & is_match
+    out_valid = pair_valid
+    overflow = total_pairs > c
+
+    if how in ("right", "outer"):
+        # Build rows whose key matches no probe row emit once with a null
+        # left side, appended after the pair region.
+        skp = jnp.sort(kp)
+        lo_b = jnp.searchsorted(skp, kb, side="left")
+        hi_b = jnp.searchsorted(skp, kb, side="right")
+        unmatched = build_valid & ((hi_b - lo_b) == 0)
+        su, n_extra = _exclusive_cumsum(unmatched.astype(jnp.int32))
+        extra_slot = jnp.where(
+            unmatched & (total_pairs + su < c), total_pairs + su, c
+        )
+        extra_owner = _owners(extra_slot, unmatched.astype(jnp.int32), b, c)
+        # The extras region starts at total_pairs; inside it the pair
+        # machinery's owner is stale, so extras override.
+        in_extras = (j >= total_pairs) & (extra_owner > 0)
+        build_idx = jnp.where(in_extras, jnp.maximum(extra_owner - 1, 0), build_idx)
+        build_take = jnp.where(in_extras, True, build_take)
+        probe_take = probe_take & ~in_extras
+        out_valid = out_valid | (in_extras & (j < total_pairs + n_extra))
+        overflow = overflow | (total_pairs + n_extra > c)
+
+    return probe_idx, probe_take, build_idx, build_take, out_valid, overflow

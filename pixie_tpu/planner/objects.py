@@ -348,10 +348,10 @@ class DataFrameObj:
         for c in ro:
             if not right.relation.has_column(c):
                 raise PxLError(f"merge right_on column {c!r} missing", lineno)
-        if how not in ("inner", "left"):
+        if how not in ("inner", "left", "right", "outer"):
             raise PxLError(
-                f"merge how={how!r} unsupported (inner/left; the exec join is "
-                "N:1 build-probe like the reference equijoin)", lineno)
+                f"merge how={how!r} unsupported "
+                "(inner/left/right/outer)", lineno)
         suffixes = tuple(suffixes)
         if suffixes and suffixes[0] != "":
             raise PxLError("merge suffixes must keep the left side unsuffixed "

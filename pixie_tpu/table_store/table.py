@@ -477,6 +477,13 @@ class Table:
                     ),
                 )
         planes = [np.ascontiguousarray(cols[c][i]) for c, i in self._plane_layout]
+        for (c, _i), p in zip(self._plane_layout, planes):
+            if p.ndim != 1 or len(p) != hb.length:
+                # A mis-shaped plane would silently corrupt the flat slab.
+                raise ValueError(
+                    f"column {c!r} plane has shape {p.shape}; expected "
+                    f"1-D of length {hb.length}"
+                )
         times = cols[TIME_COLUMN][0] if (TIME_COLUMN, 0) == self._plane_layout[0] else None
         self._backend.append(planes, times)
         return hb

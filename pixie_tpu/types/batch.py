@@ -75,7 +75,10 @@ class HostBatch:
             if relation is not None:
                 dt = relation.col_type(name)
             else:
-                dt = from_numpy_dtype(arr.dtype, is_time=name in time_cols)
+                if arr.ndim == 2 and arr.shape[1] == 2 and arr.dtype == np.uint64:
+                    dt = DataType.UINT128  # (n, 2) [hi, lo] UPID layout
+                else:
+                    dt = from_numpy_dtype(arr.dtype, is_time=name in time_cols)
                 rel_items.append((name, dt))
             if dt == DataType.STRING:
                 if dicts is not None and name in dicts:

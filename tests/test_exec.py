@@ -320,17 +320,25 @@ class TestJoinUnion:
         assert list(out["k"]) == [1, 2, 3]
         assert list(out["v"]) == [0, 9, 0]
 
-    def test_join_dup_build_side_raises(self, engine):
+    def test_join_dup_build_side_fans_out(self, engine):
+        """A non-unique build side falls through to the device N:M join
+        (reference equijoin_node.cc supports full fan-out)."""
         e = Engine()
-        e.append_data("a", {"k": np.array([1], dtype=np.int64)}, time_cols=())
-        e.append_data("b", {"k": np.array([2, 2], dtype=np.int64)}, time_cols=())
+        e.append_data("a", {"k": np.array([1, 2], dtype=np.int64)}, time_cols=())
+        e.append_data(
+            "b",
+            {"k": np.array([2, 2, 3], dtype=np.int64),
+             "v": np.array([7, 8, 9], dtype=np.int64)},
+            time_cols=(),
+        )
         p = Plan()
         s1 = p.add(MemorySourceOp(table="a"))
         s2 = p.add(MemorySourceOp(table="b"))
         j = p.add(JoinOp(left_on=("k",), right_on=("k",)), [s1, s2])
         p.add(ResultSinkOp("output"), [j])
-        with pytest.raises(QueryError, match="not unique"):
-            e.execute_plan(p)
+        out = e.execute_plan(p)["output"].to_pydict()
+        assert list(out["k"]) == [2, 2]
+        assert sorted(out["v"]) == [7, 8]
 
     def test_union(self, engine):
         e = Engine()

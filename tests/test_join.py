@@ -1,0 +1,209 @@
+"""Device N:M join tests (kernel + engine routing).
+
+Mirrors the reference's join coverage (``equijoin_node_test.cc``,
+``end_to_end_join_test.cc``): all four join types, N:M fan-out, string
+keys with divergent dictionaries, u128 keys, empty sides, and the
+overflow-retry path.
+"""
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec.engine import Engine
+from pixie_tpu.exec.plan import JoinOp, MemorySourceOp, Plan, ResultSinkOp
+
+
+def _ref_join(lk, rk, how):
+    """Reference N:M join on int key lists -> set of (l_idx, r_idx) pairs
+    (r_idx None = null right, l_idx None = null left)."""
+    out = []
+    r_by_key = {}
+    for j, k in enumerate(rk):
+        r_by_key.setdefault(k, []).append(j)
+    matched_r = set()
+    for i, k in enumerate(lk):
+        js = r_by_key.get(k, [])
+        if js:
+            for j in js:
+                out.append((i, j))
+                matched_r.add(j)
+        elif how in ("left", "outer"):
+            out.append((i, None))
+    if how in ("right", "outer"):
+        for j in range(len(rk)):
+            if j not in matched_r:
+                out.append((None, j))
+    return sorted(out, key=lambda p: (p[0] is None, p[0], p[1] is None, p[1]))
+
+
+def _run_join(lk, lv, rk, rv, how):
+    e = Engine()
+    e.append_data(
+        "l",
+        {"k": np.asarray(lk, dtype=np.int64), "lv": np.asarray(lv, dtype=np.int64)},
+        time_cols=(),
+    )
+    e.append_data(
+        "r",
+        {"k": np.asarray(rk, dtype=np.int64), "rv": np.asarray(rv, dtype=np.int64)},
+        time_cols=(),
+    )
+    p = Plan()
+    s1 = p.add(MemorySourceOp(table="l"))
+    s2 = p.add(MemorySourceOp(table="r"))
+    j = p.add(JoinOp(left_on=("k",), right_on=("k",), how=how), [s1, s2])
+    p.add(ResultSinkOp("output"), [j])
+    return p, e
+
+
+def _check(lk, rk, how):
+    lv = [100 + i for i in range(len(lk))]
+    rv = [200 + j for j in range(len(rk))]
+    p, e = _run_join(lk, lv, rk, rv, how)
+    out = e.execute_plan(p)["output"].to_pydict()
+    got = sorted(
+        zip(out["lv"].tolist(), out["rv"].tolist()),
+        key=lambda t: (t[0] == 0, t[0], t[1] == 0, t[1]),
+    )
+    ref = _ref_join(lk, rk, how)
+    want = sorted(
+        (
+            (0 if i is None else 100 + i, 0 if j is None else 200 + j)
+            for i, j in ref
+        ),
+        key=lambda t: (t[0] == 0, t[0], t[1] == 0, t[1]),
+    )
+    assert got == want, f"{how}: {got} != {want}"
+
+
+class TestDeviceJoinKernel:
+    """Drive the kernel through the engine with forced-device routing."""
+
+    @pytest.fixture(autouse=True)
+    def force_device(self, monkeypatch):
+        import pixie_tpu.exec.engine as eng_mod
+
+        monkeypatch.setattr(eng_mod, "DEVICE_JOIN_MIN_ROWS", 0)
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    def test_all_types_nm(self, how):
+        _check([1, 2, 2, 5, 7], [2, 2, 3, 5, 5, 9], how)
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    def test_no_overlap(self, how):
+        _check([1, 2], [3, 4], how)
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    def test_full_overlap_dups_both_sides(self, how):
+        _check([4, 4, 4], [4, 4], how)
+
+    def test_randomized_vs_reference(self):
+        rng = np.random.default_rng(3)
+        for how in ("inner", "left", "right", "outer"):
+            lk = rng.integers(0, 20, 300).tolist()
+            rk = rng.integers(10, 30, 200).tolist()
+            _check(lk, rk, how)
+
+    def test_string_keys_divergent_dicts(self):
+        e = Engine()
+        e.append_data("l", {"s": ["a", "b", "c", "b"]}, time_cols=())
+        e.append_data(
+            "r", {"s": ["b", "d", "b"], "v": np.array([1, 2, 3], dtype=np.int64)},
+            time_cols=(),
+        )
+        p = Plan()
+        s1 = p.add(MemorySourceOp(table="l"))
+        s2 = p.add(MemorySourceOp(table="r"))
+        j = p.add(JoinOp(left_on=("s",), right_on=("s",), how="outer"), [s1, s2])
+        p.add(ResultSinkOp("output"), [j])
+        out = e.execute_plan(p)["output"].to_pydict()
+        rows = sorted(zip(out["s"], out["v"].tolist()))
+        assert rows == [
+            ("a", 0), ("b", 1), ("b", 1), ("b", 3), ("b", 3), ("c", 0), ("d", 2)
+        ]
+
+    def test_u128_keys(self):
+        hi = np.array([1, 1, 2], dtype=np.uint64)
+        lo = np.array([5, 6, 5], dtype=np.uint64)
+        e = Engine()
+        e.append_data("l", {"u": np.stack([hi, lo], axis=1)}, time_cols=())
+        e.append_data(
+            "r",
+            {"u": np.stack([hi[:2], lo[:2]], axis=1),
+             "v": np.array([10, 20], dtype=np.int64)},
+            time_cols=(),
+        )
+        p = Plan()
+        s1 = p.add(MemorySourceOp(table="l"))
+        s2 = p.add(MemorySourceOp(table="r"))
+        j = p.add(JoinOp(left_on=("u",), right_on=("u",), how="left"), [s1, s2])
+        p.add(ResultSinkOp("output"), [j])
+        out = e.execute_plan(p)["output"].to_pydict()
+        assert out["v"].tolist() == [10, 20, 0]
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    def test_empty_left(self, how):
+        _check([], [1, 2], how)
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    def test_empty_right(self, how):
+        _check([1, 2], [], how)
+
+    @pytest.mark.parametrize("how", ["inner", "outer"])
+    def test_empty_both(self, how):
+        _check([], [], how)
+
+    def test_overflow_retries_with_larger_capacity(self, monkeypatch):
+        """A high-fan-out join whose output exceeds the first capacity
+        guess must rebucket, not truncate."""
+        # 64 probe rows x 64 build rows on one key -> 4096 pairs, far
+        # beyond bucket_capacity(64 + 64) = 128.
+        lk = [7] * 64
+        rk = [7] * 64
+        p, e = _run_join(lk, range(64), rk, range(64), "inner")
+        out = e.execute_plan(p)["output"].to_pydict()
+        assert len(out["k"]) == 64 * 64
+
+
+class TestJoinRouting:
+    def test_large_inputs_route_to_device(self, monkeypatch):
+        """Above the threshold the device path runs (host path would
+        raise on the duplicate build keys)."""
+        import pixie_tpu.exec.engine as eng_mod
+
+        monkeypatch.setattr(eng_mod, "DEVICE_JOIN_MIN_ROWS", 4)
+        calls = []
+        orig = eng_mod._join_device
+
+        def spy(left, right, op):
+            calls.append(op.how)
+            return orig(left, right, op)
+
+        monkeypatch.setattr(eng_mod, "_join_device", spy)
+        _check([1, 2, 3], [2, 3, 4], "inner")
+        assert calls == ["inner"]
+
+    def test_pxl_right_and_outer_merge(self):
+        """The frontend accepts right/outer and routes to the device."""
+        e = Engine()
+        e.append_data(
+            "a",
+            {"k": np.array([1, 2], dtype=np.int64),
+             "x": np.array([10, 20], dtype=np.int64)},
+            time_cols=(),
+        )
+        e.append_data(
+            "b",
+            {"k": np.array([2, 3], dtype=np.int64),
+             "y": np.array([5, 6], dtype=np.int64)},
+            time_cols=(),
+        )
+        out = e.execute_query("""
+import px
+a = px.DataFrame(table='a')
+b = px.DataFrame(table='b')
+j = a.merge(b, how='outer', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+px.display(j)
+""")["output"].to_pydict()
+        rows = sorted(zip(out["x"].tolist(), out["y"].tolist()))
+        assert rows == [(0, 6), (10, 0), (20, 5)]
