@@ -1,0 +1,103 @@
+"""Uniform config/flag system with environment-variable fallback.
+
+Reference parity: the gflags + ``StringFromEnv`` idiom used throughout the
+reference (``src/carnot/carnot_executable.cc:40-50``,
+``src/vizier/services/agent/pem/pem_manager.cc:26-33``) and the Go
+pflag/viper layer (``src/shared/services/service_flags.go``). One registry:
+every tunable declares a name, type, default and doc here; the value
+resolves from (in order) an explicit ``set_flag`` override, the
+``PIXIE_TPU_<NAME>`` environment variable, then the default.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass
+class Flag:
+    name: str
+    default: object
+    parse: Callable
+    doc: str
+
+    @property
+    def env_var(self) -> str:
+        return "PIXIE_TPU_" + self.name.upper()
+
+
+_REGISTRY: dict[str, Flag] = {}
+_OVERRIDES: dict[str, object] = {}
+_LOCK = threading.Lock()
+
+
+def _parse_bool(s) -> bool:
+    if isinstance(s, bool):
+        return s
+    return str(s).strip().lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name: str, default, doc: str, parse: Callable | None = None) -> None:
+    if parse is None:
+        if isinstance(default, bool):
+            parse = _parse_bool
+        elif isinstance(default, int):
+            parse = int
+        elif isinstance(default, float):
+            parse = float
+        else:
+            parse = str
+    with _LOCK:
+        _REGISTRY[name] = Flag(name=name, default=default, parse=parse, doc=doc)
+
+
+def get_flag(name: str):
+    f = _REGISTRY[name]
+    with _LOCK:
+        if name in _OVERRIDES:
+            return _OVERRIDES[name]
+    env = os.environ.get(f.env_var)
+    if env is not None:
+        return f.parse(env)
+    return f.default
+
+
+def set_flag(name: str, value) -> None:
+    """Programmatic override (the runtime ConfigUpdateMessage analog)."""
+    f = _REGISTRY[name]
+    with _LOCK:
+        _OVERRIDES[name] = f.parse(value) if not isinstance(value, type(f.default)) else value
+
+
+def clear_flag(name: str) -> None:
+    with _LOCK:
+        _OVERRIDES.pop(name, None)
+
+
+def all_flags() -> dict:
+    """{name: (value, doc)} snapshot — the --helpfull / statusz listing."""
+    return {n: (get_flag(n), f.doc) for n, f in sorted(_REGISTRY.items())}
+
+
+# -- engine/table tunables ---------------------------------------------------
+define_flag("window_rows", 1 << 17,
+            "Rows per streamed device window (engine + device residency).")
+define_flag("max_groups", 4096,
+            "Initial group-by capacity; overflow doubles it and re-runs.")
+define_flag("max_groups_limit", 1 << 22,
+            "Hard cap for group-by rebucketing growth.")
+define_flag("groupby_impl", "hash",
+            "Per-window group-id algorithm: 'hash' (bounded-probe device "
+            "table) or 'sort' (multi-key stable sort).")
+define_flag("device_residency", True,
+            "Stage full table windows into device memory (HBM) at append "
+            "time so steady-state queries run without host transfers.")
+define_flag("device_cache_bytes", 6 << 30,
+            "Byte budget for device-resident table windows (LRU-evicted).")
+define_flag("device_join_min_rows", 1 << 15,
+            "Combined row count above which joins route to the device kernel.")
+define_flag("agent_heartbeat_s", 5.0, "Agent heartbeat period (seconds).")
+define_flag("agent_expiry_s", 60.0, "Tracker agent expiry after silence.")

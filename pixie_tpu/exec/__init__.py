@@ -1,4 +1,4 @@
-from .engine import Engine, InMemoryTable, QueryError
+from .engine import Engine, QueryError
 from .plan import (
     AggExpr,
     AggOp,
@@ -17,7 +17,6 @@ from .plan import (
 
 __all__ = [
     "Engine",
-    "InMemoryTable",
     "QueryError",
     "Plan",
     "MemorySourceOp",

@@ -1,0 +1,106 @@
+"""Per-query execution statistics (the ``analyze`` flag).
+
+Reference parity: every Carnot ExecNode tracks ``ExecNodeStats``
+(bytes/rows/batches, self vs child timers — ``src/carnot/exec/
+exec_node.h:40-127``) and ``ExecutePlan`` ships per-operator
+``queryresultspb.OperatorExecutionStats`` (``carnot.cc:389-423``). Here
+the unit of execution is a compiled *fragment* (a whole Map/Filter/Agg
+chain), so stats attach per fragment with a per-stage wall-time
+breakdown of the TPU streaming pipeline:
+
+- ``read``     host slab -> host window (cursor read)
+- ``stage``    host -> device transfer + padding (zero when the window
+               was already device-resident)
+- ``compute``  device program (update/fold), measured to completion
+- ``finalize`` agg finalize program
+- ``materialize`` device -> host copy + host batch assembly
+
+Enabling analyze forces synchronization after each stage
+(``block_until_ready``), so overlap is sacrificed for attribution — run
+benchmarks with it off.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class StageStat:
+    seconds: float = 0.0
+    rows: int = 0
+    count: int = 0
+
+
+@dataclass
+class FragmentStats:
+    """Stats for one materialized fragment."""
+
+    ops: tuple = ()  # operator type names in chain order
+    windows: int = 0
+    rows_in: int = 0
+    rows_out: int = 0
+    stages: dict = field(default_factory=dict)  # {stage: StageStat}
+
+    def add(self, stage: str, seconds: float, rows: int = 0) -> None:
+        s = self.stages.setdefault(stage, StageStat())
+        s.seconds += seconds
+        s.rows += int(rows)
+        s.count += 1
+
+    def timed(self, stage: str, rows: int = 0):
+        return _Timer(self, stage, rows)
+
+    def to_dict(self) -> dict:
+        return {
+            "ops": list(self.ops),
+            "windows": self.windows,
+            "rows_in": self.rows_in,
+            "rows_out": self.rows_out,
+            "stages": {
+                k: {
+                    "seconds": round(v.seconds, 6),
+                    "rows": v.rows,
+                    "count": v.count,
+                }
+                for k, v in self.stages.items()
+            },
+        }
+
+
+class _Timer:
+    def __init__(self, stats: FragmentStats, stage: str, rows: int):
+        self.stats, self.stage, self.rows = stats, stage, rows
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.stats.add(self.stage, time.perf_counter() - self.t0, self.rows)
+
+
+@dataclass
+class QueryStats:
+    """All fragment stats for one plan execution."""
+
+    fragments: list = field(default_factory=list)  # list[FragmentStats]
+    total_seconds: float = 0.0
+
+    def new_fragment(self, ops) -> FragmentStats:
+        fs = FragmentStats(ops=tuple(type(o).__name__ for o in ops))
+        self.fragments.append(fs)
+        return fs
+
+    def to_dict(self) -> dict:
+        totals: dict = {}
+        for f in self.fragments:
+            for k, v in f.stages.items():
+                t = totals.setdefault(k, 0.0)
+                totals[k] = t + v.seconds
+        return {
+            "total_seconds": round(self.total_seconds, 6),
+            "stage_totals": {k: round(v, 6) for k, v in sorted(totals.items())},
+            "fragments": [f.to_dict() for f in self.fragments],
+        }

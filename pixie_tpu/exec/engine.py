@@ -20,6 +20,7 @@ from __future__ import annotations
 import functools
 import itertools
 import json
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -86,72 +87,11 @@ class QueryError(Exception):
 
 
 @dataclass
-class InMemoryTable:
-    """Minimal table: shared per-column dictionaries + row batches.
-
-    Stand-in for the full hot/cold Table (stage 6); the engine only needs
-    ``scan()`` -> HostBatch windows with shared dictionaries.
-    """
-
-    name: str
-    relation: Relation
-    dicts: dict = field(default_factory=dict)
-    batches: list = field(default_factory=list)
-
-    def append(self, data, time_cols=("time_",)) -> HostBatch:
-        hb = (
-            data
-            if isinstance(data, HostBatch)
-            else HostBatch.from_pydict(
-                data,
-                relation=self.relation if len(self.relation) else None,
-                time_cols=time_cols,
-                dicts=self.dicts,
-            )
-        )
-        if not len(self.relation):
-            self.relation = hb.relation
-        for col, d in hb.dicts.items():
-            self.dicts.setdefault(col, d)
-        self.batches.append(hb)
-        return hb
-
-    @property
-    def num_rows(self) -> int:
-        return sum(b.length for b in self.batches)
-
-    def scan(self, start_time=None, stop_time=None):
-        """Yield batches, time-bounded on the ``time_`` column."""
-        for b in self.batches:
-            if (start_time is None and stop_time is None) or not b.relation.has_column(
-                "time_"
-            ):
-                yield b
-                continue
-            t = b.cols["time_"][0]
-            keep = np.ones(b.length, dtype=bool)
-            if start_time is not None:
-                keep &= t >= start_time
-            if stop_time is not None:
-                keep &= t < stop_time
-            if keep.all():
-                yield b
-            elif keep.any():
-                idx = np.nonzero(keep)[0]
-                yield HostBatch(
-                    relation=b.relation,
-                    cols={n: tuple(p[idx] for p in ps) for n, ps in b.cols.items()},
-                    length=len(idx),
-                    dicts=b.dicts,
-                )
-
-
-@dataclass
 class _Stream:
     relation: Relation
     dicts: dict
     chain: list
-    source: object  # InMemoryTable | HostBatch
+    source: object  # list[Table] | Table | HostBatch
     source_op: Optional[MemorySourceOp] = None
 
     def extend(self, op):
@@ -162,12 +102,16 @@ class Engine:
     """Owns tables + registry; executes plans. (EngineState analog,
     ``src/carnot/engine_state.h``.)"""
 
-    def __init__(self, registry: Registry | None = None, window_rows: int = 1 << 17):
+    def __init__(self, registry: Registry | None = None,
+                 window_rows: int | None = None):
+        from ..config import get_flag
         from ..table_store import TableStore
 
         self.registry = registry or default_registry()
         self.table_store = TableStore()
-        self.window_rows = window_rows
+        self.window_rows = window_rows or get_flag("window_rows")
+        self.last_stats = None
+        self._query_stats = None
 
     @property
     def tables(self) -> dict:
@@ -192,9 +136,11 @@ class Engine:
 
     # -- execution -----------------------------------------------------------
     def execute_query(self, query: str, now_ns: int = 0,
-                      max_output_rows: int = 10_000) -> dict:
+                      max_output_rows: int = 10_000,
+                      analyze: bool = False) -> dict:
         """Compile a PxL script and execute it (Carnot::ExecuteQuery parity,
-        ``src/carnot/carnot.cc:122-134``). Returns {output name: HostBatch}."""
+        ``src/carnot/carnot.cc:122-134``). Returns {output name: HostBatch}.
+        ``analyze`` records per-fragment stats on ``self.last_stats``."""
         from ..planner import CompilerState, compile_pxl
 
         state = CompilerState(
@@ -204,7 +150,7 @@ class Engine:
             max_output_rows=max_output_rows,
         )
         compiled = compile_pxl(query, state)
-        return self.execute_plan(compiled.plan)
+        return self.execute_plan(compiled.plan, analyze=analyze)
 
     def set_metadata_state(self, state) -> None:
         """Attach k8s metadata; rebinds the metadata UDFs to a snapshot of
@@ -218,7 +164,8 @@ class Engine:
         self.registry = reg
 
     def execute_plan(
-        self, plan: Plan, bridge_inputs: dict | None = None
+        self, plan: Plan, bridge_inputs: dict | None = None,
+        analyze: bool = False,
     ) -> dict:
         """Execute a plan. Whole plans return {sink name: HostBatch}.
 
@@ -226,7 +173,27 @@ class Engine:
         a plan ending in BridgeSinkOps additionally returns
         {("bridge", id): payload}; a merge plan starting from
         BridgeSourceOps reads ``bridge_inputs`` = {bridge id: [payloads]}.
+
+        ``analyze`` records per-fragment, per-stage execution stats
+        (exec_node.h:40 ExecNodeStats analog) on ``self.last_stats``.
         """
+        if analyze:
+            from .analyze import QueryStats
+
+            self._query_stats = QueryStats()
+            t_start = time.perf_counter()
+            try:
+                out = self._execute_plan_inner(plan, bridge_inputs)
+            finally:
+                self._query_stats.total_seconds = time.perf_counter() - t_start
+                self.last_stats = self._query_stats
+                self._query_stats = None
+            return out
+        return self._execute_plan_inner(plan, bridge_inputs)
+
+    def _execute_plan_inner(
+        self, plan: Plan, bridge_inputs: dict | None = None
+    ) -> dict:
         results: dict[int, object] = {}
         outputs: dict = {}
         consumers: dict[int, int] = {}
@@ -344,14 +311,21 @@ class Engine:
         return hb
 
     # -- bridge (agent-mode) machinery ----------------------------------------
-    def _fold_agg_state(self, stream: "_Stream", frag):
+    def _fold_agg_state(self, stream: "_Stream", frag, stats=None):
         """Stream the source through the fragment's window fold, returning
         the accumulated (unfinalized) group state."""
         init_state, agg_step, _ = self._compile_steps(frag)
         state = init_state()
-        for hb in self._windows(stream):
-            cols, valid = self._stage(hb, self._window_capacity(hb.length))
-            state = agg_step(state, cols, valid)
+        for cols, valid in self._staged_windows(stream, stats):
+            if stats is None:
+                state = agg_step(state, cols, valid)
+            else:
+                import jax
+
+                with stats.timed("compute"):
+                    state = agg_step(state, cols, valid)
+                    jax.block_until_ready(state)
+                stats.windows += 1
         return state
 
     def _bridge_payload(self, res):
@@ -362,10 +336,14 @@ class Engine:
         ):
             import jax
 
-            frag = compile_fragment(
-                res.chain, res.relation, res.dicts, self.registry
-            )
-            state = self._fold_agg_state(res, frag)
+            while True:
+                frag = compile_fragment(
+                    res.chain, res.relation, res.dicts, self.registry
+                )
+                state = self._fold_agg_state(res, frag)
+                if not bool(np.asarray(state["overflow"])):
+                    break
+                res = _double_agg_groups(res)  # rebucket before shipping
             return AggStatePayload(
                 chain=tuple(res.chain),
                 input_relation=res.relation,
@@ -393,14 +371,28 @@ class Engine:
         canonical dictionary (the reference ships raw strings over GRPC,
         so alignment is implicit there; here ids must be reconciled).
         """
+        import dataclasses
+
         import jax
         import jax.numpy as jnp
 
         from .fragment import _bind_pre_stage, _split_chain
 
         p0 = pending.payloads[0]
+        # Agents may have rebucketed independently; merge at the largest
+        # capacity (smaller states pad with neutral slots below).
+        g = max(
+            op.max_groups
+            for p in pending.payloads
+            for op in p.chain
+            if isinstance(op, AggOp)
+        )
+        chain = [
+            dataclasses.replace(op, max_groups=g) if isinstance(op, AggOp) else op
+            for op in p0.chain
+        ]
         frag = compile_fragment(
-            list(p0.chain), p0.input_relation, dict(p0.input_dicts), self.registry
+            chain, p0.input_relation, dict(p0.input_dicts), self.registry
         )
         key_plane_index = frag.key_plane_index
         group_rel = frag.group_relation
@@ -442,15 +434,52 @@ class Engine:
                     keys[pi] = np.where(
                         ids >= 0, remap[np.clip(ids, 0, None)], NULL_ID
                     ).astype(np.int32)
+            if bool(np.asarray(p.state["overflow"])):
+                # Lost groups at the source cannot be recovered here; the
+                # producing agent rebuckets before shipping (_bridge_payload).
+                raise QueryError(
+                    "bridge payload arrived with group overflow; producing "
+                    "agent failed to rebucket"
+                )
             states.append({**p.state, "keys": tuple(keys)})
-        merge = jax.jit(frag.merge_states)
-        acc = jax.tree_util.tree_map(jnp.asarray, states[0])
-        for s in states[1:]:
-            acc = merge(acc, jax.tree_util.tree_map(jnp.asarray, s))
-        cols, valid, overflow = frag.finalize(acc)
-        if bool(overflow):
-            raise QueryError(
-                "group-by overflow merging bridge states; raise max_groups"
+        while True:
+            # Pad smaller states into g neutral slots, fold-merge, and on
+            # merged-distinct overflow double g and retry from the (still
+            # intact) original states.
+            init = frag.init_state()
+
+            def pad(a, i):
+                a = jnp.asarray(a)
+                if a.ndim == 0 or a.shape[0] >= i.shape[0]:
+                    return a
+                return jnp.concatenate([a, i[a.shape[0]:]])
+
+            merge = jax.jit(frag.merge_states)
+            padded = [jax.tree_util.tree_map(pad, s, init) for s in states]
+            acc = padded[0]
+            for s in padded[1:]:
+                acc = merge(acc, s)
+            cols, valid, overflow = frag.finalize(acc)
+            if not bool(overflow):
+                break
+            from ..config import get_flag
+
+            if g * 2 > get_flag("max_groups_limit"):
+                raise QueryError(
+                    f"group-by overflow merging bridge states at "
+                    f"max_groups={g}; rebucketing past the "
+                    f"{get_flag('max_groups_limit')} cap refused "
+                    "(PIXIE_TPU_MAX_GROUPS_LIMIT)"
+                )
+            g *= 2
+            chain = [
+                dataclasses.replace(op, max_groups=g)
+                if isinstance(op, AggOp)
+                else op
+                for op in chain
+            ]
+            frag = compile_fragment(
+                chain, p0.input_relation, dict(p0.input_dicts), self.registry
             )
         meta = [
             (
@@ -503,6 +532,10 @@ class Engine:
                     )
 
     # -- execution seams (overridden by DistributedEngine) -------------------
+    # Whether this engine may consume device-resident table windows (HBM
+    # cold store). DistributedEngine stages row-sharded instead.
+    device_residency = True
+
     def _window_capacity(self, length: int) -> int:
         return max(bucket_capacity(self.window_rows), bucket_capacity(length))
 
@@ -510,6 +543,57 @@ class Engine:
         """Pad a host window to capacity and place it on device."""
         db = hb.to_device(capacity)
         return db.cols, db.valid
+
+    def _staged_windows(self, stream: "_Stream", stats=None):
+        """Yield (cols, valid) device-staged windows for a stream.
+
+        Table sources use the device-resident window cache (zero
+        host->device transfer once staged — SURVEY.md §7 stage 1 "HBM as
+        cold"); host batches and distributed engines stage per window.
+        """
+        from ..config import get_flag
+
+        import jax
+
+        use_cache = (
+            self.device_residency
+            and get_flag("device_residency")
+            and not isinstance(stream.source, HostBatch)
+        )
+        if use_cache:
+            sop = stream.source_op
+            start = sop.start_time if sop else None
+            stop = sop.stop_time if sop else None
+            tables = (
+                stream.source
+                if isinstance(stream.source, list)
+                else [stream.source]
+            )
+            cap = bucket_capacity(self.window_rows)
+            mask_fn = _range_mask_fn(cap)
+            for t in tables:
+                if getattr(t, "_backend", None) is None:
+                    continue
+                for win, lo, hi in t.device_scan(
+                    start, stop, window_rows=self.window_rows
+                ):
+                    t0 = time.perf_counter() if stats is not None else 0
+                    valid = mask_fn(
+                        np.int32(lo - win.row0), np.int32(hi - win.row0)
+                    )
+                    if stats is not None:
+                        stats.add("stage", time.perf_counter() - t0, hi - lo)
+                        stats.rows_in += hi - lo
+                    yield win.cols, valid
+            return
+        for hb in self._windows(stream):
+            t0 = time.perf_counter() if stats is not None else 0
+            cols, valid = self._stage(hb, self._window_capacity(hb.length))
+            if stats is not None:
+                jax.block_until_ready(cols)
+                stats.add("stage", time.perf_counter() - t0, hb.length)
+                stats.rows_in += hb.length
+            yield cols, valid
 
     def _compile_steps(self, frag):
         """(init_state_fn, agg_step, rows_step) for a compiled fragment."""
@@ -524,37 +608,118 @@ class Engine:
         frag = compile_fragment(
             stream.chain, stream.relation, stream.dicts, self.registry
         )
+        qstats = getattr(self, "_query_stats", None)
+        stats = qstats.new_fragment(stream.chain) if qstats is not None else None
 
         if frag.is_agg:
-            state = self._fold_agg_state(stream, frag)
-            cols, valid, overflow = frag.finalize(state)
-            if bool(overflow):
-                raise QueryError(
-                    "group-by overflow: more distinct groups than max_groups; "
-                    "raise AggOp.max_groups"
+            while True:
+                state = self._fold_agg_state(stream, frag, stats)
+                if stats is None:
+                    cols, valid, overflow = frag.finalize(state)
+                else:
+                    import jax
+
+                    with stats.timed("finalize"):
+                        cols, valid, overflow = frag.finalize(state)
+                        jax.block_until_ready((cols, valid, overflow))
+                if not bool(overflow):
+                    break
+                # Rebucket: double max_groups and re-run the stream (the
+                # same recovery the device join uses on output overflow;
+                # Carnot's hash map grows instead, ``agg_node.cc``).
+                stream = _double_agg_groups(stream)
+                frag = compile_fragment(
+                    stream.chain, stream.relation, stream.dicts, self.registry
                 )
-            out = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
+                if qstats is not None:
+                    # Fresh per-attempt stats: totals stay true wall time,
+                    # per-fragment rows/windows stay per-attempt.
+                    stats = qstats.new_fragment(stream.chain)
+                    stats.ops = stats.ops + ("rebucket",)
+            if stats is None:
+                out = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
+            else:
+                with stats.timed("materialize"):
+                    out = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
+                stats.rows_out = out.length
             return _apply_limit(out, frag.limit)
 
         # Non-agg: stream windows, stop early once a limit is satisfied.
         _, _, rows_step = self._compile_steps(frag)
         pieces, total = [], 0
-        for hb in self._windows(stream):
-            cols, valid = self._stage(hb, self._window_capacity(hb.length))
-            out_cols, out_valid = rows_step(cols, valid)
-            piece = _to_host_batch(frag.out_meta, out_cols, np.asarray(out_valid))
+        for cols, valid in self._staged_windows(stream, stats):
+            if stats is None:
+                out_cols, out_valid = rows_step(cols, valid)
+            else:
+                import jax
+
+                with stats.timed("compute"):
+                    out_cols, out_valid = rows_step(cols, valid)
+                    jax.block_until_ready((out_cols, out_valid))
+                stats.windows += 1
+            if stats is None:
+                piece = _to_host_batch(
+                    frag.out_meta, out_cols, np.asarray(out_valid)
+                )
+            else:
+                with stats.timed("materialize"):
+                    piece = _to_host_batch(
+                        frag.out_meta, out_cols, np.asarray(out_valid)
+                    )
             pieces.append(piece)
             total += piece.length
             if frag.limit is not None and total >= frag.limit:
                 break
         out = _concat_host(pieces, frag.relation)
+        if stats is not None:
+            stats.rows_out = out.length
         return _apply_limit(out, frag.limit)
+
+
+@functools.lru_cache(maxsize=16)
+def _range_mask_fn(capacity: int):
+    """Jitted (lo, hi) -> bool[capacity] row-range mask (device-resident
+    windows carry no per-query mask; this computes it on device)."""
+    import jax
+    import jax.numpy as jnp
+
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    return jax.jit(lambda lo, hi: (iota >= lo) & (iota < hi))
 
 
 def _col(name):
     from .plan import ColumnRef
 
     return ColumnRef(name)
+
+
+def _double_agg_groups(stream: "_Stream") -> "_Stream":
+    """Return the stream with its AggOp's max_groups doubled (rebucket)."""
+    import dataclasses
+
+    from ..config import get_flag
+
+    limit = get_flag("max_groups_limit")
+    chain = []
+    doubled = False
+    for op in stream.chain:
+        if isinstance(op, AggOp) and not doubled:
+            g2 = op.max_groups * 2
+            if g2 > limit:
+                raise QueryError(
+                    f"group-by overflow at max_groups={op.max_groups}; "
+                    f"rebucketing past the {limit} cap refused "
+                    "(PIXIE_TPU_MAX_GROUPS_LIMIT)"
+                )
+            chain.append(dataclasses.replace(op, max_groups=g2))
+            doubled = True
+        else:
+            chain.append(op)
+    if not doubled:
+        raise AssertionError("no AggOp in overflowing chain")
+    return _Stream(
+        stream.relation, stream.dicts, chain, stream.source, stream.source_op
+    )
 
 
 def _to_host_batch(meta_list, cols, valid) -> HostBatch:

@@ -25,7 +25,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..ops.groupby import dense_group_ids, regroup_pair, scatter_carry
+from ..config import get_flag
+from ..ops.groupby import (
+    dense_group_ids,
+    dense_group_ids_hash,
+    regroup_pair,
+    scatter_carry,
+)
 from ..types.dtypes import DataType, device_dtypes, pad_values
 from ..types.relation import Relation
 from ..udf.registry import Registry
@@ -190,11 +196,20 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
 
     init_carries = {ae.out_name: uda.init(g) for ae, uda, _, _ in aggs_bound}
 
+    # Per-window group ids: bounded-probe hash table (O(rounds*n)) by
+    # default; 'sort' falls back to the multi-key stable sort. The small
+    # [2G] regroup merges below always use the sort path.
+    window_group_ids = (
+        dense_group_ids_hash
+        if get_flag("groupby_impl") == "hash"
+        else dense_group_ids
+    )
+
     def window_state(cols, valid):
         """Fold one window of rows into a fresh [G]-slot group state."""
         cols, valid = apply_pre(cols, valid)
         key_planes = [cols[c][i] for c, i in key_plane_index]
-        gids, keys_w, valid_w, n_w = dense_group_ids(key_planes, valid, g)
+        gids, keys_w, valid_w, n_w = window_group_ids(key_planes, valid, g)
 
         carries_w = {}
         for ae, uda, arg_bound, casts in aggs_bound:

@@ -1,0 +1,135 @@
+"""Canonical output-table schemas shipped by the ingest edge.
+
+Reference parity: Stirling's static table schemas —
+``src/stirling/source_connectors/socket_tracer/http_table.h`` /
+``conn_stats_table.h`` (kConnStatsElements),
+``perf_profiler/stack_traces_table.h`` (kStackTraceTable),
+``mysql_table.h``, ``source_connectors/process_stats``. These are the
+relations a PEM creates at registration (``pem_manager.cc:86-104``
+InitSchemas) and the contract the shipped PxL script library compiles
+against (``src/e2e_test/vizier/planner/dump_schemas``).
+
+The TPU build materializes the k8s-context columns (``service``/``pod``)
+at ingest time — Stirling fills them from AgentMetadataState during
+TransferData (SURVEY.md §3.2) — so group-bys hit dictionary ids directly.
+"""
+
+from __future__ import annotations
+
+from ..types.dtypes import DataType
+from ..types.relation import Relation
+from .replay import HTTP_EVENTS_RELATION
+
+# conn_stats_table.h kConnStatsElements (+ materialized k8s context).
+CONN_STATS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("remote_addr", DataType.STRING),
+        ("remote_port", DataType.INT64),
+        ("trace_role", DataType.INT64),
+        ("addr_family", DataType.INT64),
+        ("protocol", DataType.INT64),
+        ("ssl", DataType.BOOLEAN),
+        ("conn_open", DataType.INT64),
+        ("conn_close", DataType.INT64),
+        ("conn_active", DataType.INT64),
+        ("bytes_sent", DataType.INT64),
+        ("bytes_recv", DataType.INT64),
+        ("src_addr", DataType.STRING),
+        ("src_pod", DataType.STRING),
+    ]
+)
+
+# stack_traces_table.h kStackTraceTable ("stack_traces.beta").
+STACK_TRACES_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("stack_trace_id", DataType.INT64),
+        ("stack_trace", DataType.STRING),
+        ("count", DataType.INT64),
+        ("pod", DataType.STRING),
+    ]
+)
+
+# mysql_table.h kMySQLTable (subset: the sql_stats script surface).
+MYSQL_EVENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("req_cmd", DataType.INT64),
+        ("query_str", DataType.STRING),  # req_body in the reference
+        ("resp_status", DataType.INT64),
+        ("latency_ns", DataType.INT64),
+        ("service", DataType.STRING),
+    ]
+)
+
+# process_stats connector (proc-fs metrics).
+PROCESS_STATS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("major_faults", DataType.INT64),
+        ("minor_faults", DataType.INT64),
+        ("cpu_utime_ns", DataType.INT64),
+        ("cpu_ktime_ns", DataType.INT64),
+        ("rss_bytes", DataType.INT64),
+        ("vsize_bytes", DataType.INT64),
+        ("rchar_bytes", DataType.INT64),
+        ("wchar_bytes", DataType.INT64),
+        ("read_bytes", DataType.INT64),
+        ("write_bytes", DataType.INT64),
+        ("pod", DataType.STRING),
+    ]
+)
+
+# network_stats connector (per-pod RX/TX).
+NETWORK_STATS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("pod_id", DataType.STRING),
+        ("rx_bytes", DataType.INT64),
+        ("rx_packets", DataType.INT64),
+        ("rx_errors", DataType.INT64),
+        ("rx_drops", DataType.INT64),
+        ("tx_bytes", DataType.INT64),
+        ("tx_packets", DataType.INT64),
+        ("tx_errors", DataType.INT64),
+        ("tx_drops", DataType.INT64),
+        ("pod", DataType.STRING),
+    ]
+)
+
+# dns_table.h kDNSTable (subset).
+DNS_EVENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("req_header", DataType.STRING),
+        ("req_body", DataType.STRING),
+        ("resp_header", DataType.STRING),
+        ("resp_body", DataType.STRING),
+        ("latency_ns", DataType.INT64),
+        ("pod", DataType.STRING),
+    ]
+)
+
+#: Every schema a PEM ships (InitSchemas analog): table name -> Relation.
+CANONICAL_SCHEMAS: dict[str, Relation] = {
+    "http_events": HTTP_EVENTS_RELATION,
+    "conn_stats": CONN_STATS_RELATION,
+    "stack_traces.beta": STACK_TRACES_RELATION,
+    "mysql_events": MYSQL_EVENTS_RELATION,
+    "process_stats": PROCESS_STATS_RELATION,
+    "network_stats": NETWORK_STATS_RELATION,
+    "dns_events": DNS_EVENTS_RELATION,
+}
+
+
+def init_schemas(target) -> None:
+    """Create every canonical table on an engine/table-store-like target
+    (``pem_manager.cc:86-104`` InitSchemas analog)."""
+    for name, rel in CANONICAL_SCHEMAS.items():
+        target.create_table(name, rel)

@@ -2,22 +2,24 @@
 
 Reference parity: Carnot's BlockingAggNode builds an absl flat_hash_map
 keyed by RowTuple (``src/carnot/exec/agg_node.h:66``,
-``src/carnot/exec/row_tuple.h``). Hash maps are hostile to XLA, so groups
-are found by **multi-key lexicographic sort + first-occurrence cumsum**:
-exact (no hash collisions), fully static shapes, and the sort is the same
-machinery the t-digest uses.
+``src/carnot/exec/row_tuple.h``). Two exact device strategies:
 
-Two layers:
+- ``dense_group_ids`` — **multi-key lexicographic sort + first-occurrence
+  cumsum**: no hashing at all; used for small inputs (regrouping two [G]
+  states) where sort cost is negligible.
+- ``dense_group_ids_hash`` — **bounded-probe open-addressing insert on
+  device**: rows claim slots in a 2G-slot table via scatter-min rounds,
+  then slot ranks give dense ids. Exact (full keys are compared, the hash
+  only picks probe order); O(rounds * n) elementwise work instead of
+  O(key_planes) full-window stable sorts — the per-window fast path.
+  Probe exhaustion reports overflow, which the engine's rebucketing
+  doubles away (Carnot's growing hash map, ``agg_node.cc``).
 
-- ``dense_group_ids``: rows -> dense ids in [0, max_groups), plus the
-  per-group key values and an overflow indicator (distinct groups beyond
-  the static capacity are clamped into the last slot and flagged).
-- ``scatter_group_state`` / regroup: align two group states (different
-  slot orders, e.g. accumulated-state x new-window, or per-device
-  partials) onto a shared dense id space so UDA carries can be merged
-  slot-wise. This is the TPU replacement for Carnot's
-  partial-agg-serialize -> GRPC -> finalize-agg pipeline
-  (``planner/distributed/splitter/partial_op_mgr``).
+Plus the regroup layer: align two group states (different slot orders,
+e.g. accumulated-state x new-window, or per-device partials) onto a shared
+dense id space so UDA carries can be merged slot-wise. This is the TPU
+replacement for Carnot's partial-agg-serialize -> GRPC -> finalize-agg
+pipeline (``planner/distributed/splitter/partial_op_mgr``).
 """
 
 from __future__ import annotations
@@ -27,9 +29,18 @@ import jax.numpy as jnp
 
 
 def _sortable(plane):
-    """Map a key plane to a sortable array (bools -> int8)."""
+    """Map a key plane to its sortable bit view.
+
+    Sorting/grouping happens on bit patterns (``_to_bits``), not values,
+    so float keys group exactly by payload — bit-identical NaNs form ONE
+    group — matching the hash path. (Value order for negative floats
+    differs from numeric order; group *membership* is unaffected and
+    callers never rely on group emission order.)
+    """
     if plane.dtype == jnp.bool_:
         return plane.astype(jnp.int8)
+    if jnp.issubdtype(plane.dtype, jnp.floating):
+        return _to_bits(plane)
     return plane
 
 
@@ -81,6 +92,162 @@ def dense_group_ids(key_planes, mask, max_groups: int):
     group_valid = first_idx < n
     safe_idx = jnp.where(group_valid, first_idx, 0)
     group_keys = [p[safe_idx] for p in key_planes]
+    return gids, group_keys, group_valid, n_groups
+
+
+def _to_bits(p):
+    """Bit-exact unsigned view of a key plane (u32 or u64).
+
+    Comparing bit patterns (not values) makes float keys well-defined for
+    NaNs and costs nothing for ints.
+    """
+    if p.dtype == jnp.bool_:
+        return p.astype(jnp.uint32)
+    nbits = p.dtype.itemsize * 8
+    if nbits < 32:
+        return jax.lax.bitcast_convert_type(
+            p.astype(jnp.int32), jnp.uint32
+        )
+    target = jnp.uint32 if nbits == 32 else jnp.uint64
+    return jax.lax.bitcast_convert_type(p, target)
+
+
+def _from_bits(bits, dtype):
+    if dtype == jnp.bool_:
+        return bits != 0
+    nbits = jnp.dtype(dtype).itemsize * 8
+    if nbits < 32:
+        return jax.lax.bitcast_convert_type(bits, jnp.int32).astype(dtype)
+    return jax.lax.bitcast_convert_type(bits, dtype)
+
+
+def _mix32(x):
+    """32-bit finalizer (lowbias32); wrapping uint32 arithmetic."""
+    x ^= x >> jnp.uint32(16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x ^= x >> jnp.uint32(15)
+    x = x * jnp.uint32(0x846CA68B)
+    x ^= x >> jnp.uint32(16)
+    return x
+
+
+def _hash_bits(bit_planes):
+    h = jnp.full(bit_planes[0].shape, jnp.uint32(0x9E3779B9))
+    for b in bit_planes:
+        if b.dtype == jnp.uint64:
+            h = _mix32(h ^ (b & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+            h = _mix32(h ^ (b >> jnp.uint64(32)).astype(jnp.uint32))
+        else:
+            h = _mix32(h ^ b)
+    return h
+
+
+def _table_size(max_groups: int) -> int:
+    size = 16
+    while size < 2 * max_groups:
+        size *= 2
+    return size
+
+
+def dense_group_ids_hash(key_planes, mask, max_groups: int,
+                         max_rounds: int = 32):
+    """``dense_group_ids`` via a device-built open-addressing table.
+
+    Same contract as ``dense_group_ids`` except group ids are in hash
+    (arbitrary) order rather than key-sorted order. Rows linear-probe a
+    2G-slot table: each round, rows whose candidate slot is free race to
+    claim it (scatter-min on row index), the winner publishes its key,
+    and every row whose candidate slot now holds its exact key resolves.
+    Unresolved rows after ``max_rounds`` report overflow (n_groups >
+    max_groups) so the caller rebuckets larger.
+    """
+    n = mask.shape[0]
+    if not key_planes:
+        # No-group aggregation: every valid row lands in slot 0 (matches
+        # the sort path's degenerate behavior).
+        gids = jnp.where(mask, 0, max_groups).astype(jnp.int32)
+        group_valid = (
+            jnp.zeros(max_groups, dtype=jnp.bool_).at[0].set(jnp.any(mask))
+        )
+        return gids, [], group_valid, jnp.int32(0)
+    size = _table_size(max_groups)
+    bit_planes = [_to_bits(p) for p in key_planes]
+    base = (_hash_bits(bit_planes) & jnp.uint32(size - 1)).astype(jnp.int32)
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    slot_bits0 = tuple(
+        jnp.zeros(size + 1, dtype=b.dtype) for b in bit_planes
+    )
+    occupied0 = jnp.zeros(size + 1, dtype=jnp.bool_)
+
+    def round_body(carry):
+        r, active, row_slot, occupied, slot_bits = carry
+        cand = (base + r) & jnp.int32(size - 1)
+        free = ~occupied[cand]
+        contender = active & free
+        claim_idx = jnp.where(contender, cand, size)
+        claims = (
+            jnp.full(size + 1, n, dtype=jnp.int32).at[claim_idx].min(iota)
+        )
+        winner = contender & (claims[cand] == iota)
+        win_idx = jnp.where(winner, cand, size)
+        occupied = occupied.at[win_idx].set(True)
+        occupied = occupied.at[size].set(False)
+        slot_bits = tuple(
+            sb.at[win_idx].set(b) for sb, b in zip(slot_bits, bit_planes)
+        )
+        # Resolve rows whose candidate slot now holds their exact key.
+        match = active & occupied[cand]
+        for sb, b in zip(slot_bits, bit_planes):
+            match = match & (sb[cand] == b)
+        row_slot = jnp.where(match, cand, row_slot)
+        active = active & ~match
+        return r + 1, active, row_slot, occupied, slot_bits
+
+    def round_cond(carry):
+        r, active, *_ = carry
+        return (r < max_rounds) & jnp.any(active)
+
+    init = (
+        jnp.int32(0),
+        mask,
+        jnp.full(n, -1, dtype=jnp.int32),
+        occupied0,
+        slot_bits0,
+    )
+    _, active, row_slot, occupied, slot_bits = jax.lax.while_loop(
+        round_cond, round_body, init
+    )
+    probe_failed = jnp.any(active)
+
+    occ = occupied[:size]
+    rank = jnp.cumsum(occ.astype(jnp.int32)) - 1  # [size]
+    n_occupied = jnp.sum(occ.astype(jnp.int32))
+    n_groups = jnp.where(
+        probe_failed, jnp.int32(max_groups + 1), n_occupied
+    )
+    # Row ids: rank of the row's slot, clamped into [0, G) for valid rows
+    # (overflowing ranks land in the last slot, like the sort path);
+    # invalid/unresolved rows get the trash slot G.
+    resolved = mask & (row_slot >= 0)
+    raw_gid = rank[jnp.clip(row_slot, 0, size - 1)]
+    gids = jnp.where(
+        resolved, jnp.clip(raw_gid, 0, max_groups - 1), max_groups
+    ).astype(jnp.int32)
+
+    # Dense [G] key values from occupied slots (rank < G).
+    dense_idx = jnp.where(occ & (rank < max_groups), rank, max_groups)
+    group_keys = []
+    for sb, p in zip(slot_bits, key_planes):
+        dense = (
+            jnp.zeros(max_groups + 1, dtype=sb.dtype)
+            .at[dense_idx]
+            .set(sb[:size])[:max_groups]
+        )
+        group_keys.append(_from_bits(dense, p.dtype))
+    group_valid = jnp.arange(max_groups, dtype=jnp.int32) < jnp.minimum(
+        n_occupied, max_groups
+    )
     return gids, group_keys, group_valid, n_groups
 
 

@@ -94,6 +94,10 @@ class DistributedEngine(Engine):
     inputs); all per-row work and partial-agg merging is on-mesh.
     """
 
+    # Windows stage row-sharded over the mesh per query; the single-device
+    # resident cache does not apply here (mesh residency is future work).
+    device_residency = False
+
     def __init__(self, registry=None, window_rows: int = 1 << 17,
                  mesh: Mesh | None = None, n_agents: int | None = None,
                  n_kelvin: int = 1, distributed_state=None):
@@ -103,7 +107,7 @@ class DistributedEngine(Engine):
         self.distributed_state = distributed_state
         self.last_distributed_plan = None
 
-    def execute_plan(self, plan, bridge_inputs=None):
+    def execute_plan(self, plan, bridge_inputs=None, analyze=False):
         """Replan against the live agent set before executing (the
         reference pulls DistributedState fresh per query —
         ``query_executor.go:415``).
@@ -114,7 +118,7 @@ class DistributedEngine(Engine):
         plan), and bridges are stitched against that executing mesh.
         """
         if self.distributed_state is None:
-            return super().execute_plan(plan, bridge_inputs=bridge_inputs)
+            return super().execute_plan(plan, bridge_inputs=bridge_inputs, analyze=analyze)
 
         from ..exec.engine import QueryError
         from ..planner.distributed import DistributedPlanner
@@ -142,7 +146,7 @@ class DistributedEngine(Engine):
         saved = (self.mesh, self.n_devices)
         self.mesh, self.n_devices = mesh, int(np.prod(mesh.devices.shape))
         try:
-            return super().execute_plan(plan, bridge_inputs=bridge_inputs)
+            return super().execute_plan(plan, bridge_inputs=bridge_inputs, analyze=analyze)
         finally:
             self.mesh, self.n_devices = saved
 

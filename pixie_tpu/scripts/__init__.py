@@ -1,0 +1,79 @@
+"""Shipped PxL script library.
+
+Reference parity: ``src/pxl_scripts/px/`` — 60 script directories, each a
+``manifest.yaml`` + ``*.pxl`` (+ vis spec), compiled wholesale in CI by
+``src/e2e_test/vizier/planner/all_scripts_test.go`` against dumped
+cluster schemas. Here every script dir under ``px/`` holds
+``manifest.yaml`` + ``<name>.pxl`` (+ optional ``vis.json``), compiles
+against the canonical ingest schemas (``pixie_tpu.ingest.schemas``), and
+``tests/test_scripts.py`` is the compile-all regression.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+_SCRIPT_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "px")
+
+
+@dataclass
+class ScriptDef:
+    """One shipped script: PxL source + manifest metadata."""
+
+    name: str  # e.g. "px/http_stats"
+    path: str
+    pxl: str
+    manifest: dict = field(default_factory=dict)
+    vis: str | None = None  # vis.json contents when present
+
+    @property
+    def tables(self) -> list[str]:
+        return list(self.manifest.get("tables", []))
+
+
+def list_scripts() -> list[str]:
+    """Names of every shipped script (sorted)."""
+    if not os.path.isdir(_SCRIPT_ROOT):
+        return []
+    return sorted(
+        f"px/{d}"
+        for d in os.listdir(_SCRIPT_ROOT)
+        if os.path.isdir(os.path.join(_SCRIPT_ROOT, d))
+        and any(
+            f.endswith(".pxl")
+            for f in os.listdir(os.path.join(_SCRIPT_ROOT, d))
+        )
+    )
+
+
+def load_script(name: str) -> ScriptDef:
+    """Load ``px/<short>`` (or bare ``<short>``) from the library."""
+    import yaml
+
+    short = name.split("/", 1)[1] if "/" in name else name
+    d = os.path.join(_SCRIPT_ROOT, short)
+    if not os.path.isdir(d):
+        raise KeyError(f"no shipped script named {name!r}")
+    pxl_files = [f for f in os.listdir(d) if f.endswith(".pxl")]
+    if not pxl_files:
+        raise KeyError(f"script dir {d} has no .pxl file")
+    with open(os.path.join(d, sorted(pxl_files)[0])) as f:
+        pxl = f.read()
+    manifest = {}
+    mpath = os.path.join(d, "manifest.yaml")
+    if os.path.exists(mpath):
+        with open(mpath) as f:
+            manifest = yaml.safe_load(f) or {}
+    vis = None
+    vpath = os.path.join(d, "vis.json")
+    if os.path.exists(vpath):
+        with open(vpath) as f:
+            vis = f.read()
+    return ScriptDef(
+        name=f"px/{short}", path=d, pxl=pxl, manifest=manifest, vis=vis
+    )
+
+
+def load_all() -> list[ScriptDef]:
+    return [load_script(n) for n in list_scripts()]

@@ -1,0 +1,149 @@
+"""Device-resident table windows: HBM as the cold store.
+
+Reference contrast: Carnot's Table keeps hot ColumnWrapper batches and
+cold Arrow slabs in host RAM (``src/table_store/table/table.h:104``), and
+every query re-reads them. On TPU the equivalent of "cold" is **HBM**:
+a full window of rows is staged onto the device once — at append time,
+asynchronously — and every subsequent query consumes the already-resident
+buffers, so steady-state queries perform zero host->device transfers of
+table data (SURVEY.md §7 stage 1, §5 long-context).
+
+Windows are aligned to absolute row-id multiples of ``window_rows`` (row
+ids are monotone and never reused — ``table.h`` unique-row-id cursors), so
+a window's content is immutable once full. Partial tail windows are cached
+keyed by their current length and re-staged as they grow; expired windows
+are evicted. An LRU byte budget (``device_cache_bytes``) bounds HBM use.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..config import get_flag
+from ..types.dtypes import device_dtypes, pad_values
+
+
+@dataclass
+class DeviceWindow:
+    """One staged window: device column planes + occupancy info.
+
+    ``cols`` maps column name -> tuple of jnp planes, each of length
+    ``capacity`` (== the window size, a power of two). Rows
+    [row0, row0 + n) are live; the validity mask for a query's row range
+    is computed on device by the engine (cheap iota compares).
+    """
+
+    row0: int  # absolute row id of slot 0
+    n: int  # live rows staged
+    capacity: int
+    cols: dict  # {name: tuple(jnp arrays)}
+    nbytes: int
+
+
+class DeviceWindowCache:
+    """LRU cache of staged windows for one Table."""
+
+    def __init__(self):
+        self._entries: OrderedDict[tuple, DeviceWindow] = OrderedDict()
+        self._bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def get(self, key: tuple) -> DeviceWindow | None:
+        win = self._entries.get(key)
+        if win is not None:
+            self._entries.move_to_end(key)
+        return win
+
+    def put(self, key: tuple, win: DeviceWindow) -> None:
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._bytes -= old.nbytes
+        self._entries[key] = win
+        self._bytes += win.nbytes
+        # Evict partial-window predecessors of the same (window_rows,
+        # window_index) — key = (W, k, row0, n): a grown window supersedes
+        # its stale shorter stagings.
+        stale = [
+            k for k in self._entries if k[:2] == key[:2] and k != key
+        ]
+        for k in stale:
+            self._evict(k)
+        budget = get_flag("device_cache_bytes")
+        while self._bytes > budget and len(self._entries) > 1:
+            self._evict(next(iter(self._entries)))
+
+    def _evict(self, key: tuple) -> None:
+        win = self._entries.pop(key, None)
+        if win is not None:
+            self._bytes -= win.nbytes
+
+    def evict_other_window_sizes(self, window_rows: int) -> None:
+        """Drop entries staged at a different window size.
+
+        A consumer scanning at W can never hit a (W', ...) entry; leaving
+        them resident would double HBM use when append-time staging
+        (keyed by the ``window_rows`` flag) disagrees with an engine's
+        explicit ``window_rows`` override.
+        """
+        stale = [k for k in self._entries if k[0] != window_rows]
+        for k in stale:
+            self._evict(k)
+
+    def evict_before(self, first_row_id: int) -> None:
+        """Drop windows fully expired from the table."""
+        stale = [
+            k
+            for k, w in self._entries.items()
+            if w.row0 + w.n <= first_row_id
+        ]
+        for k in stale:
+            self._evict(k)
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0
+
+
+def stage_window(table, window_index: int, window_rows: int) -> DeviceWindow | None:
+    """Read window ``window_index`` (rows [k*W, (k+1)*W)) and place it on
+    device. Returns None for an empty window. The device_put is
+    asynchronous — callers at append time pay only the host read/pad."""
+    import jax.numpy as jnp
+
+    from ..types.batch import bucket_capacity
+
+    be = table._backend
+    lo = window_index * window_rows
+    planes, first, n = be.read(max(lo, be.first_row_id()), window_rows)
+    hi_cap = (window_index + 1) * window_rows
+    if n > 0 and first + n > hi_cap:  # clip reads that ran past the window
+        n = max(0, hi_cap - first)
+        planes = [p[:n] for p in planes]
+    if n <= 0:
+        return None
+    cap = bucket_capacity(window_rows)
+    cols: dict = {}
+    nbytes = 0
+    for (cname, plane_i), p in zip(table._plane_layout, planes):
+        dt = table.relation.col_type(cname)
+        ddt = np.dtype(device_dtypes(dt)[plane_i])  # f64 -> f32 etc.
+        padded = np.full(cap, pad_values(dt)[plane_i], dtype=ddt)
+        padded[:n] = p
+        arr = jnp.asarray(padded)
+        cols.setdefault(cname, {})[plane_i] = arr
+        nbytes += cap * ddt.itemsize
+    cols = {
+        c: tuple(v[i] for i in sorted(v)) for c, v in cols.items()
+    }
+    return DeviceWindow(
+        row0=first, n=n, capacity=cap, cols=cols, nbytes=nbytes
+    )

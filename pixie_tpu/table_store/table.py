@@ -407,6 +407,10 @@ class Table:
         self.compacted_rows = compacted_rows
         self._backend = None
         self._plane_layout: list[tuple[str, int]] = []  # native order
+        # Device residency (HBM as cold store): staged windows + watermark
+        # of rows already staged at append time (device_cache.py).
+        self._device_cache = None
+        self._staged_through = 0
         if len(self.relation):
             self._init_backend()
 
@@ -486,6 +490,12 @@ class Table:
                 )
         times = cols[TIME_COLUMN][0] if (TIME_COLUMN, 0) == self._plane_layout[0] else None
         self._backend.append(planes, times)
+        from ..config import get_flag
+
+        if get_flag("device_residency"):
+            # Ship any newly completed windows to device now (the
+            # device_put is async) so queries find them resident.
+            self.stage_resident()
         return hb
 
     def compact(self) -> int:
@@ -510,6 +520,79 @@ class Table:
             if hb is None:
                 break
             yield hb
+
+    def stage_resident(self, window_rows: int | None = None) -> None:
+        """Stage all complete windows onto the device (HBM cold store)."""
+        from ..config import get_flag
+        from .device_cache import DeviceWindowCache, stage_window
+
+        if self._backend is None:
+            return
+        w = int(window_rows or get_flag("window_rows"))
+        if self._device_cache is None:
+            self._device_cache = DeviceWindowCache()
+        be = self._backend
+        self._device_cache.evict_before(be.first_row_id())
+        end = be.end_row_id()
+        self._staged_through = max(
+            self._staged_through, (be.first_row_id() // w) * w
+        )
+        while self._staged_through + w <= end:
+            k = self._staged_through // w
+            win = stage_window(self, k, w)
+            if win is not None:
+                self._device_cache.put((w, k, win.row0, win.n), win)
+            self._staged_through = (k + 1) * w
+
+    def device_scan(self, start_time=None, stop_time=None,
+                    window_rows: int | None = None):
+        """Yield (DeviceWindow, lo_row, hi_row) covering the time range.
+
+        Windows come from the device-resident cache when staged (zero
+        transfer); misses — typically the partial tail window — stage on
+        demand and are cached keyed by their length, so a grown tail
+        re-stages while full windows stay immutable.
+        """
+        from ..config import get_flag
+        from .device_cache import DeviceWindowCache, stage_window
+
+        if self._backend is None:
+            return
+        w = int(window_rows or get_flag("window_rows"))
+        be = self._backend
+        if self._device_cache is None:
+            self._device_cache = DeviceWindowCache()
+        self._device_cache.evict_before(be.first_row_id())
+        # An engine overriding window_rows away from the flag value makes
+        # append-time stagings dead weight; reclaim them. (Keep the two in
+        # sync — PIXIE_TPU_WINDOW_ROWS — to get zero-transfer steady state.)
+        self._device_cache.evict_other_window_sizes(w)
+        if start_time is not None:
+            start_row = be.row_id_for_time(int(start_time), False)
+        else:
+            start_row = be.first_row_id()
+        if stop_time is not None:
+            stop_row = min(
+                be.row_id_for_time(int(stop_time) - 1, True), be.end_row_id()
+            )
+        else:
+            stop_row = be.end_row_id()
+        if stop_row <= start_row:
+            return
+        for k in range(start_row // w, (stop_row + w - 1) // w):
+            first = max(k * w, be.first_row_id())
+            n = min((k + 1) * w, be.end_row_id()) - first
+            if n <= 0:
+                continue
+            win = self._device_cache.get((w, k, first, n))
+            if win is None:
+                win = stage_window(self, k, w)
+                if win is None:
+                    continue
+                self._device_cache.put((w, k, win.row0, win.n), win)
+            lo, hi = max(start_row, win.row0), min(stop_row, win.row0 + win.n)
+            if hi > lo:
+                yield win, lo, hi
 
     def _batch_from_planes(self, planes, cols=None) -> HostBatch:
         by_key = {k: p for k, p in zip(self._plane_layout, planes)}

@@ -240,7 +240,9 @@ class TestAgg:
         assert abs(row["p50"] - truth) / truth < 0.05
         assert set(row) == {"p01", "p10", "p25", "p50", "p75", "p90", "p99"}
 
-    def test_agg_overflow_raises(self, engine):
+    def test_agg_overflow_rebuckets(self, engine):
+        """Overflow no longer fails: the engine doubles max_groups and
+        re-runs (Carnot's growing hash map, ``agg_node.cc``)."""
         p = Plan()
         src = p.add(MemorySourceOp(table="http_events"))
         agg = p.add(
@@ -252,8 +254,30 @@ class TestAgg:
             [src],
         )
         p.add(ResultSinkOp("output"), [agg])
+        out = run(engine, p).to_pydict()
+        table = engine.tables["http_events"].read_all()
+        lat = table.cols["latency_ns"][0]
+        assert len(out["latency_ns"]) == len(np.unique(lat))
+        assert out["n"].sum() == len(lat)
+
+    def test_agg_overflow_cap_raises(self, engine, monkeypatch):
+        from pixie_tpu import config
+
+        monkeypatch.setenv("PIXIE_TPU_MAX_GROUPS_LIMIT", "128")
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        agg = p.add(
+            AggOp(
+                group_cols=("latency_ns",),
+                aggs=(AggExpr("n", "count", (C("latency_ns"),)),),
+                max_groups=64,
+            ),
+            [src],
+        )
+        p.add(ResultSinkOp("output"), [agg])
         with pytest.raises(QueryError, match="overflow"):
             run(engine, p)
+        assert config.get_flag("max_groups_limit") == 128
 
     def test_post_agg_map_filter(self, engine):
         p = Plan()
