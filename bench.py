@@ -43,6 +43,14 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _script(name: str) -> str:
+    """PxL source of a shipped library script (the bench runs the same
+    scripts the library ships — VERDICT r02 ask #8)."""
+    from pixie_tpu.scripts import load_script
+
+    return load_script(name).pxl
+
+
 # ---------------------------------------------------------------------------
 # Launcher: subprocess orchestration so one bad backend never zeroes the run.
 # ---------------------------------------------------------------------------
@@ -226,17 +234,7 @@ def _shape_http_stats(n, window):
     eng, warm = _build_engines("http_events", rel, cols, n, window,
                                {"service": svc_dict, "req_path": path_dict})
 
-    query = """
-import px
-df = px.DataFrame(table='http_events')
-df = df[df.resp_status < 400]
-df = df.groupby(['service', 'req_path']).agg(
-    n=('latency_ns', px.count),
-    lat_mean=('latency_ns', px.mean),
-    lat_max=('latency_ns', px.max),
-)
-px.display(df)
-"""
+    query = _script("px/http_stats")
     rps, dt, out, prof = _time_query(eng, query, n, warm_eng=warm, profile=True)
 
     # numpy baseline (timed: this is the vs_baseline denominator).
@@ -270,20 +268,7 @@ def _shape_service_stats(engines, data, n):
     http_events replay already in the engine)."""
     eng, warm = engines
     lat, status, svc_codes = data
-    query = """
-import px
-df = px.DataFrame(table='http_events')
-df.failure = df.resp_status >= 400
-per_svc = df.groupby('service').agg(
-    lat_q=('latency_ns', px.quantiles),
-    error_rate=('failure', px.mean),
-    throughput=('latency_ns', px.count),
-)
-per_svc.p50 = px.pluck_float64(per_svc.lat_q, 'p50')
-per_svc.p99 = px.pluck_float64(per_svc.lat_q, 'p99')
-per_svc = per_svc[['service', 'p50', 'p99', 'error_rate', 'throughput']]
-px.display(per_svc)
-"""
+    query = _script("px/service_stats")
     rps, dt, out = _time_query(eng, query, n, warm_eng=warm)
 
     t0 = time.perf_counter()
@@ -326,7 +311,7 @@ def _shape_net_flow_graph(n, window):
         ("time_", DataType.TIME64NS),
         ("src_addr", DataType.STRING),
         ("src_pod", DataType.STRING),
-        ("dst_addr", DataType.STRING),
+        ("remote_addr", DataType.STRING),
         ("bytes_sent", DataType.INT64),
         ("bytes_recv", DataType.INT64),
     ])
@@ -341,32 +326,16 @@ def _shape_net_flow_graph(n, window):
             "time_": (np.arange(off, off + m, dtype=np.int64),),
             "src_addr": (src[s],),   # pod i owns addr i
             "src_pod": (src[s],),
-            "dst_addr": (dst[s],),
+            "remote_addr": (dst[s],),
             "bytes_sent": (sent[s],),
             "bytes_recv": (recv[s],),
         }
 
     eng, warm = _build_engines("conn_stats", rel, cols, n, window,
                                {"src_addr": addr_dict, "src_pod": pod_dict,
-                                "dst_addr": addr_dict})
+                                "remote_addr": addr_dict})
 
-    query = """
-import px
-df = px.DataFrame(table='conn_stats')
-flows = df.groupby(['src_pod', 'dst_addr']).agg(
-    bytes_sent=('bytes_sent', px.sum),
-    bytes_recv=('bytes_recv', px.sum),
-)
-addrs = df.groupby(['src_addr', 'src_pod']).agg(m=('bytes_sent', px.count))
-addrs = addrs[['src_addr', 'src_pod']]
-g = flows.merge(addrs, how='inner', left_on=['dst_addr'],
-                right_on=['src_addr'], suffixes=['', '_dst'])
-out = g.groupby(['src_pod', 'src_pod_dst']).agg(
-    bytes_sent=('bytes_sent', px.sum),
-    bytes_recv=('bytes_recv', px.sum),
-)
-px.display(out)
-"""
+    query = _script("px/net_flow_graph")
     rps, dt, out = _time_query(eng, query, n, warm_eng=warm)
 
     t0 = time.perf_counter()
@@ -424,17 +393,7 @@ def _shape_sql_stats(n, window):
     eng, warm = _build_engines("mysql_events", rel, cols, n, window,
                                {"query_str": q_dict})
 
-    query = """
-import px
-df = px.DataFrame(table='mysql_events')
-df.query_norm = px.normalize_mysql(df.query_str)
-df.window = px.bin(df.time_, px.DurationNanos(1000000000))
-out = df.groupby(['query_norm', 'window']).agg(
-    n=('latency_ns', px.count),
-    lat_mean=('latency_ns', px.mean),
-)
-px.display(out)
-"""
+    query = _script("px/sql_stats")
     rps, dt, out = _time_query(eng, query, n, warm_eng=warm)
 
     t0 = time.perf_counter()
@@ -480,7 +439,7 @@ def _shape_perf_flamegraph(n, window):
     rel = Relation([
         ("time_", DataType.TIME64NS),
         ("stack_trace", DataType.STRING),
-        ("cnt", DataType.INT64),
+        ("count", DataType.INT64),
     ])
     sc = _codes(rng, n, len(stacks))
     cnt = rng.integers(1, 50, n)
@@ -490,18 +449,13 @@ def _shape_perf_flamegraph(n, window):
         return {
             "time_": (np.arange(off, off + m, dtype=np.int64),),
             "stack_trace": (sc[s],),
-            "cnt": (cnt[s],),
+            "count": (cnt[s],),
         }
 
-    eng, warm = _build_engines("stack_traces", rel, cols, n, window,
+    eng, warm = _build_engines("stack_traces.beta", rel, cols, n, window,
                                {"stack_trace": st_dict})
 
-    query = """
-import px
-df = px.DataFrame(table='stack_traces')
-out = df.groupby('stack_trace').agg(count=('cnt', px.sum))
-px.display(out)
-"""
+    query = _script("px/perf_flamegraph")
     rps, dt, out = _time_query(eng, query, n, warm_eng=warm)
 
     t0 = time.perf_counter()

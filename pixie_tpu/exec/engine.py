@@ -128,7 +128,11 @@ class Engine:
     # -- table management ----------------------------------------------------
     def create_table(self, name: str, relation: Relation | None = None,
                      max_bytes: int = -1):
-        return self.table_store.add_table(name, relation, max_bytes=max_bytes)
+        t = self.table_store.add_table(name, relation, max_bytes=max_bytes)
+        # Tables created through an engine stage device windows at the
+        # engine's streaming size from the first append on.
+        t.device_window_rows = self.window_rows
+        return t
 
     def append_data(self, name: str, data, time_cols=("time_",)):
         """Push path (Stirling's RegisterDataPushCallback analog)."""

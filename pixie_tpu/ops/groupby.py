@@ -99,10 +99,14 @@ def _to_bits(p):
     """Bit-exact unsigned view of a key plane (u32 or u64).
 
     Comparing bit patterns (not values) makes float keys well-defined for
-    NaNs and costs nothing for ints.
+    NaNs (bit-identical NaNs group together) and costs nothing for ints;
+    -0.0 canonicalizes to +0.0 first so both zeros stay one group
+    (value-equality semantics, Carnot's RowTuple ==).
     """
     if p.dtype == jnp.bool_:
         return p.astype(jnp.uint32)
+    if jnp.issubdtype(p.dtype, jnp.floating):
+        p = jnp.where(p == 0, jnp.zeros_like(p), p)
     nbits = p.dtype.itemsize * 8
     if nbits < 32:
         return jax.lax.bitcast_convert_type(

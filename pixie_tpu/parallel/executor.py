@@ -98,7 +98,7 @@ class DistributedEngine(Engine):
     # resident cache does not apply here (mesh residency is future work).
     device_residency = False
 
-    def __init__(self, registry=None, window_rows: int = 1 << 17,
+    def __init__(self, registry=None, window_rows: int | None = None,
                  mesh: Mesh | None = None, n_agents: int | None = None,
                  n_kelvin: int = 1, distributed_state=None):
         super().__init__(registry=registry, window_rows=window_rows)

@@ -17,6 +17,8 @@ are evicted. An LRU byte budget (``device_cache_bytes``) bounds HBM use.
 
 from __future__ import annotations
 
+import itertools
+import weakref
 from collections import OrderedDict
 from dataclasses import dataclass
 
@@ -24,6 +26,33 @@ import numpy as np
 
 from ..config import get_flag
 from ..types.dtypes import device_dtypes, pad_values
+
+# Global LRU accounting: the device_cache_bytes budget bounds the SUM of
+# resident windows across every table's cache (one HBM, many tables), so
+# eviction picks the globally least-recently-used window.
+_CACHES: "weakref.WeakSet[DeviceWindowCache]" = weakref.WeakSet()
+_TICK = itertools.count()
+
+
+def total_resident_bytes() -> int:
+    return sum(c._bytes for c in _CACHES)
+
+
+def _enforce_global_budget(newest: tuple) -> None:
+    """Evict globally-LRU windows until under budget; the just-inserted
+    window (``newest`` = (cache, key)) always survives."""
+    budget = get_flag("device_cache_bytes")
+    while total_resident_bytes() > budget:
+        victim = None  # (tick, cache, key)
+        for c in _CACHES:
+            for k, t in c._ticks.items():
+                if (c, k) == newest:
+                    continue
+                if victim is None or t < victim[0]:
+                    victim = (t, c, k)
+        if victim is None:
+            break
+        victim[1]._evict(victim[2])
 
 
 @dataclass
@@ -44,11 +73,13 @@ class DeviceWindow:
 
 
 class DeviceWindowCache:
-    """LRU cache of staged windows for one Table."""
+    """Cache of staged windows for one Table; budget enforced globally."""
 
     def __init__(self):
         self._entries: OrderedDict[tuple, DeviceWindow] = OrderedDict()
+        self._ticks: dict[tuple, int] = {}
         self._bytes = 0
+        _CACHES.add(self)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -61,6 +92,7 @@ class DeviceWindowCache:
         win = self._entries.get(key)
         if win is not None:
             self._entries.move_to_end(key)
+            self._ticks[key] = next(_TICK)
         return win
 
     def put(self, key: tuple, win: DeviceWindow) -> None:
@@ -68,6 +100,7 @@ class DeviceWindowCache:
         if old is not None:
             self._bytes -= old.nbytes
         self._entries[key] = win
+        self._ticks[key] = next(_TICK)
         self._bytes += win.nbytes
         # Evict partial-window predecessors of the same (window_rows,
         # window_index) — key = (W, k, row0, n): a grown window supersedes
@@ -77,12 +110,11 @@ class DeviceWindowCache:
         ]
         for k in stale:
             self._evict(k)
-        budget = get_flag("device_cache_bytes")
-        while self._bytes > budget and len(self._entries) > 1:
-            self._evict(next(iter(self._entries)))
+        _enforce_global_budget(newest=(self, key))
 
     def _evict(self, key: tuple) -> None:
         win = self._entries.pop(key, None)
+        self._ticks.pop(key, None)
         if win is not None:
             self._bytes -= win.nbytes
 
@@ -110,6 +142,7 @@ class DeviceWindowCache:
 
     def clear(self) -> None:
         self._entries.clear()
+        self._ticks.clear()
         self._bytes = 0
 
 

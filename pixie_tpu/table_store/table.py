@@ -408,9 +408,16 @@ class Table:
         self._backend = None
         self._plane_layout: list[tuple[str, int]] = []  # native order
         # Device residency (HBM as cold store): staged windows + watermark
-        # of rows already staged at append time (device_cache.py).
+        # of rows already staged at append time (device_cache.py). The
+        # staging window size is a per-table fact: it defaults to the
+        # window_rows flag and is ADOPTED from the first consumer that
+        # scans at a different size, so append-time staging and query
+        # windows converge without env-var choreography.
+        from ..config import get_flag as _get_flag
+
         self._device_cache = None
         self._staged_through = 0
+        self.device_window_rows = int(_get_flag("window_rows"))
         if len(self.relation):
             self._init_backend()
 
@@ -523,12 +530,11 @@ class Table:
 
     def stage_resident(self, window_rows: int | None = None) -> None:
         """Stage all complete windows onto the device (HBM cold store)."""
-        from ..config import get_flag
         from .device_cache import DeviceWindowCache, stage_window
 
         if self._backend is None:
             return
-        w = int(window_rows or get_flag("window_rows"))
+        w = int(window_rows or self.device_window_rows)
         if self._device_cache is None:
             self._device_cache = DeviceWindowCache()
         be = self._backend
@@ -539,9 +545,12 @@ class Table:
         )
         while self._staged_through + w <= end:
             k = self._staged_through // w
-            win = stage_window(self, k, w)
-            if win is not None:
-                self._device_cache.put((w, k, win.row0, win.n), win)
+            first = max(k * w, be.first_row_id())
+            n = min((k + 1) * w, end) - first
+            if n > 0 and self._device_cache.get((w, k, first, n)) is None:
+                win = stage_window(self, k, w)
+                if win is not None:
+                    self._device_cache.put((w, k, win.row0, win.n), win)
             self._staged_through = (k + 1) * w
 
     def device_scan(self, start_time=None, stop_time=None,
@@ -553,19 +562,21 @@ class Table:
         demand and are cached keyed by their length, so a grown tail
         re-stages while full windows stay immutable.
         """
-        from ..config import get_flag
         from .device_cache import DeviceWindowCache, stage_window
 
         if self._backend is None:
             return
-        w = int(window_rows or get_flag("window_rows"))
+        w = int(window_rows or self.device_window_rows)
         be = self._backend
         if self._device_cache is None:
             self._device_cache = DeviceWindowCache()
         self._device_cache.evict_before(be.first_row_id())
-        # An engine overriding window_rows away from the flag value makes
-        # append-time stagings dead weight; reclaim them. (Keep the two in
-        # sync — PIXIE_TPU_WINDOW_ROWS — to get zero-transfer steady state.)
+        if w != self.device_window_rows:
+            # Adopt the consumer's window size: future appends stage at w
+            # (last consumer wins; differently-sized stagings are dead
+            # weight for this consumer and are reclaimed now).
+            self.device_window_rows = w
+            self._staged_through = 0
         self._device_cache.evict_other_window_sizes(w)
         if start_time is not None:
             start_row = be.row_id_for_time(int(start_time), False)

@@ -1,0 +1,172 @@
+"""Shipped-script library compile-all regression.
+
+Reference parity: ``src/e2e_test/vizier/planner/all_scripts_test.go``
+compiles all 60 shipped PxL scripts against dumped real-cluster schemas.
+Here every script under ``pixie_tpu/scripts/px/`` must compile against
+the canonical ingest schemas, and the five benchmark shapes must also
+*execute* correctly on tiny synthetic replays.
+"""
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec import Engine
+from pixie_tpu.ingest.schemas import CANONICAL_SCHEMAS, init_schemas
+from pixie_tpu.planner import CompilerState, compile_pxl
+from pixie_tpu.scripts import list_scripts, load_all, load_script
+from pixie_tpu.udf.registry import default_registry
+
+
+class TestLibraryShape:
+    def test_at_least_ten_scripts(self):
+        assert len(list_scripts()) >= 10
+
+    def test_each_script_has_manifest(self):
+        for s in load_all():
+            assert s.manifest.get("name") == s.name
+            assert s.manifest.get("short")
+            assert s.tables, f"{s.name} declares no table deps"
+
+    def test_declared_tables_are_canonical(self):
+        for s in load_all():
+            for t in s.tables:
+                assert t in CANONICAL_SCHEMAS, (s.name, t)
+
+    def test_bench_shapes_are_shipped(self):
+        names = set(list_scripts())
+        for req in ("px/http_stats", "px/service_stats", "px/net_flow_graph",
+                    "px/sql_stats", "px/perf_flamegraph"):
+            assert req in names
+
+
+class TestCompileAll:
+    @pytest.mark.parametrize("name", list_scripts() or ["<none>"])
+    def test_compiles_against_canonical_schemas(self, name):
+        s = load_script(name)
+        state = CompilerState(
+            schemas=dict(CANONICAL_SCHEMAS),
+            registry=default_registry(),
+            now_ns=10**18,
+            max_output_rows=10_000,
+        )
+        compiled = compile_pxl(s.pxl, state)
+        assert compiled.plan.nodes, name
+
+
+@pytest.fixture()
+def loaded_engine():
+    eng = Engine(window_rows=1 << 12)
+    init_schemas(eng)
+    rng = np.random.default_rng(5)
+    n = 5000
+    eng.append_data("http_events", {
+        "time_": np.arange(n, dtype=np.int64) * 10**6,
+        "upid": np.stack([np.full(n, 1, np.uint64),
+                          rng.integers(1, 99, n).astype(np.uint64)], axis=1),
+        "remote_addr": [f"10.0.0.{i % 9}" for i in range(n)],
+        "req_method": ["GET"] * n,
+        "req_path": [f"/ep{i % 6}" for i in range(n)],
+        "resp_status": rng.choice([200, 200, 200, 404, 500], n).astype(np.int64),
+        "resp_body_size": rng.integers(1, 4096, n),
+        "latency_ns": rng.integers(10**5, 10**9, n).astype(np.int64),
+        "service": [f"svc-{i % 4}" for i in range(n)],
+        "pod": [f"svc-{i % 4}/pod-{i % 8}" for i in range(n)],
+    })
+    return eng
+
+
+class TestExecuteBenchShapes:
+    def test_http_stats_runs(self, loaded_engine):
+        s = load_script("px/http_stats")
+        out = loaded_engine.execute_query(s.pxl)["output"].to_pydict()
+        t = loaded_engine.tables["http_events"].read_all()
+        ok = t.cols["resp_status"][0] < 400
+        assert out["n"].sum() == ok.sum()
+        # (i%4, i%6) yields lcm(4,6)=12 distinct pairs in this replay.
+        assert len(out["service"]) == 12
+
+    def test_service_stats_runs(self, loaded_engine):
+        s = load_script("px/service_stats")
+        out = loaded_engine.execute_query(s.pxl)["output"].to_pydict()
+        assert set(out) == {"service", "p50", "p99", "error_rate", "throughput"}
+        assert (out["p99"] >= out["p50"]).all()
+
+    def test_http_request_stats_runs(self, loaded_engine):
+        s = load_script("px/http_request_stats")
+        out = loaded_engine.execute_query(s.pxl)["output"].to_pydict()
+        assert "frac" in out and (out["frac"] <= 1.0).all()
+
+    def test_net_flow_graph_runs(self):
+        eng = Engine(window_rows=1 << 12)
+        init_schemas(eng)
+        rng = np.random.default_rng(6)
+        n = 4000
+        n_pods = 8
+        src = rng.integers(0, n_pods, n)
+        dst = rng.integers(0, n_pods, n)
+        eng.append_data("conn_stats", {
+            "time_": np.arange(n, dtype=np.int64),
+            "upid": np.stack([np.full(n, 1, np.uint64),
+                              src.astype(np.uint64)], axis=1),
+            "remote_addr": [f"10.0.0.{i}" for i in dst],
+            "remote_port": np.full(n, 443, np.int64),
+            "trace_role": np.full(n, 1, np.int64),
+            "addr_family": np.full(n, 2, np.int64),
+            "protocol": np.full(n, 1, np.int64),
+            "ssl": np.zeros(n, dtype=bool),
+            "conn_open": np.ones(n, dtype=np.int64),
+            "conn_close": np.zeros(n, dtype=np.int64),
+            "conn_active": np.ones(n, dtype=np.int64),
+            "bytes_sent": rng.integers(1, 10**6, n),
+            "bytes_recv": rng.integers(1, 10**6, n),
+            "src_addr": [f"10.0.0.{i}" for i in src],
+            "src_pod": [f"ns/pod-{i}" for i in src],
+        })
+        s = load_script("px/net_flow_graph")
+        out = eng.execute_query(s.pxl)["output"].to_pydict()
+        bs = eng.tables["conn_stats"].read_all().cols["bytes_sent"][0]
+        assert out["bytes_sent"].sum() == bs.sum()  # every dst pod is known
+
+    def test_sql_stats_runs(self):
+        eng = Engine(window_rows=1 << 12)
+        init_schemas(eng)
+        rng = np.random.default_rng(7)
+        n = 3000
+        qs = [f"SELECT * FROM t{i % 3} WHERE id = {i}" for i in range(50)]
+        qc = rng.integers(0, len(qs), n)
+        eng.append_data("mysql_events", {
+            "time_": (np.arange(n, dtype=np.int64) * 10**7),
+            "upid": np.stack([np.full(n, 1, np.uint64),
+                              np.full(n, 2, np.uint64)], axis=1),
+            "req_cmd": np.full(n, 3, np.int64),
+            "query_str": [qs[i] for i in qc],
+            "resp_status": np.zeros(n, dtype=np.int64),
+            "latency_ns": rng.integers(10**4, 10**8, n).astype(np.int64),
+            "service": ["db"] * n,
+        })
+        s = load_script("px/sql_stats")
+        out = eng.execute_query(s.pxl)["output"].to_pydict()
+        assert out["n"].sum() == n
+        assert len(set(out["query_norm"])) == 3  # one shape per table name
+
+    def test_perf_flamegraph_runs(self):
+        eng = Engine(window_rows=1 << 12)
+        init_schemas(eng)
+        rng = np.random.default_rng(8)
+        n = 2000
+        stacks = [f"main;f{i};g{i % 7}" for i in range(40)]
+        sc = rng.integers(0, len(stacks), n)
+        cnt = rng.integers(1, 20, n)
+        eng.append_data("stack_traces.beta", {
+            "time_": np.arange(n, dtype=np.int64),
+            "upid": np.stack([np.full(n, 1, np.uint64),
+                              np.full(n, 9, np.uint64)], axis=1),
+            "stack_trace_id": sc.astype(np.int64),
+            "stack_trace": [stacks[i] for i in sc],
+            "count": cnt.astype(np.int64),
+            "pod": ["ns/p0"] * n,
+        })
+        s = load_script("px/perf_flamegraph")
+        out = eng.execute_query(s.pxl)["output"].to_pydict()
+        assert out["count"].sum() == cnt.sum()
+        assert len(out["stack_trace"]) == len(np.unique(sc))
