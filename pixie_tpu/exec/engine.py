@@ -136,6 +136,11 @@ class Engine:
 
     def append_data(self, name: str, data, time_cols=("time_",)):
         """Push path (Stirling's RegisterDataPushCallback analog)."""
+        if not self.table_store.tablets(name):
+            # Route auto-creation through create_table so the table stages
+            # device windows at THIS engine's streaming size from the
+            # first append (not the flag default).
+            self.create_table(name)
         return self.table_store.append_data(name, data, time_cols=time_cols)
 
     # -- execution -----------------------------------------------------------
@@ -321,14 +326,10 @@ class Engine:
         init_state, agg_step, _ = self._compile_steps(frag)
         state = init_state()
         for cols, valid in self._staged_windows(stream, stats):
-            if stats is None:
+            with _timed(stats, "compute"):
                 state = agg_step(state, cols, valid)
-            else:
-                import jax
-
-                with stats.timed("compute"):
-                    state = agg_step(state, cols, valid)
-                    jax.block_until_ready(state)
+                _block_if(stats, state)
+            if stats is not None:
                 stats.windows += 1
         return state
 
@@ -400,6 +401,30 @@ class Engine:
         )
         key_plane_index = frag.key_plane_index
         group_rel = frag.group_relation
+        if frag.string_carry_sources and len(pending.payloads) > 1:
+            # String ids inside a CARRY (not a group key) cannot be
+            # realigned after the fact; reject unless every agent encoded
+            # from the very same dictionary objects (engine.py realigns
+            # keys only — reference ships raw strings over GRPC instead).
+            for out_name, src_cols in frag.string_carry_sources:
+                for c in src_cols:
+                    d0 = pending.payloads[0].input_dicts.get(c)
+                    s0 = list(d0.strings) if d0 is not None else None
+                    for p in pending.payloads[1:]:
+                        d = p.input_dicts.get(c)
+                        same = (
+                            d is d0
+                            or (d is not None and s0 is not None
+                                and list(d.strings) == s0)
+                        )
+                        if not same:
+                            raise QueryError(
+                                f"aggregate {out_name!r} carries string ids "
+                                f"of column {c!r} across agents whose "
+                                "dictionaries disagree; results would be "
+                                "garbage. Share one dictionary or aggregate "
+                                "after merge."
+                            )
         pre, _agg, _post, _limit = _split_chain(list(p0.chain))
         # Per-agent post-pre-stage dictionaries for the group columns.
         per_agent_dicts = []
@@ -581,21 +606,20 @@ class Engine:
                 for win, lo, hi in t.device_scan(
                     start, stop, window_rows=self.window_rows
                 ):
-                    t0 = time.perf_counter() if stats is not None else 0
-                    valid = mask_fn(
-                        np.int32(lo - win.row0), np.int32(hi - win.row0)
-                    )
+                    with _timed(stats, "stage"):
+                        valid = mask_fn(
+                            np.int32(lo - win.row0), np.int32(hi - win.row0)
+                        )
+                        _block_if(stats, valid)
                     if stats is not None:
-                        stats.add("stage", time.perf_counter() - t0, hi - lo)
                         stats.rows_in += hi - lo
                     yield win.cols, valid
             return
         for hb in self._windows(stream):
-            t0 = time.perf_counter() if stats is not None else 0
-            cols, valid = self._stage(hb, self._window_capacity(hb.length))
+            with _timed(stats, "stage"):
+                cols, valid = self._stage(hb, self._window_capacity(hb.length))
+                _block_if(stats, cols)
             if stats is not None:
-                jax.block_until_ready(cols)
-                stats.add("stage", time.perf_counter() - t0, hb.length)
                 stats.rows_in += hb.length
             yield cols, valid
 
@@ -618,14 +642,9 @@ class Engine:
         if frag.is_agg:
             while True:
                 state = self._fold_agg_state(stream, frag, stats)
-                if stats is None:
+                with _timed(stats, "finalize"):
                     cols, valid, overflow = frag.finalize(state)
-                else:
-                    import jax
-
-                    with stats.timed("finalize"):
-                        cols, valid, overflow = frag.finalize(state)
-                        jax.block_until_ready((cols, valid, overflow))
+                    _block_if(stats, (cols, valid, overflow))
                 if not bool(overflow):
                     break
                 # Rebucket: double max_groups and re-run the stream (the
@@ -640,11 +659,9 @@ class Engine:
                     # per-fragment rows/windows stay per-attempt.
                     stats = qstats.new_fragment(stream.chain)
                     stats.ops = stats.ops + ("rebucket",)
-            if stats is None:
+            with _timed(stats, "materialize"):
                 out = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
-            else:
-                with stats.timed("materialize"):
-                    out = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
+            if stats is not None:
                 stats.rows_out = out.length
             return _apply_limit(out, frag.limit)
 
@@ -652,24 +669,15 @@ class Engine:
         _, _, rows_step = self._compile_steps(frag)
         pieces, total = [], 0
         for cols, valid in self._staged_windows(stream, stats):
-            if stats is None:
+            with _timed(stats, "compute"):
                 out_cols, out_valid = rows_step(cols, valid)
-            else:
-                import jax
-
-                with stats.timed("compute"):
-                    out_cols, out_valid = rows_step(cols, valid)
-                    jax.block_until_ready((out_cols, out_valid))
+                _block_if(stats, (out_cols, out_valid))
+            if stats is not None:
                 stats.windows += 1
-            if stats is None:
+            with _timed(stats, "materialize"):
                 piece = _to_host_batch(
                     frag.out_meta, out_cols, np.asarray(out_valid)
                 )
-            else:
-                with stats.timed("materialize"):
-                    piece = _to_host_batch(
-                        frag.out_meta, out_cols, np.asarray(out_valid)
-                    )
             pieces.append(piece)
             total += piece.length
             if frag.limit is not None and total >= frag.limit:
@@ -678,6 +686,24 @@ class Engine:
         if stats is not None:
             stats.rows_out = out.length
         return _apply_limit(out, frag.limit)
+
+
+def _timed(stats, stage: str):
+    """Stage timer context (no-op without stats) — keeps the analyze and
+    plain execution paths one code path."""
+    if stats is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return stats.timed(stage)
+
+
+def _block_if(stats, x) -> None:
+    """block_until_ready under analyze only (attribution needs sync)."""
+    if stats is not None:
+        import jax
+
+        jax.block_until_ready(x)
 
 
 @functools.lru_cache(maxsize=16)
@@ -1092,7 +1118,14 @@ def _join_host(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
 
 
 def _union_host(mats) -> HostBatch:
-    """Schema-aligned concatenation with dictionary re-encoding."""
+    """Schema-aligned union with dictionary re-encoding.
+
+    When the schema carries a ``time_`` column the result is merged in
+    time order — the reference UnionNode's k-way ordered merge of
+    cross-PEM streams (``src/carnot/exec/union_node.cc``); a stable sort
+    over the concatenation is equivalent given each input is itself
+    time-ordered, and stays a single vectorized pass.
+    """
     first = mats[0]
     for m in mats[1:]:
         if tuple(m.relation.column_names) != tuple(first.relation.column_names):
@@ -1121,6 +1154,12 @@ def _union_host(mats) -> HostBatch:
                 np.concatenate([m.cols[c][i] for m in mats])
                 for i in range(len(first.cols[c]))
             )
+    if first.relation.has_column("time_"):
+        order = np.argsort(out_cols["time_"][0], kind="stable")
+        if not np.array_equal(order, np.arange(len(order))):
+            out_cols = {
+                c: tuple(p[order] for p in ps) for c, ps in out_cols.items()
+            }
     return HostBatch(
         relation=first.relation,
         cols=out_cols,

@@ -69,6 +69,11 @@ class CompiledFragment:
     # the agent-mode bridge merge to realign string key dictionaries.
     key_plane_index: tuple = ()
     group_relation: Relation = None
+    # Agg outputs whose CARRY holds string-dictionary ids (e.g. ``any``
+    # over a string column) mapped to the input columns those ids encode.
+    # Group keys realign across agents; carries do not — the bridge merge
+    # rejects such payloads unless every agent shares the dictionaries.
+    string_carry_sources: tuple = ()  # tuple[(out_name, tuple[col, ...])]
 
 
 def _bind_pre_stage(ops, relation, dicts, registry):
@@ -326,6 +331,17 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
             device_cols[s] = cols[s]
         return device_cols, valid, state["overflow"]
 
+    string_carry_sources = []
+    for ae, uda, arg_bound, _ in aggs_bound:
+        if (
+            uda.return_type == DataType.STRING
+            and not uda.struct_fields
+            and any(b.dtype == DataType.STRING for b in arg_bound)
+        ):
+            string_carry_sources.append(
+                (ae.out_name, tuple(_expr_columns(ae.args)))
+            )
+
     return CompiledFragment(
         relation=out_rel,
         out_meta=final_meta,
@@ -339,4 +355,24 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
         apply_rows=apply_pre,
         key_plane_index=tuple(key_plane_index),
         group_relation=rel1,
+        string_carry_sources=tuple(string_carry_sources),
     )
+
+
+def _expr_columns(exprs):
+    """Column names referenced anywhere in a tuple of Expr trees."""
+    from .plan import ColumnRef, FuncCall
+
+    out: list[str] = []
+
+    def walk(e):
+        if isinstance(e, ColumnRef):
+            if e.name not in out:
+                out.append(e.name)
+        elif isinstance(e, FuncCall):
+            for a in e.args:
+                walk(a)
+
+    for e in exprs:
+        walk(e)
+    return out

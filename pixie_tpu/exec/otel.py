@@ -191,3 +191,71 @@ def batch_to_otlp(hb, spec: OTelDataSpec) -> dict:
                 }
             )
     return payload
+
+
+class OTLPHttpExporter:
+    """Push OTLP-JSON payloads over HTTP (stdlib urllib; no grpc in env).
+
+    Reference transport parity: ``otel_export_sink_node.cc`` ships the
+    same payloads over OTLP gRPC with retries; OTLP/HTTP is the spec's
+    sibling encoding (POST /v1/metrics, /v1/traces). Bind an instance as
+    an engine's ``export_otel`` to turn collected exports into pushes.
+    """
+
+    def __init__(self, base_url: str, headers=(), timeout_s: float = 5.0,
+                 max_retries: int = 2):
+        self.base_url = base_url.rstrip("/")
+        self.headers = tuple(headers)
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.pushed = 0
+        self.errors = 0
+
+    def __call__(self, payload: dict, endpoint=None) -> None:
+        url = self.base_url
+        if endpoint is not None and getattr(endpoint, "url", ""):
+            url = endpoint.url.rstrip("/")
+        jobs = []
+        if payload.get("resourceMetrics"):
+            jobs.append((url + "/v1/metrics",
+                         {"resourceMetrics": payload["resourceMetrics"]}))
+        if payload.get("resourceSpans"):
+            jobs.append((url + "/v1/traces",
+                         {"resourceSpans": payload["resourceSpans"]}))
+        for u, body in jobs:
+            self._post(u, body, endpoint)
+
+    def _post(self, url: str, body: dict, endpoint) -> None:
+        import json as _json
+        import time as _time
+        import urllib.error
+        import urllib.request
+
+        data = _json.dumps(body).encode()
+        headers = {"Content-Type": "application/json"}
+        headers.update(dict(self.headers))
+        if endpoint is not None:
+            headers.update(dict(getattr(endpoint, "headers", ()) or ()))
+        last = None
+        for attempt in range(self.max_retries + 1):
+            req = urllib.request.Request(url, data=data, headers=headers)
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout_s):
+                    self.pushed += 1
+                    return
+            except urllib.error.HTTPError as e:
+                last = e
+                if e.code not in (429,) and e.code < 500:
+                    break  # 4xx (auth, bad request): retrying cannot help
+                if attempt < self.max_retries:
+                    _time.sleep(min(0.2 * (2 ** attempt), 2.0))
+            except (urllib.error.URLError, OSError) as e:
+                last = e
+                if attempt < self.max_retries:
+                    _time.sleep(min(0.2 * (2 ** attempt), 2.0))
+        self.errors += 1
+        raise ExportError(f"OTLP push to {url} failed: {last}")
+
+
+class ExportError(Exception):
+    pass

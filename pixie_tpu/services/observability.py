@@ -1,0 +1,184 @@
+"""Service observability: metrics registry + healthz/statusz/metrics HTTP.
+
+Reference parity: the prometheus-cpp registry every C++ service carries
+(``src/common/metrics/metrics.h:27`` — e.g. PEM node-memory gauges,
+table-store counters) and the shared Go service handlers
+(``src/shared/services/``: ``healthz``, ``statusz``, prometheus
+``metrics``). Transport is stdlib http.server (no external deps); the
+text exposition follows the Prometheus format so standard scrapers work.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Metric:
+    name: str
+    kind: str  # "counter" | "gauge"
+    help: str
+    values: dict = field(default_factory=dict)  # labels tuple -> float
+
+
+class MetricsRegistry:
+    """Process-wide named counters/gauges with label support."""
+
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+        self._collectors: list = []  # callables run at render time
+
+    def counter(self, name: str, help: str = "") -> "Counter":
+        with self._lock:
+            m = self._metrics.setdefault(name, _Metric(name, "counter", help))
+        return Counter(m, self._lock)
+
+    def gauge(self, name: str, help: str = "") -> "Gauge":
+        with self._lock:
+            m = self._metrics.setdefault(name, _Metric(name, "gauge", help))
+        return Gauge(m, self._lock)
+
+    def register_collector(self, fn) -> None:
+        """``fn(registry)`` runs before each render — pull-style metrics
+        (table stats, cache bytes) refresh here."""
+        self._collectors.append(fn)
+
+    def render(self) -> str:
+        for fn in list(self._collectors):
+            fn(self)
+
+        def esc(v) -> str:  # exposition-format label escaping
+            return (
+                str(v)
+                .replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
+        lines = []
+        with self._lock:
+            for m in sorted(self._metrics.values(), key=lambda m: m.name):
+                if m.help:
+                    lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {m.kind}")
+                for labels, v in sorted(m.values.items()):
+                    if labels:
+                        lbl = ",".join(
+                            f'{k}="{esc(val)}"' for k, val in labels
+                        )
+                        lines.append(f"{m.name}{{{lbl}}} {v}")
+                    else:
+                        lines.append(f"{m.name} {v}")
+        return "\n".join(lines) + "\n"
+
+
+class _Bound:
+    def __init__(self, metric: _Metric, lock, labels=()):
+        self._m = metric
+        self._lock = lock
+        self._labels = tuple(sorted(labels))
+
+    def labels(self, **kw):
+        return type(self)(self._m, self._lock, tuple(kw.items()))
+
+
+class Counter(_Bound):
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._m.values[self._labels] = (
+                self._m.values.get(self._labels, 0.0) + v
+            )
+
+
+class Gauge(_Bound):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._m.values[self._labels] = float(v)
+
+
+#: Default process registry (metrics.h GetMetricsRegistry analog).
+default_registry = MetricsRegistry()
+
+
+class ObservabilityServer:
+    """healthz / statusz / metrics endpoints for one service process."""
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 statusz_fn=None, health_fn=None):
+        self.registry = registry or default_registry
+        self.statusz_fn = statusz_fn  # () -> dict
+        self.health_fn = health_fn  # () -> (bool, str)
+        self._httpd = None
+
+    def handle(self, path: str) -> tuple[int, str, str]:
+        """(status, content_type, body) — transport-independent core."""
+        if path == "/healthz":
+            ok, msg = (True, "ok") if self.health_fn is None else self.health_fn()
+            return (200 if ok else 503, "text/plain", msg + "\n")
+        if path == "/statusz":
+            from ..config import all_flags
+
+            status = {"flags": {k: v for k, (v, _) in all_flags().items()}}
+            if self.statusz_fn is not None:
+                status.update(self.statusz_fn())
+            return (200, "application/json", json.dumps(status, indent=1))
+        if path == "/metrics":
+            return (200, "text/plain; version=0.0.4", self.registry.render())
+        return (404, "text/plain", "not found\n")
+
+    def start(self, port: int = 0) -> int:
+        """Serve on a background thread; returns the bound port."""
+        obs = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                code, ctype, body = obs.handle(self.path.split("?")[0])
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # silence per-request stderr noise
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        t = threading.Thread(
+            target=self._httpd.serve_forever, name="observability", daemon=True
+        )
+        t.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def engine_collector(engine):
+    """Collector exporting an engine's table + device-cache stats
+    (table_metrics.h / pem_manager.h:63 node-memory gauges analog)."""
+
+    def collect(reg: MetricsRegistry) -> None:
+        from ..table_store.device_cache import total_resident_bytes
+
+        g_rows = reg.gauge("pixie_table_rows", "Rows resident per table")
+        g_bytes = reg.gauge("pixie_table_bytes", "Bytes resident per table")
+        for name, t in engine.tables.items():
+            if t is None:
+                continue
+            st = t.stats()
+            g_rows.labels(table=name).set(st.num_rows)
+            g_bytes.labels(table=name).set(st.bytes)
+        reg.gauge(
+            "pixie_device_cache_bytes",
+            "Device-resident window bytes (all tables)",
+        ).set(total_resident_bytes())
+
+    return collect

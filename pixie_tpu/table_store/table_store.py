@@ -103,7 +103,7 @@ class TableStore:
                     # budget, and (shared) string dictionaries so every
                     # tablet encodes into one id space.
                     base = next(iter(tablets.values()), None)
-                    tablets[tablet_id] = Table(
+                    t_new = Table(
                         name,
                         base.relation if base is not None else None,
                         max_bytes=base.max_bytes if base is not None else -1,
@@ -114,6 +114,9 @@ class TableStore:
                         ),
                         dicts=base.dicts if base is not None else None,
                     )
+                    if base is not None:
+                        t_new.device_window_rows = base.device_window_rows
+                    tablets[tablet_id] = t_new
                 if name not in self._names_to_ids:
                     self._names_to_ids[name] = self._next_id
                     self._ids[self._next_id] = name

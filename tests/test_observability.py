@@ -1,0 +1,249 @@
+"""Observability sweep: time-ordered union, cron runner, OTLP pusher,
+string-carry guard, metrics/healthz endpoints."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec import (
+    AggExpr,
+    AggOp,
+    ColumnRef,
+    Engine,
+    MemorySourceOp,
+    Plan,
+    QueryError,
+    ResultSinkOp,
+    UnionOp,
+)
+from pixie_tpu.exec.plan import BridgeSinkOp, BridgeSourceOp
+from pixie_tpu.services.observability import (
+    MetricsRegistry,
+    ObservabilityServer,
+    engine_collector,
+)
+from pixie_tpu.services.script_runner import CronScript, ScriptRunner
+
+C = ColumnRef
+
+
+class TestTimeOrderedUnion:
+    def test_union_merges_by_time(self):
+        e = Engine(window_rows=1 << 10)
+        e.append_data("a", {"time_": np.array([0, 10, 20], np.int64),
+                            "v": np.array([1, 2, 3], np.int64)})
+        e.append_data("b", {"time_": np.array([5, 15, 25], np.int64),
+                            "v": np.array([9, 8, 7], np.int64)})
+        p = Plan()
+        sa = p.add(MemorySourceOp(table="a"))
+        sb = p.add(MemorySourceOp(table="b"))
+        u = p.add(UnionOp(), [sa, sb])
+        p.add(ResultSinkOp("output"), [u])
+        out = e.execute_plan(p)["output"].to_pydict()
+        assert list(out["time_"]) == [0, 5, 10, 15, 20, 25]
+        assert list(out["v"]) == [1, 9, 2, 8, 3, 7]
+
+
+class TestStringCarryGuard:
+    def _agent(self, strings):
+        e = Engine(window_rows=1 << 10)
+        e.append_data("t", {"time_": np.arange(len(strings), dtype=np.int64),
+                            "k": np.ones(len(strings), np.int64),
+                            "s": strings})
+        return e
+
+    def _plans(self):
+        from pixie_tpu.planner.distributed.splitter import Splitter
+
+        p = Plan()
+        src = p.add(MemorySourceOp(table="t"))
+        agg = p.add(
+            AggOp(("k",), (AggExpr("first_s", "any", (C("s"),)),)), [src]
+        )
+        p.add(ResultSinkOp("output"), [agg])
+        return Splitter().split(p)
+
+    def test_unshared_dicts_rejected(self):
+        split = self._plans()
+        e1 = self._agent(["aaa", "bbb"])
+        e2 = self._agent(["zzz", "aaa"])  # different dictionary object/order
+        p1 = e1.execute_plan(split.before_blocking)[("bridge", 0)]
+        p2 = e2.execute_plan(split.before_blocking)[("bridge", 0)]
+        merge = Engine(window_rows=1 << 10)
+        with pytest.raises(QueryError, match="string ids"):
+            merge.execute_plan(
+                split.after_blocking, bridge_inputs={0: [p1, p2]}
+            )
+
+    def test_shared_dict_allowed(self):
+        from pixie_tpu.types.strings import StringDictionary
+
+        split = self._plans()
+        shared = StringDictionary(["aaa", "bbb", "zzz"])
+        engines = []
+        for strs in (["aaa", "bbb"], ["zzz", "aaa"]):
+            e = Engine(window_rows=1 << 10)
+            t = e.create_table("t")
+            ids = np.array([shared.lookup(s) for s in strs], np.int32)
+            from pixie_tpu.types.batch import HostBatch
+            from pixie_tpu.types.dtypes import DataType
+            from pixie_tpu.types.relation import Relation
+
+            rel = Relation([("time_", DataType.TIME64NS),
+                            ("k", DataType.INT64), ("s", DataType.STRING)])
+            hb = HostBatch(relation=rel, cols={
+                "time_": (np.arange(2, dtype=np.int64),),
+                "k": (np.ones(2, np.int64),),
+                "s": (ids,),
+            }, length=2, dicts={"s": shared})
+            e.append_data("t", hb)
+            engines.append(e)
+        payloads = [
+            e.execute_plan(split.before_blocking)[("bridge", 0)]
+            for e in engines
+        ]
+        merge = Engine(window_rows=1 << 10)
+        out = merge.execute_plan(
+            split.after_blocking, bridge_inputs={0: payloads}
+        )["output"].to_pydict()
+        assert out["first_s"][0] in ("aaa", "bbb", "zzz")
+
+
+class TestScriptRunner:
+    def _engine(self):
+        e = Engine(window_rows=1 << 10)
+        e.append_data("t", {"time_": np.arange(10, dtype=np.int64),
+                            "v": np.arange(10, dtype=np.int64)})
+        return e
+
+    QUERY = "import px\ndf = px.DataFrame(table='t')\npx.display(df.head(3))\n"
+
+    def test_tick_runs_due_scripts_on_frequency(self):
+        runner = ScriptRunner(self._engine())
+        runner.upsert(CronScript("s1", self.QUERY, frequency_s=10))
+        recs = runner.tick(now_s=100.0)
+        assert len(recs) == 1 and recs[0].ok
+        assert recs[0].row_counts == {"output": 3}
+        assert runner.tick(now_s=105.0) == []  # not due yet
+        assert len(runner.tick(now_s=110.0)) == 1
+
+    def test_broken_script_recorded_not_raised(self):
+        runner = ScriptRunner(self._engine())
+        runner.upsert(CronScript("bad", "import px\npx.nope()\n", 1))
+        (rec,) = runner.tick(now_s=0.0)
+        assert not rec.ok and rec.error
+
+    def test_compare_state_reconciles(self):
+        runner = ScriptRunner(self._engine())
+        runner.upsert(CronScript("old", self.QUERY, 1))
+        truth = {
+            "s1": CronScript("s1", self.QUERY, 5),
+            "s2": CronScript("s2", self.QUERY, 7, enabled=False),
+        }
+        runner.compare_state(truth)
+        have = runner.scripts()
+        assert set(have) == {"s1", "s2"}
+        # checksum change (frequency) re-syncs
+        runner.compare_state({"s1": CronScript("s1", self.QUERY, 9),
+                              "s2": truth["s2"]})
+        assert runner.scripts()["s1"].frequency_s == 9
+        # disabled scripts never run
+        assert all(r.script_id != "s2" for r in runner.tick(now_s=0.0))
+
+
+class TestOTLPPusher:
+    def _serve(self):
+        import http.server
+
+        received = []
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                received.append((self.path, json.loads(body)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, received
+
+    def test_pushes_metrics_and_traces(self):
+        from pixie_tpu.exec.otel import OTLPHttpExporter
+
+        httpd, received = self._serve()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            exp = OTLPHttpExporter(url, headers=(("x-api-key", "k"),))
+            exp({"resourceMetrics": [{"scopeMetrics": []}],
+                 "resourceSpans": [{"scopeSpans": []}]})
+            assert exp.pushed == 2
+            paths = sorted(p for p, _ in received)
+            assert paths == ["/v1/metrics", "/v1/traces"]
+        finally:
+            httpd.shutdown()
+
+    def test_push_failure_raises_after_retries(self):
+        from pixie_tpu.exec.otel import ExportError, OTLPHttpExporter
+
+        exp = OTLPHttpExporter("http://127.0.0.1:9", max_retries=1,
+                               timeout_s=0.2)
+        with pytest.raises(ExportError):
+            exp({"resourceMetrics": [{}]})
+        assert exp.errors == 1
+
+    def test_engine_export_hook(self):
+        from pixie_tpu.exec.otel import OTLPHttpExporter
+
+        httpd, received = self._serve()
+        try:
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            e = Engine(window_rows=1 << 10)
+            e.export_otel = OTLPHttpExporter(url)
+            e.export_otel({"resourceMetrics": [{"x": 1}]})
+            assert [p for p, _ in received] == ["/v1/metrics"]
+        finally:
+            httpd.shutdown()
+
+
+class TestObservabilityServer:
+    def test_endpoints(self):
+        e = Engine(window_rows=1 << 10)
+        e.append_data("t", {"time_": np.arange(7, dtype=np.int64),
+                            "v": np.arange(7, dtype=np.int64)})
+        reg = MetricsRegistry()
+        reg.counter("pixie_queries_total", "Queries executed").inc(3)
+        reg.register_collector(engine_collector(e))
+        srv = ObservabilityServer(
+            registry=reg, statusz_fn=lambda: {"role": "pem"}
+        )
+        port = srv.start(0)
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ) as r:
+                    return r.status, r.read().decode()
+
+            code, body = get("/healthz")
+            assert code == 200 and body.strip() == "ok"
+            code, body = get("/statusz")
+            st = json.loads(body)
+            assert st["role"] == "pem" and "window_rows" in st["flags"]
+            code, body = get("/metrics")
+            assert "pixie_queries_total 3" in body
+            assert 'pixie_table_rows{table="t"} 7' in body
+            assert "pixie_device_cache_bytes" in body
+        finally:
+            srv.stop()
+
+    def test_unhealthy_returns_503(self):
+        srv = ObservabilityServer(health_fn=lambda: (False, "agent expired"))
+        code, _, body = srv.handle("/healthz")
+        assert code == 503 and "expired" in body
