@@ -136,11 +136,12 @@ class Engine:
 
     def append_data(self, name: str, data, time_cols=("time_",)):
         """Push path (Stirling's RegisterDataPushCallback analog)."""
-        if not self.table_store.tablets(name):
-            # Route auto-creation through create_table so the table stages
-            # device windows at THIS engine's streaming size from the
-            # first append (not the flag default).
-            self.create_table(name)
+        # Atomic get-or-create at THIS engine's streaming window size so
+        # first appends stage device windows correctly (and concurrent
+        # first appends never replace each other's table).
+        self.table_store.ensure_table(
+            name, device_window_rows=self.window_rows
+        )
         return self.table_store.append_data(name, data, time_cols=time_cols)
 
     # -- execution -----------------------------------------------------------
@@ -606,7 +607,7 @@ class Engine:
                 for win, lo, hi in t.device_scan(
                     start, stop, window_rows=self.window_rows
                 ):
-                    with _timed(stats, "stage"):
+                    with _timed(stats, "stage", rows=hi - lo):
                         valid = mask_fn(
                             np.int32(lo - win.row0), np.int32(hi - win.row0)
                         )
@@ -616,7 +617,7 @@ class Engine:
                     yield win.cols, valid
             return
         for hb in self._windows(stream):
-            with _timed(stats, "stage"):
+            with _timed(stats, "stage", rows=hb.length):
                 cols, valid = self._stage(hb, self._window_capacity(hb.length))
                 _block_if(stats, cols)
             if stats is not None:
@@ -688,14 +689,14 @@ class Engine:
         return _apply_limit(out, frag.limit)
 
 
-def _timed(stats, stage: str):
+def _timed(stats, stage: str, rows: int = 0):
     """Stage timer context (no-op without stats) — keeps the analyze and
     plain execution paths one code path."""
     if stats is None:
         import contextlib
 
         return contextlib.nullcontext()
-    return stats.timed(stage)
+    return stats.timed(stage, rows)
 
 
 def _block_if(stats, x) -> None:

@@ -12,7 +12,7 @@ directly, and the fragment compiler turns maximal linear chains of it into
 single jitted XLA programs.
 """
 
-from .compiler import CompiledScript, CompilerState, compile_pxl
+from .compiler import CompiledScript, CompilerState, compile_mutations, compile_pxl
 from .objects import PxLError
 
-__all__ = ["CompiledScript", "CompilerState", "compile_pxl", "PxLError"]
+__all__ = ["CompiledScript", "CompilerState", "compile_mutations", "compile_pxl", "PxLError"]

@@ -145,10 +145,21 @@ _SAFE_BUILTINS = {
 class ASTVisitor:
     """Evaluates a PxL module against a PlanBuilder-backed ``px`` module."""
 
-    def __init__(self, px: PxModule):
+    def __init__(self, px: PxModule, pxtrace=None):
         self.px = px
+        # Lazily-built pxtrace module (probes DSL); importing it marks the
+        # script as a mutation candidate (probes.h MutationsIR).
+        self._pxtrace = pxtrace
         self.module_scope = Scope()
         self.funcs: dict[str, PxFunc] = {}
+
+    @property
+    def pxtrace(self):
+        if self._pxtrace is None:
+            from .pxtrace_module import TraceModule
+
+            self._pxtrace = TraceModule()
+        return self._pxtrace
 
     # -- driver --------------------------------------------------------------
     def run(self, tree: ast.Module):
@@ -176,9 +187,12 @@ class ASTVisitor:
         for alias in node.names:
             if alias.name == "px":
                 scope.assign(alias.asname or "px", self.px)
+            elif alias.name == "pxtrace":
+                scope.assign(alias.asname or "pxtrace", self.pxtrace)
             else:
                 raise PxLError(
-                    f"cannot import {alias.name!r}; only 'px' is available",
+                    f"cannot import {alias.name!r}; only 'px' and 'pxtrace' "
+                    "are available",
                     node.lineno,
                 )
 
@@ -247,8 +261,15 @@ class ASTVisitor:
     def _stmt_FunctionDef(self, node, scope):
         doc = ast.get_docstring(node) or ""
         fn = PxFunc(node.name, node.args, node.body, scope, self, doc)
+        for dec in reversed(node.decorator_list):
+            wrapper = self.eval(dec, scope)
+            if not callable(wrapper):
+                raise PxLError(
+                    f"decorator on {node.name!r} is not callable", node.lineno
+                )
+            fn = wrapper(fn)
         scope.assign(node.name, fn)
-        if scope is self.module_scope:
+        if scope is self.module_scope and isinstance(fn, PxFunc):
             self.funcs[node.name] = fn
 
     def _stmt_Return(self, node, scope):
@@ -314,15 +335,17 @@ class ASTVisitor:
             except PxLError as e:
                 raise PxLError(e.raw_msg, node.lineno)
         from .otel_module import OTelModule, _MetricNamespace, _TraceNamespace
+        from .pxtrace_module import TraceModule
 
         if isinstance(
-            obj, (OTelModule, _MetricNamespace, _TraceNamespace)
+            obj, (OTelModule, _MetricNamespace, _TraceNamespace, TraceModule)
         ) and not attr.startswith("_"):
             try:
                 return getattr(obj, attr)
             except AttributeError:
                 raise PxLError(
-                    f"px.otel has no attribute {attr!r}", node.lineno
+                    f"{type(obj).__name__} has no attribute {attr!r}",
+                    node.lineno,
                 ) from None
         raise PxLError(
             f"cannot access attribute {attr!r} on {type(obj).__name__}",

@@ -49,6 +49,15 @@ class Agent:
         )
         self.heartbeat_interval_s = heartbeat_interval_s
         self.asid = None
+        # Dynamic tracing surface (pem/tracepoint_manager.h:48 analog):
+        # traceable in-process symbols + deployed tracepoint connectors.
+        from ..ingest.collector import Collector
+        from ..ingest.dynamic import TraceTargetRegistry
+
+        self.trace_targets = TraceTargetRegistry()
+        self.collector = Collector()
+        self.collector.wire_to(self.engine)
+        self._tracepoints: dict = {}  # name -> DynamicTraceConnector
         self._registered = threading.Event()
         self._stop = threading.Event()
         self._subs = []
@@ -70,6 +79,7 @@ class Agent:
             self.bus.subscribe(f"agent.{a}.execute", self._on_execute),
             self.bus.subscribe(f"agent.{a}.merge", self._on_merge),
             self.bus.subscribe(f"agent.{a}.bridge", self._on_bridge),
+            self.bus.subscribe(f"agent.{a}.tracepoint", self._on_tracepoint),
             self.bus.subscribe("query.cancel", self._on_cancel),
         ]
         self._register()
@@ -116,6 +126,63 @@ class Agent:
     # -- data push (Stirling's RegisterDataPushCallback target) --------------
     def append_data(self, table: str, data, time_cols=("time_",)):
         return self.engine.append_data(table, data, time_cols=time_cols)
+
+    # -- dynamic tracepoints (TracepointManager analog) ----------------------
+    def _on_tracepoint(self, msg):
+        from ..services.tracepoints import FAILED, RUNNING, TOPIC_STATUS
+
+        if msg.get("op") == "remove":
+            conn = self._tracepoints.pop(msg["name"], None)
+            if conn is not None:
+                self.collector.remove_source(conn)
+            return
+        dep = msg["deployment"]
+        try:
+            from ..ingest.dynamic import compile_program
+
+            old = self._tracepoints.pop(dep.name, None)
+            if old is not None:
+                # Re-deploy under the same name: detach the old connector
+                # first (otherwise the target ends up double-wrapped and
+                # every call records duplicate rows).
+                self.collector.remove_source(old)
+            conn = compile_program(
+                dep, self.trace_targets, asid=self.asid or 0
+            )
+            if not self.engine.table_store.tablets(dep.table_name):
+                # Never replace an existing table (rows already collected
+                # under this name survive a TTL refresh / re-deploy).
+                self.engine.create_table(dep.table_name, dep.relation())
+            self.collector.register_source(conn)
+            self._tracepoints[dep.name] = conn
+        except Exception as e:
+            self.bus.publish(
+                TOPIC_STATUS,
+                {
+                    "name": dep.name,
+                    "agent": self.agent_id,
+                    "state": FAILED,
+                    "error": repr(e)[:300],
+                },
+            )
+            return
+        # Publish the new schema immediately (the broker's mutation wait
+        # needs it before the next heartbeat would fire).
+        self.bus.publish(
+            TOPIC_HEARTBEAT,
+            {"agent_id": self.agent_id, "schemas": self._schemas()},
+        )
+        self.bus.publish(
+            TOPIC_STATUS,
+            {"name": dep.name, "agent": self.agent_id, "state": RUNNING},
+        )
+
+    def poll_tracepoints(self) -> None:
+        """Drain deployed-tracepoint buffers into the table store now
+        (tests and low-latency paths; the collector thread does this on
+        its own cadence when running)."""
+        self.collector.run_core(once=True)
+        self.collector.flush()
 
     # -- query execution -----------------------------------------------------
     def _on_cancel(self, msg):

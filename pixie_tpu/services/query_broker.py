@@ -15,7 +15,7 @@ import threading
 import uuid
 
 from ..exec.engine import QueryError
-from ..planner import CompilerState, compile_pxl
+from ..planner import CompilerState, compile_mutations, compile_pxl
 from ..planner.distributed import DistributedPlanner
 from ..planner.distributed.coordinator import PlanningError
 from ..udf.registry import Registry, default_registry
@@ -113,6 +113,9 @@ class QueryBroker:
         )
         self.forwarder = QueryResultForwarder(bus)
         self.planner = DistributedPlanner(self.registry)
+        # Dynamic-tracing support (the MutationExecutor dependency,
+        # mutation_executor.go:84); wire a TracepointRegistry to enable.
+        self.tracepoints = None
 
     def execute_script(
         self,
@@ -120,16 +123,68 @@ class QueryBroker:
         timeout_s: float = 30.0,
         now_ns: int = 0,
         max_output_rows: int = 10_000,
+        mutation_timeout_s: float = 10.0,
     ) -> dict:
-        """The VizierService.ExecuteScript flow, end to end."""
-        state = self.tracker.distributed_state()  # fresh per query
+        """The VizierService.ExecuteScript flow, end to end.
+
+        Mutation phase first (MutationExecutor.Execute): pxtrace
+        tracepoints deploy and the broker waits until their tables are
+        schema-ready before compiling the query phase — so a script may
+        query the very table its tracepoint creates.
+        """
         compiler_state = CompilerState(
             schemas=self.tracker.schemas(),
             registry=self.registry,
             now_ns=now_ns,
             max_output_rows=max_output_rows,
         )
+        mutation_states = None
+        # Cheap gate: the mutation pass re-executes the script, so skip it
+        # entirely unless the source can contain pxtrace at all.
+        mutations = (
+            compile_mutations(query, compiler_state)
+            if "pxtrace" in query
+            else []
+        )
+        if mutations:
+            if self.tracepoints is None:
+                raise QueryError(
+                    "script contains pxtrace mutations but this broker has "
+                    "no TracepointRegistry wired"
+                )
+            self.tracepoints.apply(mutations)
+            from ..trace.spec import TracepointDeployment
+
+            names = [
+                m.name for m in mutations
+                if isinstance(m, TracepointDeployment)
+            ]
+            mutation_states = self.tracepoints.wait_ready(
+                names, timeout_s=mutation_timeout_s
+            )
+            failed = {n: s for n, s in mutation_states.items() if s != "RUNNING"}
+            if failed:
+                infos = {
+                    n: (self.tracepoints.info(n) or {}).get("error", "")
+                    for n in failed
+                }
+                raise QueryError(f"tracepoint deploy failed: {infos}")
+            # Re-read schemas: the tracepoint tables now exist.
+            compiler_state = CompilerState(
+                schemas=self.tracker.schemas(),
+                registry=self.registry,
+                now_ns=now_ns,
+                max_output_rows=max_output_rows,
+            )
+        state = self.tracker.distributed_state()  # fresh per query
         compiled = compile_pxl(query, compiler_state)
+        if mutations and not compiled.outputs and not compiled.n_exports:
+            return {
+                "mutations": mutation_states,
+                "tables": {},
+                "agent_stats": {},
+                "qid": None,
+            }
         try:
             dplan = self.planner.plan(compiled.plan, state)
         except PlanningError as e:
@@ -165,4 +220,6 @@ class QueryBroker:
         result = self.forwarder.wait(qid, timeout_s)
         result["qid"] = qid
         result["distributed_plan"] = dplan
+        if mutation_states is not None:
+            result["mutations"] = mutation_states
         return result

@@ -128,7 +128,7 @@ class ScriptRunner:
         try:
             if hasattr(self.target, "execute_script"):
                 result = self.target.execute_script(script.pxl)
-                outputs = result.get("outputs", result)
+                outputs = result["tables"]  # broker result envelope
             else:
                 outputs = self.target.execute_query(script.pxl)
             if self.on_result is not None:

@@ -52,6 +52,29 @@ class TableStore:
                 self._names_to_ids[name] = tid
             return t
 
+    def ensure_table(
+        self,
+        name: str,
+        relation: Relation | None = None,
+        max_bytes: int = -1,
+        device_window_rows: int | None = None,
+    ) -> Table:
+        """Atomic get-or-create of a table's default tablet (check-then-act
+        callers racing on first append must not replace each other)."""
+        with self._lock:
+            existing = next(iter(self._tables.get(name, {}).values()), None)
+            if existing is not None:
+                return existing
+            t = Table(name, relation, max_bytes=max_bytes)
+            if device_window_rows is not None:
+                t.device_window_rows = device_window_rows
+            self._tables.setdefault(name, {})[DEFAULT_TABLET] = t
+            if name not in self._names_to_ids:
+                self._ids[self._next_id] = name
+                self._names_to_ids[name] = self._next_id
+                self._next_id += 1
+            return t
+
     def get_table(self, name_or_id, tablet_id: str = DEFAULT_TABLET) -> Optional[Table]:
         with self._lock:
             name = (

@@ -1,0 +1,15 @@
+from .spec import (
+    ProbeDef,
+    TraceExpr,
+    TracepointDelete,
+    TracepointDeployment,
+    parse_ttl,
+)
+
+__all__ = [
+    "ProbeDef",
+    "TraceExpr",
+    "TracepointDelete",
+    "TracepointDeployment",
+    "parse_ttl",
+]

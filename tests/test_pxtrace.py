@@ -1,0 +1,231 @@
+"""Dynamic tracing (pxtrace mutation path) end to end.
+
+Reference flow under test (SURVEY.md §3.4): pxtrace PxL -> mutation
+compile -> tracepoint registry state machine -> PEM deploys a dynamic
+connector -> new table streams -> broker waits for schema -> query."""
+
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec import Engine
+from pixie_tpu.exec.engine import QueryError
+from pixie_tpu.ingest.dynamic import (
+    TraceError,
+    TraceTargetRegistry,
+    compile_program,
+)
+from pixie_tpu.planner import CompilerState, compile_mutations, compile_pxl
+from pixie_tpu.services import (
+    AgentTracker,
+    KelvinAgent,
+    MessageBus,
+    PEMAgent,
+    QueryBroker,
+)
+from pixie_tpu.services.tracepoints import (
+    FAILED,
+    RUNNING,
+    TERMINATED,
+    TracepointRegistry,
+)
+from pixie_tpu.trace.spec import TracepointDeployment, parse_ttl
+from pixie_tpu.udf.registry import default_registry
+
+TRACE_PXL = """
+import px
+import pxtrace
+
+@pxtrace.probe('demo.handle')
+def probe_fn():
+    return [{
+        'latency_ns': pxtrace.FunctionLatency(),
+        'arg0': pxtrace.ArgExpr('arg0'),
+        'who': pxtrace.ArgExpr('who', type='string'),
+        'ret': pxtrace.RetExpr(type='int64'),
+    }]
+
+pxtrace.UpsertTracepoint('demo_tp', 'demo_calls', probe_fn, ttl='10m')
+"""
+
+
+class Demo:
+    """The instrumented 'binary': a plain in-process callable."""
+
+    def handle(self, x, who="anon"):
+        return x * 2
+
+
+def _state(schemas=None):
+    return CompilerState(
+        schemas=schemas or {}, registry=default_registry(), now_ns=10**18
+    )
+
+
+class TestCompile:
+    def test_mutation_extraction(self):
+        muts = compile_mutations(TRACE_PXL, _state())
+        assert len(muts) == 1
+        dep = muts[0]
+        assert isinstance(dep, TracepointDeployment)
+        assert dep.name == "demo_tp" and dep.table_name == "demo_calls"
+        assert dep.ttl_s == 600.0
+        rel = dep.relation()
+        assert list(rel.column_names) == [
+            "time_", "upid", "latency_ns", "arg0", "who", "ret"
+        ]
+
+    def test_full_compile_carries_mutations(self):
+        compiled = compile_pxl(TRACE_PXL, _state())
+        assert len(compiled.mutations) == 1
+        assert compiled.outputs == []
+
+    def test_mutation_plus_query_extraction(self):
+        # The query phase references the not-yet-existing table; mutation
+        # extraction still succeeds (best-effort past the deploy).
+        pxl = TRACE_PXL + (
+            "df = px.DataFrame(table='demo_calls')\npx.display(df)\n"
+        )
+        muts = compile_mutations(pxl, _state())
+        assert [m.name for m in muts] == ["demo_tp"]
+
+    def test_ttl_parse(self):
+        assert parse_ttl("30s") == 30.0
+        assert parse_ttl("2h") == 7200.0
+        assert parse_ttl(5) == 5.0
+
+
+class TestDynamicConnector:
+    def test_attach_capture_detach(self):
+        demo = Demo()
+        reg = TraceTargetRegistry()
+        reg.register("demo.handle", demo, "handle")
+        dep = compile_mutations(TRACE_PXL, _state())[0]
+        conn = compile_program(dep, reg, asid=7)
+        conn.init()
+        orig_results = [demo.handle(5, who="alice"), demo.handle(9)]
+        assert orig_results == [10, 18]  # behavior preserved
+        from pixie_tpu.ingest.core import DataTable
+
+        dt = DataTable("demo_calls", dep.relation())
+        conn.transfer_data(None, {"demo_calls": dt})
+        records = dt.drain()
+        assert list(records["arg0"]) == [5, 9]
+        assert list(records["who"]) == ["alice", "anon"]
+        assert list(records["ret"]) == [10, 18]
+        assert (records["latency_ns"] >= 0).all()
+        assert records["upid"][0][0] >> 32 == 7  # asid plane
+        conn.stop()
+        assert demo.handle.__func__ is Demo.handle  # restored
+
+    def test_unknown_symbol_fails_fast(self):
+        dep = compile_mutations(TRACE_PXL, _state())[0]
+        with pytest.raises(TraceError, match="demo.handle"):
+            compile_program(dep, TraceTargetRegistry())
+
+
+@pytest.fixture
+def trace_cluster():
+    bus = MessageBus()
+    tracker = AgentTracker(bus, expiry_s=60.0, check_interval_s=60.0)
+    pem = PEMAgent(bus, "pem-0", heartbeat_interval_s=0.05).start()
+    kelvin = KelvinAgent(bus, "kelvin-0", heartbeat_interval_s=0.05).start()
+    # Seed a table so the tracker always has one schema.
+    pem.append_data("seed", {"time_": np.arange(4, dtype=np.int64),
+                             "v": np.arange(4, dtype=np.int64)})
+    pem._register()
+    broker = QueryBroker(bus, tracker)
+    broker.tracepoints = TracepointRegistry(bus, tracker)
+    demo = Demo()
+    pem.trace_targets.register("demo.handle", demo, "handle")
+    yield bus, tracker, pem, kelvin, broker, demo
+    broker.tracepoints.close()
+    pem.stop()
+    kelvin.stop()
+    tracker.close()
+    bus.close()
+
+
+class TestEndToEnd:
+    def test_deploy_then_query(self, trace_cluster):
+        bus, tracker, pem, kelvin, broker, demo = trace_cluster
+        res = broker.execute_script(TRACE_PXL)
+        assert res["mutations"] == {"demo_tp": RUNNING}
+        assert broker.tracepoints.state("demo_tp") == RUNNING
+        assert "demo_calls" in tracker.schemas()
+
+        for i in range(20):
+            demo.handle(i, who=f"user-{i % 3}")
+        pem.poll_tracepoints()
+
+        out = broker.execute_script(
+            "import px\n"
+            "df = px.DataFrame(table='demo_calls')\n"
+            "s = df.groupby('who').agg(n=('arg0', px.count),\n"
+            "                          total=('ret', px.sum))\n"
+            "px.display(s)\n"
+        )
+        got = out["tables"]["output"].to_pydict()
+        assert sorted(got["who"]) == ["user-0", "user-1", "user-2"]
+        assert got["n"].sum() == 20
+        assert got["total"].sum() == sum(2 * i for i in range(20))
+
+    def test_mutation_and_query_one_script(self, trace_cluster):
+        bus, tracker, pem, kelvin, broker, demo = trace_cluster
+        pxl = TRACE_PXL + (
+            "df = px.DataFrame(table='demo_calls')\n"
+            "px.display(df.head(10))\n"
+        )
+        res = broker.execute_script(pxl)
+        assert res["mutations"] == {"demo_tp": RUNNING}
+        assert "output" in res["tables"]  # empty table, but schema-ready
+
+    def test_failed_deploy_surfaces(self, trace_cluster):
+        bus, tracker, pem, kelvin, broker, demo = trace_cluster
+        bad = TRACE_PXL.replace("demo.handle", "no.such.symbol")
+        with pytest.raises(QueryError, match="deploy failed"):
+            broker.execute_script(bad, mutation_timeout_s=2.0)
+        assert broker.tracepoints.state("demo_tp") == FAILED
+
+    def test_ttl_expiry_detaches(self, trace_cluster):
+        bus, tracker, pem, kelvin, broker, demo = trace_cluster
+        broker.execute_script(TRACE_PXL)
+        deadline = time.time() + 2
+        while "demo_tp" not in pem._tracepoints and time.time() < deadline:
+            time.sleep(0.01)
+        assert "demo_tp" in pem._tracepoints
+        expired = broker.tracepoints.tick(now=time.monotonic() + 601)
+        assert expired == ["demo_tp"]
+        deadline = time.time() + 2
+        while "demo_tp" in pem._tracepoints and time.time() < deadline:
+            time.sleep(0.01)
+        assert broker.tracepoints.state("demo_tp") == TERMINATED
+        assert "demo_tp" not in pem._tracepoints
+        assert demo.handle.__func__ is Demo.handle  # unpatched
+
+    def test_redeploy_same_name_single_wrapper(self, trace_cluster):
+        bus, tracker, pem, kelvin, broker, demo = trace_cluster
+        broker.execute_script(TRACE_PXL)
+        # Changed TTL -> a genuinely new deployment under the same name.
+        broker.execute_script(TRACE_PXL.replace("ttl='10m'", "ttl='20m'"))
+        time.sleep(0.1)
+        assert len(pem._tracepoints) == 1
+        demo.handle(4)
+        pem.poll_tracepoints()
+        out = broker.execute_script(
+            "import px\n"
+            "df = px.DataFrame(table='demo_calls')\n"
+            "s = df.groupby('who').agg(n=('arg0', px.count))\n"
+            "px.display(s)\n"
+        )
+        got = out["tables"]["output"].to_pydict()
+        assert got["n"].sum() == 1  # single wrapper: no duplicate rows
+
+    def test_upsert_idempotent(self, trace_cluster):
+        bus, tracker, pem, kelvin, broker, demo = trace_cluster
+        broker.execute_script(TRACE_PXL)
+        # Re-running the same script refreshes TTL, does not redeploy.
+        res = broker.execute_script(TRACE_PXL)
+        assert res["mutations"] == {"demo_tp": RUNNING}
+        assert len(pem._tracepoints) == 1
