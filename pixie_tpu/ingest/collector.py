@@ -39,6 +39,9 @@ class Collector:
         with self._lock:
             self._connectors.append(connector)
             for name, rel in connector.tables:
+                existing = self._data_tables.get(name)
+                if existing is not None and list(existing.relation.items()) == list(rel.items()):
+                    continue  # same-schema redeploy: pending rows survive
                 self._data_tables[name] = DataTable(name, rel)
 
     def remove_source(self, connector: SourceConnector) -> None:
@@ -88,7 +91,10 @@ class Collector:
                 for name, _rel in c.tables:
                     dt = self._data_tables[name]
                     if (push_due or dt.over_threshold()) and dt.pending_rows:
-                        self._push(dt)
+                        try:
+                            self._push(dt)
+                        except Exception as e:  # push must not kill the loop
+                            self.errors.append((dt.name, repr(e)))
             if once:
                 return
             # Sleep until the earliest upcoming deadline (stirling.cc:732).

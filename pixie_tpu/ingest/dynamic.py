@@ -104,6 +104,7 @@ class DynamicTraceConnector(SourceConnector):
         self._max_ring = 1 << 16
         self._target = None
         self._orig = None
+        self._wrapped = None
 
     # -- attach / detach ----------------------------------------------------
     def init(self) -> None:
@@ -137,9 +138,14 @@ class DynamicTraceConnector(SourceConnector):
                     pass
             return kwargs.get(expr, 0)
 
+        # The inner callable lives in a mutable cell so a tracepoint can
+        # be spliced out of a wrapper CHAIN (two tracepoints on one
+        # symbol) without un-wrapping the others.
+        holder = [orig]
+
         def wrapped(*args, **kwargs):
             t0 = time.perf_counter_ns()
-            ret = orig(*args, **kwargs)
+            ret = holder[0](*args, **kwargs)
             t1 = time.perf_counter_ns()
             row = [time.time_ns(), upid.hi, upid.lo]
             for _col, te in outputs:
@@ -155,15 +161,32 @@ class DynamicTraceConnector(SourceConnector):
                     del ring[: len(ring) - max_ring]
             return ret
 
-        wrapped.__wrapped__ = orig
+        wrapped._pxt_holder = holder
+        self._wrapped = wrapped
         setattr(self._target.owner, self._target.attr, wrapped)
         super().init()
 
     def stop(self) -> None:
-        if self._target is not None and self._orig is not None:
-            setattr(self._target.owner, self._target.attr, self._orig)
+        if self._target is not None and self._wrapped is not None:
+            cur = getattr(self._target.owner, self._target.attr)
+            if cur is self._wrapped:
+                setattr(
+                    self._target.owner, self._target.attr,
+                    self._wrapped._pxt_holder[0],
+                )
+            else:
+                # We are somewhere inside a wrapper chain: splice our
+                # layer out by pointing the enclosing wrapper's cell at
+                # our inner callable.
+                w = cur
+                while getattr(w, "_pxt_holder", None) is not None:
+                    if w._pxt_holder[0] is self._wrapped:
+                        w._pxt_holder[0] = self._wrapped._pxt_holder[0]
+                        break
+                    w = w._pxt_holder[0]
             self._target = None
             self._orig = None
+            self._wrapped = None
         super().stop()
 
     # -- collection ---------------------------------------------------------

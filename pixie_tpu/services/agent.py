@@ -85,6 +85,9 @@ class Agent:
         self._register()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
+        # The ingest loop (Stirling::RunAsThread): drains connector
+        # buffers — incl. dynamically deployed tracepoints — on cadence.
+        self.collector.run_as_thread()
         return self
 
     def stop(self):
@@ -93,6 +96,8 @@ class Agent:
         for s in self._subs:
             s.unsubscribe()
         self._subs = []
+        # Stops connectors too, restoring any trace-wrapped callables.
+        self.collector.stop()
 
     def _register(self):
         self.bus.publish(
@@ -149,10 +154,15 @@ class Agent:
             conn = compile_program(
                 dep, self.trace_targets, asid=self.asid or 0
             )
-            if not self.engine.table_store.tablets(dep.table_name):
-                # Never replace an existing table (rows already collected
-                # under this name survive a TTL refresh / re-deploy).
-                self.engine.create_table(dep.table_name, dep.relation())
+            existing = self.engine.table_store.relation(dep.table_name)
+            new_rel = dep.relation()
+            if existing is None:
+                self.engine.create_table(dep.table_name, new_rel)
+            elif list(existing.items()) != list(new_rel.items()):
+                # Schema changed: replace the table (old-relation rows
+                # cannot coexist with the new output spec).
+                self.engine.create_table(dep.table_name, new_rel)
+            # else: TTL refresh / same-schema redeploy keeps collected rows.
             self.collector.register_source(conn)
             self._tracepoints[dep.name] = conn
         except Exception as e:
@@ -178,10 +188,14 @@ class Agent:
         )
 
     def poll_tracepoints(self) -> None:
-        """Drain deployed-tracepoint buffers into the table store now
-        (tests and low-latency paths; the collector thread does this on
-        its own cadence when running)."""
-        self.collector.run_core(once=True)
+        """Drain deployed-tracepoint buffers into the table store NOW —
+        bypassing the collector thread's sampling/push frequencies (which
+        drain on their own cadence) for tests and low-latency reads."""
+        for conn in list(self._tracepoints.values()):
+            try:
+                conn.transfer_data(self.collector, self.collector._data_tables)
+            except Exception as e:
+                self.collector.errors.append((conn.name, repr(e)))
         self.collector.flush()
 
     # -- query execution -----------------------------------------------------

@@ -1,0 +1,280 @@
+"""Socket transport for the message bus: agents in separate processes.
+
+Reference parity: the control plane is NATS pub/sub with protobuf
+envelopes (``src/common/event/nats.h:36-60``; ``launch_query.go:36``) and
+the data plane is gRPC streaming (``grpc_router.{h,cc}``). Here one
+framed-TCP layer carries both: a ``BusServer`` wraps the broker-side
+in-process ``MessageBus`` and remote ``RemoteBus`` clients mirror the bus
+API (subscribe/publish), with every frame encoded by the versioned wire
+codec (``wire.py``) — no pickle crosses the socket.
+
+Frames: 4-byte little-endian length + wire-encoded dict
+{"op": "pub"|"sub"|"unsub", "topic": str, "msg": ...?, "sid": int?}.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from .msgbus import MessageBus
+from .wire import decode, encode
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 30
+
+
+def _send_frame(sock: socket.socket, obj) -> None:
+    payload = encode(obj)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket):
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ConnectionError(f"frame of {n} bytes exceeds limit")
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return decode(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class BusServer:
+    """Bridges a local MessageBus to remote RemoteBus clients."""
+
+    def __init__(self, bus: MessageBus, host: str = "127.0.0.1", port: int = 0):
+        self.bus = bus
+        self._srv = socket.create_server((host, port))
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._clients: list = []
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="busserver", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            client = _ClientConn(self, sock)
+            with self._lock:
+                self._clients.append(client)
+            client.start()
+
+    def _drop(self, client) -> None:
+        with self._lock:
+            if client in self._clients:
+                self._clients.remove(client)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            clients = list(self._clients)
+        for c in clients:
+            c.close()
+
+
+class _ClientConn:
+    """Server-side state for one remote client."""
+
+    def __init__(self, server: BusServer, sock: socket.socket):
+        self.server = server
+        self.sock = sock
+        self._send_lock = threading.Lock()
+        self._subs: dict[int, object] = {}  # sid -> Subscription
+        self._thread = threading.Thread(
+            target=self._read_loop, name="busserver-client", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = _recv_frame(self.sock)
+                if frame is None:
+                    break
+                op = frame.get("op")
+                if op == "pub":
+                    self.server.bus.publish(frame["topic"], frame["msg"])
+                elif op == "sub":
+                    sid, topic = frame["sid"], frame["topic"]
+
+                    def fwd(msg, _sid=sid, _topic=topic):
+                        self._send({"op": "msg", "sid": _sid, "msg": msg})
+
+                    self._subs[sid] = self.server.bus.subscribe(topic, fwd)
+                elif op == "unsub":
+                    sub = self._subs.pop(frame["sid"], None)
+                    if sub is not None:
+                        sub.unsubscribe()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.close()
+
+    def _send(self, obj) -> None:
+        try:
+            with self._send_lock:
+                _send_frame(self.sock, obj)
+        except (ConnectionError, OSError):
+            self.close()
+
+    def close(self) -> None:
+        for sub in list(self._subs.values()):
+            sub.unsubscribe()
+        self._subs.clear()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.server._drop(self)
+
+
+class _RemoteSubscription:
+    """One remote subscription with its own dispatcher thread (mirrors
+    msgbus.Subscription: a slow handler must not block other handlers or
+    the socket read loop — e.g. query execution vs. cancellation)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, bus: "RemoteBus", sid: int, fn):
+        import queue as _queue
+
+        self._bus = bus
+        self._sid = sid
+        self._fn = fn
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name=f"remotebus-sub-{sid}", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            msg = self._q.get()
+            if msg is self._SENTINEL:
+                return
+            try:
+                self._fn(msg)
+            except Exception:  # handler errors never kill the dispatcher
+                pass
+
+    def _deliver(self, msg) -> None:
+        self._q.put(msg)
+
+    def unsubscribe(self) -> None:
+        self._bus._unsubscribe(self._sid)
+        self._q.put(self._SENTINEL)
+
+
+class RemoteBus:
+    """Client-side bus mirror: same subscribe/publish surface as
+    MessageBus, carried over one TCP connection to a BusServer."""
+
+    def __init__(self, host: str, port: int, connect_timeout_s: float = 10.0):
+        self.sock = socket.create_connection((host, port), connect_timeout_s)
+        self._send_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._handlers: dict[int, object] = {}  # sid -> callable
+        self._next_sid = 1
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._read_loop, name="remotebus", daemon=True
+        )
+        self._thread.start()
+
+    def subscribe(self, topic: str, fn) -> _RemoteSubscription:
+        with self._lock:
+            sid = self._next_sid
+            self._next_sid += 1
+            sub = _RemoteSubscription(self, sid, fn)
+            self._handlers[sid] = sub
+        self._send({"op": "sub", "topic": topic, "sid": sid})
+        return sub
+
+    def publish(self, topic: str, msg: dict) -> int:
+        self._send({"op": "pub", "topic": topic, "msg": msg})
+        return 1
+
+    def request(self, topic: str, msg: dict, timeout_s: float = 5.0) -> dict:
+        """Request/reply over the bridge (MessageBus.request mirror).
+
+        The publish-count check is impossible remotely; a missing
+        responder surfaces as the timeout instead.
+        """
+        import queue as _queue
+        import uuid as _uuid
+
+        inbox = f"_inbox.{_uuid.uuid4().hex}"
+        q: _queue.Queue = _queue.Queue()
+        sub = self.subscribe(inbox, q.put)
+        try:
+            self.publish(topic, {**msg, "_reply_to": inbox})
+            return q.get(timeout=timeout_s)
+        except _queue.Empty:
+            raise TimeoutError(
+                f"no reply from {topic!r} in {timeout_s}s"
+            ) from None
+        finally:
+            sub.unsubscribe()
+
+    def _unsubscribe(self, sid: int) -> None:
+        with self._lock:
+            self._handlers.pop(sid, None)
+        try:
+            self._send({"op": "unsub", "sid": sid})
+        except (ConnectionError, OSError):
+            pass  # bus already closed; the server reaps on disconnect
+
+    def _send(self, obj) -> None:
+        if self._closed.is_set():
+            raise ConnectionError("remote bus closed")
+        with self._send_lock:
+            _send_frame(self.sock, obj)
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = _recv_frame(self.sock)
+                if frame is None:
+                    break
+                if frame.get("op") == "msg":
+                    with self._lock:
+                        sub = self._handlers.get(frame["sid"])
+                    if sub is not None:
+                        sub._deliver(frame["msg"])
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._closed.set()
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -35,13 +35,26 @@ class _TracepointRecord:
 
 
 class TracepointRegistry:
-    def __init__(self, bus: MessageBus, tracker):
+    def __init__(self, bus: MessageBus, tracker,
+                 ttl_check_interval_s: float = 5.0):
         self.bus = bus
         self.tracker = tracker
         self._lock = threading.Lock()
         self._records: dict[str, _TracepointRecord] = {}
         self._changed = threading.Condition(self._lock)
         self._sub = bus.subscribe(TOPIC_STATUS, self._on_status)
+        # TTL watcher (tracepoint.go's expiry loop): tick() stays public
+        # so tests drive expiry with explicit clocks.
+        self._stop = threading.Event()
+        self._ttl_thread = threading.Thread(
+            target=self._ttl_loop, args=(ttl_check_interval_s,),
+            name="tracepoint-ttl", daemon=True,
+        )
+        self._ttl_thread.start()
+
+    def _ttl_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            self.tick()
 
     # -- mutation application ----------------------------------------------
     def apply(self, mutations, now: float | None = None) -> dict:
@@ -170,4 +183,5 @@ class TracepointRegistry:
         return expired
 
     def close(self) -> None:
+        self._stop.set()
         self._sub.unsubscribe()

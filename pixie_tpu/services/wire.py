@@ -1,0 +1,334 @@
+"""Wire format: versioned self-describing binary encoding for the
+control and data planes.
+
+Reference parity: Carnot ships RowBatches and exec errors as protobuf
+over gRPC (``src/carnot/carnotpb/carnot.proto:96-99``
+``TransferResultChunkRequest``) and control messages as protobuf NATS
+envelopes (``src/vizier/messages/messagespb``). This codec plays both
+roles for this runtime: every message the in-process bus carries — plan
+dispatch, bridge payloads (partial-agg state pytrees, row batches),
+results, tracepoint deployments — round-trips through ``encode`` /
+``decode`` so agents can live in separate processes (see ``netbus.py``).
+
+Design: tag-prefixed recursive encoding over an explicit TYPE TABLE —
+no pickle, no arbitrary code execution on decode; unknown tags/types are
+hard errors. The first byte is the format version.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+import numpy as np
+
+WIRE_VERSION = 1
+
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+_U16 = struct.Struct("<H")
+
+
+class WireError(Exception):
+    pass
+
+
+def _registered_types():
+    """The closed set of structured types allowed on the wire."""
+    from ..exec import otel as _otel
+    from ..exec import plan as _plan
+    from ..exec.engine import AggStatePayload, RowsPayload
+    from ..trace import spec as _trace
+    from ..types.batch import HostBatch
+    from ..types.relation import Relation
+    from ..types.strings import StringDictionary
+
+    types = [
+        Relation,
+        StringDictionary,
+        HostBatch,
+        AggStatePayload,
+        RowsPayload,
+        _plan.Plan,
+        _plan.PlanNode,
+        _plan.MemorySourceOp,
+        _plan.MapOp,
+        _plan.FilterOp,
+        _plan.AggOp,
+        _plan.JoinOp,
+        _plan.LimitOp,
+        _plan.UnionOp,
+        _plan.UDTFSourceOp,
+        _plan.EmptySourceOp,
+        _plan.BridgeSinkOp,
+        _plan.BridgeSourceOp,
+        _plan.OTelExportSinkOp,
+        _plan.ResultSinkOp,
+        _plan.ColumnRef,
+        _plan.Literal,
+        _plan.FuncCall,
+        _plan.AggExpr,
+        _otel.OTelEndpointConfig,
+        _otel.OTelMetricGauge,
+        _otel.OTelMetricSummary,
+        _otel.OTelSpan,
+        _otel.OTelDataSpec,
+        _trace.TraceExpr,
+        _trace.ProbeDef,
+        _trace.TracepointDeployment,
+        _trace.TracepointDelete,
+    ]
+    return types
+
+
+_TYPES: list | None = None
+_TYPE_IDS: dict | None = None
+
+
+def _tables():
+    global _TYPES, _TYPE_IDS
+    if _TYPES is None:
+        _TYPES = _registered_types()
+        _TYPE_IDS = {t: i for i, t in enumerate(_TYPES)}
+    return _TYPES, _TYPE_IDS
+
+
+def _obj_fields(obj) -> dict:
+    """Structured object -> plain field dict (encoder side)."""
+    from ..exec.plan import Plan
+    from ..types.batch import HostBatch
+    from ..types.relation import Relation
+    from ..types.strings import StringDictionary
+
+    if isinstance(obj, Relation):
+        return {"items": [(n, t.value) for n, t in obj.items()]}
+    if isinstance(obj, StringDictionary):
+        return {"strings": list(obj.strings)}
+    if isinstance(obj, HostBatch):
+        return {
+            "relation": obj.relation,
+            "cols": {n: tuple(np.asarray(p) for p in ps)
+                     for n, ps in obj.cols.items()},
+            "length": obj.length,
+            "dicts": dict(obj.dicts),
+            "eow": obj.eow,
+            "eos": obj.eos,
+        }
+    if isinstance(obj, Plan):
+        return {"nodes": dict(obj.nodes)}
+    if dataclasses.is_dataclass(obj):
+        return {f.name: getattr(obj, f.name) for f in dataclasses.fields(obj)}
+    raise WireError(f"cannot encode fields of {type(obj).__name__}")
+
+
+def _obj_build(cls, fields: dict):
+    """Field dict -> object (decoder side)."""
+    import itertools
+
+    from ..exec.plan import Plan
+    from ..types.batch import HostBatch
+    from ..types.dtypes import DataType
+    from ..types.relation import Relation
+    from ..types.strings import StringDictionary
+
+    if cls is Relation:
+        return Relation([(n, DataType(v)) for n, v in fields["items"]])
+    if cls is StringDictionary:
+        return StringDictionary(fields["strings"])
+    if cls is HostBatch:
+        return HostBatch(
+            relation=fields["relation"],
+            cols={n: tuple(ps) for n, ps in fields["cols"].items()},
+            length=fields["length"],
+            dicts=fields["dicts"],
+            eow=fields["eow"],
+            eos=fields["eos"],
+        )
+    if cls is Plan:
+        nodes = fields["nodes"]
+        start = (max(nodes) + 1) if nodes else 0
+        return Plan(nodes=nodes, _counter=itertools.count(start))
+    return cls(**fields)
+
+
+# -- encoder -----------------------------------------------------------------
+
+
+def _enc(obj, out: list) -> None:
+    from ..types.dtypes import DataType
+
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, int) and not isinstance(obj, bool):
+        if -(2**63) <= obj < 2**63:
+            out.append(b"I")
+            out.append(_I64.pack(obj))
+        else:  # u128 values etc.
+            s = str(obj).encode()
+            out.append(b"J")
+            out.append(_U32.pack(len(s)))
+            out.append(s)
+    elif isinstance(obj, float):
+        out.append(b"D")
+        out.append(_F64.pack(obj))
+    elif isinstance(obj, str):
+        b = obj.encode()
+        out.append(b"S")
+        out.append(_U32.pack(len(b)))
+        out.append(b)
+    elif isinstance(obj, bytes):
+        out.append(b"B")
+        out.append(_U32.pack(len(obj)))
+        out.append(obj)
+    elif isinstance(obj, (np.ndarray, np.generic)):
+        # np.ascontiguousarray promotes 0-d to 1-d — preserve 0-d shapes.
+        arr = np.asarray(obj)
+        if not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        if arr.dtype == object:  # decoded string columns etc.
+            out.append(b"L")
+            out.append(_U32.pack(arr.size))
+            for v in arr.reshape(-1).tolist():
+                _enc(v, out)
+            return
+        dt = arr.dtype.str.encode()
+        out.append(b"A")
+        out.append(_U16.pack(len(dt)))
+        out.append(dt)
+        out.append(b"\x01" if isinstance(obj, np.generic) else b"\x00")
+        out.append(_U16.pack(arr.ndim))
+        for d in arr.shape:
+            out.append(_U32.pack(d))
+        out.append(arr.tobytes())
+    elif isinstance(obj, tuple):
+        out.append(b"U")
+        out.append(_U32.pack(len(obj)))
+        for v in obj:
+            _enc(v, out)
+    elif isinstance(obj, (list, frozenset, set)):
+        items = sorted(obj) if isinstance(obj, (set, frozenset)) else obj
+        out.append(b"L")
+        out.append(_U32.pack(len(items)))
+        for v in items:
+            _enc(v, out)
+    elif isinstance(obj, dict):
+        out.append(b"M")
+        out.append(_U32.pack(len(obj)))
+        for k, v in obj.items():
+            _enc(k, out)
+            _enc(v, out)
+    elif isinstance(obj, DataType):
+        b = obj.value.encode()
+        out.append(b"E")
+        out.append(_U16.pack(len(b)))
+        out.append(b)
+    else:
+        _, ids = _tables()
+        tid = ids.get(type(obj))
+        if tid is None:
+            raise WireError(
+                f"type {type(obj).__name__} is not wire-registered"
+            )
+        out.append(b"O")
+        out.append(_U16.pack(tid))
+        _enc(_obj_fields(obj), out)
+
+
+def encode(obj) -> bytes:
+    out: list = [bytes([WIRE_VERSION])]
+    _enc(obj, out)
+    return b"".join(out)
+
+
+# -- decoder -----------------------------------------------------------------
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise WireError("truncated message")
+        self.pos += n
+        return b
+
+
+def _dec(r: _Reader):
+    from ..types.dtypes import DataType
+
+    tag = r.take(1)
+    if tag == b"N":
+        return None
+    if tag == b"T":
+        return True
+    if tag == b"F":
+        return False
+    if tag == b"I":
+        return _I64.unpack(r.take(8))[0]
+    if tag == b"J":
+        (n,) = _U32.unpack(r.take(4))
+        return int(r.take(n).decode())
+    if tag == b"D":
+        return _F64.unpack(r.take(8))[0]
+    if tag == b"S":
+        (n,) = _U32.unpack(r.take(4))
+        return r.take(n).decode()
+    if tag == b"B":
+        (n,) = _U32.unpack(r.take(4))
+        return r.take(n)
+    if tag == b"A":
+        (dl,) = _U16.unpack(r.take(2))
+        dt = np.dtype(r.take(dl).decode())
+        scalar = r.take(1) == b"\x01"
+        (ndim,) = _U16.unpack(r.take(2))
+        shape = tuple(_U32.unpack(r.take(4))[0] for _ in range(ndim))
+        count = 1
+        for d in shape:
+            count *= d
+        arr = np.frombuffer(
+            r.take(count * dt.itemsize), dtype=dt
+        ).reshape(shape).copy()
+        return arr[()] if scalar and ndim == 0 else arr
+    if tag == b"U":
+        (n,) = _U32.unpack(r.take(4))
+        return tuple(_dec(r) for _ in range(n))
+    if tag == b"L":
+        (n,) = _U32.unpack(r.take(4))
+        return [_dec(r) for _ in range(n)]
+    if tag == b"M":
+        (n,) = _U32.unpack(r.take(4))
+        return {_dec(r): _dec(r) for _ in range(n)}
+    if tag == b"E":
+        (n,) = _U16.unpack(r.take(2))
+        return DataType(r.take(n).decode())
+    if tag == b"O":
+        (tid,) = _U16.unpack(r.take(2))
+        types, _ = _tables()
+        if tid >= len(types):
+            raise WireError(f"unknown wire type id {tid}")
+        fields = _dec(r)
+        return _obj_build(types[tid], fields)
+    raise WireError(f"unknown wire tag {tag!r}")
+
+
+def decode(buf: bytes):
+    if not buf:
+        raise WireError("empty message")
+    if buf[0] != WIRE_VERSION:
+        raise WireError(f"wire version {buf[0]} != {WIRE_VERSION}")
+    r = _Reader(buf)
+    r.pos = 1
+    obj = _dec(r)
+    if r.pos != len(buf):
+        raise WireError(f"{len(buf) - r.pos} trailing bytes")
+    return obj

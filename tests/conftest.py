@@ -45,6 +45,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "requires_tpu: needs real TPU hardware (excluded by default)"
     )
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running tests"
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,54 @@
+"""PEM agent worker process for the multi-process cluster test.
+
+Usage: python tests/pem_worker.py <port> <agent_id> <seed> <n_rows>
+
+Connects to a BusServer over TCP (netbus.RemoteBus), seeds an
+http_events replay deterministic in <seed>, starts a PEM agent, prints
+READY, and serves until stdin closes (the parent's exit) or SIGTERM.
+"""
+
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    port, agent_id, seed, n = (
+        int(sys.argv[1]), sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+    )
+    import numpy as np
+
+    from pixie_tpu.services.agent import PEMAgent
+    from pixie_tpu.services.netbus import RemoteBus
+
+    bus = RemoteBus("127.0.0.1", port)
+    pem = PEMAgent(bus, agent_id, heartbeat_interval_s=0.2)
+    rng = np.random.default_rng(seed)
+    pem.append_data(
+        "http_events",
+        {
+            "time_": np.arange(n, dtype=np.int64),
+            "latency_ns": rng.integers(1000, 1_000_000, n),
+            "resp_status": rng.choice(np.array([200, 200, 404, 500]), n),
+            "service": [f"svc-{(seed + j) % 4}" for j in range(n)],
+        },
+    )
+    pem.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    print("READY", flush=True)
+    # Exit when the parent closes our stdin (test teardown) or SIGTERM.
+    threading.Thread(
+        target=lambda: (sys.stdin.read(), stop.set()), daemon=True
+    ).start()
+    stop.wait()
+    pem.stop()
+    bus.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
