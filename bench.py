@@ -473,6 +473,82 @@ def _shape_perf_flamegraph(n, window):
     }
 
 
+def _shape_device_join(n, window):
+    """Bonus shape: RAW pre-agg N:M self-join through the engine's device
+    join kernel (VERDICT r02 ask #5 — the five BASELINE joins are all
+    post-agg), then a small aggregate so output stays bounded."""
+    from pixie_tpu.types.dtypes import DataType
+    from pixie_tpu.types.relation import Relation
+
+    rng = np.random.default_rng(19)
+    n_keys = max(n // 2, 1)
+    rel_l = Relation([
+        ("time_", DataType.TIME64NS),
+        ("k", DataType.INT64),
+        ("b", DataType.INT64),
+    ])
+    rel_r = Relation([
+        ("time_", DataType.TIME64NS),
+        ("k", DataType.INT64),
+        ("v", DataType.INT64),
+    ])
+    lk = rng.integers(0, n_keys, n)
+    lb = rng.integers(0, 16, n)
+    rk = rng.integers(0, n_keys, n)
+    rv = rng.integers(0, 1000, n)
+
+    def cols_l(off, m):
+        s = slice(off, off + m)
+        return {"time_": (np.arange(off, off + m, dtype=np.int64),),
+                "k": (lk[s],), "b": (lb[s],)}
+
+    def cols_r(off, m):
+        s = slice(off, off + m)
+        return {"time_": (np.arange(off, off + m, dtype=np.int64),),
+                "k": (rk[s],), "v": (rv[s],)}
+
+    from pixie_tpu.exec.engine import Engine
+
+    eng = Engine(window_rows=window)
+    eng.create_table("conn_l")
+    eng.create_table("conn_r")
+    _push_encoded(eng, "conn_l", rel_l, cols_l, n, window, {})
+    _push_encoded(eng, "conn_r", rel_r, cols_r, n, window, {})
+    warm = Engine(window_rows=window)
+    warm.create_table("conn_l")
+    warm.create_table("conn_r")
+    n_warm = min(n, window)
+    _push_encoded(warm, "conn_l", rel_l, cols_l, n_warm, window, {})
+    _push_encoded(warm, "conn_r", rel_r, cols_r, n_warm, window, {})
+    query = """
+import px
+l = px.DataFrame(table='conn_l')
+r = px.DataFrame(table='conn_r')
+g = l.merge(r, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+out = g.groupby('b').agg(n=('v', px.count), s=('v', px.sum))
+px.display(out)
+"""
+    rps, dt, out = _time_query(eng, query, 2 * n, warm_eng=warm)
+
+    t0 = time.perf_counter()
+    cnt_r = np.bincount(rk, minlength=n_keys)
+    sum_r = np.bincount(rk, weights=rv.astype(np.float64), minlength=n_keys)
+    ref_n = np.bincount(lb, weights=cnt_r[lk].astype(np.float64), minlength=16)
+    ref_s = np.bincount(lb, weights=sum_r[lk], minlength=16)
+    base_dt = time.perf_counter() - t0
+
+    got = out["output"].to_pydict()
+    order = np.argsort(got["b"])
+    present = np.nonzero(ref_n)[0]
+    assert np.array_equal(got["b"][order], present), "join keys mismatch"
+    np.testing.assert_allclose(got["n"][order], ref_n[present], rtol=1e-9)
+    np.testing.assert_allclose(got["s"][order], ref_s[present], rtol=1e-9)
+    return {
+        "rows": 2 * n, "rows_per_sec": round(rps), "secs": round(dt, 3),
+        "vs_baseline": round(rps / ((2 * n) / base_dt), 3), "checked": True,
+    }
+
+
 def inner() -> int:
     t_start = time.monotonic()
     deadline = float(os.environ.get("PIXIE_TPU_BENCH_DEADLINE", 420))
@@ -491,7 +567,8 @@ def inner() -> int:
         s.strip()
         for s in os.environ.get(
             "PIXIE_TPU_BENCH_SHAPES",
-            "http_stats,service_stats,net_flow_graph,sql_stats,perf_flamegraph",
+            "http_stats,service_stats,net_flow_graph,sql_stats,"
+            "perf_flamegraph,device_join",
         ).split(",")
         if s.strip()
     ]
@@ -513,6 +590,7 @@ def inner() -> int:
         ("net_flow_graph", _shape_net_flow_graph, n // 2),
         ("sql_stats", _shape_sql_stats, n // 4),
         ("perf_flamegraph", _shape_perf_flamegraph, n // 4),
+        ("device_join", _shape_device_join, n // 4),
     ]
     known = {"service_stats"} | {t[0] for t in tails}
     unknown = [s for s in want if s != "http_stats" and s not in known]

@@ -28,7 +28,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .groupby import dense_group_ids
+from .groupby import dense_group_ids, dense_group_ids_hash
 
 
 def _exclusive_cumsum(x):
@@ -90,10 +90,20 @@ def device_join(
 
     # 1. Shared exact key-id space. Invalid rows get id b+n from the
     # group machinery; split that trash id per side so invalid build and
-    # invalid probe rows can never match each other.
+    # invalid probe rows can never match each other. The bounded-probe
+    # hash table is O(rounds * (b+n)) elementwise vs the multi-plane
+    # stable sort's O((b+n) log(b+n)) — at 10M-row joins that sort was
+    # the kernel's hot spot. Distinct keys <= b+n by construction, so a
+    # reported overflow can only mean probe exhaustion (pathological
+    # clustering); lax.cond falls back to the exact sort path then.
     cat_keys = [jnp.concatenate([bk, pk]) for bk, pk in zip(build_keys, probe_keys)]
     cat_valid = jnp.concatenate([build_valid, probe_valid])
-    ids, _, _, _ = dense_group_ids(cat_keys, cat_valid, b + n)
+    ids_h, _, _, ng_h = dense_group_ids_hash(cat_keys, cat_valid, b + n)
+    ids = jax.lax.cond(
+        ng_h > b + n,
+        lambda: dense_group_ids(cat_keys, cat_valid, b + n)[0],
+        lambda: ids_h,
+    )
     kb = jnp.where(build_valid, ids[:b], b + n)
     kp = jnp.where(probe_valid, ids[b:], b + n + 1)
 

@@ -27,6 +27,14 @@ MAX_FRAME = 1 << 30
 
 def _send_frame(sock: socket.socket, obj) -> None:
     payload = encode(obj)
+    if len(payload) > MAX_FRAME:
+        # Fail the PUBLISHER visibly; an oversize frame on the wire would
+        # instead kill the receiver's connection and silently drop all of
+        # its subscriptions.
+        raise ValueError(
+            f"frame of {len(payload)} bytes exceeds MAX_FRAME {MAX_FRAME}; "
+            "chunk the payload"
+        )
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
@@ -271,6 +279,15 @@ class RemoteBus:
             pass
         finally:
             self._closed.set()
+            self._reap_dispatchers()
+
+    def _reap_dispatchers(self) -> None:
+        """End every subscription dispatcher thread (connection gone)."""
+        with self._lock:
+            subs = list(self._handlers.values())
+            self._handlers.clear()
+        for sub in subs:
+            sub._q.put(sub._SENTINEL)
 
     def close(self) -> None:
         self._closed.set()
@@ -278,3 +295,4 @@ class RemoteBus:
             self.sock.close()
         except OSError:
             pass
+        self._reap_dispatchers()

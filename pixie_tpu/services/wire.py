@@ -191,8 +191,10 @@ def _enc(obj, out: list) -> None:
         if not arr.flags["C_CONTIGUOUS"]:
             arr = np.ascontiguousarray(arr)
         if arr.dtype == object:  # decoded string columns etc.
-            out.append(b"L")
-            out.append(_U32.pack(arr.size))
+            out.append(b"G")
+            out.append(_U16.pack(arr.ndim))
+            for d in arr.shape:
+                out.append(_U32.pack(d))
             for v in arr.reshape(-1).tolist():
                 _enc(v, out)
             return
@@ -210,11 +212,16 @@ def _enc(obj, out: list) -> None:
         out.append(_U32.pack(len(obj)))
         for v in obj:
             _enc(v, out)
-    elif isinstance(obj, (list, frozenset, set)):
-        items = sorted(obj) if isinstance(obj, (set, frozenset)) else obj
+    elif isinstance(obj, (set, frozenset)):
+        # Sets would decode as lists — a silent type change the in-process
+        # bus never makes; reject at the publisher instead.
+        raise WireError(
+            "sets are not wire-encodable; send a sorted list/tuple"
+        )
+    elif isinstance(obj, list):
         out.append(b"L")
-        out.append(_U32.pack(len(items)))
-        for v in items:
+        out.append(_U32.pack(len(obj)))
+        for v in obj:
             _enc(v, out)
     elif isinstance(obj, dict):
         out.append(b"M")
@@ -299,6 +306,16 @@ def _dec(r: _Reader):
             r.take(count * dt.itemsize), dtype=dt
         ).reshape(shape).copy()
         return arr[()] if scalar and ndim == 0 else arr
+    if tag == b"G":
+        (ndim,) = _U16.unpack(r.take(2))
+        shape = tuple(_U32.unpack(r.take(4))[0] for _ in range(ndim))
+        count = 1
+        for d in shape:
+            count *= d
+        arr = np.empty(count, dtype=object)
+        for i in range(count):
+            arr[i] = _dec(r)
+        return arr.reshape(shape)
     if tag == b"U":
         (n,) = _U32.unpack(r.take(4))
         return tuple(_dec(r) for _ in range(n))

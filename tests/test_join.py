@@ -207,3 +207,41 @@ px.display(j)
 """)["output"].to_pydict()
         rows = sorted(zip(out["x"].tolist(), out["y"].tolist()))
         assert rows == [(0, 6), (10, 0), (20, 5)]
+
+
+@pytest.mark.slow
+class TestJoinScale:
+    """Moderate-scale N:M self-join vs numpy (the 10M-row hardware case
+    lives in tests/test_tpu.py::test_device_join_10m_on_tpu)."""
+
+    def test_half_million_self_join_matches_numpy(self):
+        import jax
+
+        from pixie_tpu.ops.join import device_join
+        from pixie_tpu.types.batch import bucket_capacity
+
+        n = 500_000
+        rng = np.random.default_rng(31)
+        nb = bucket_capacity(n)
+        bk = rng.integers(0, n // 2, nb).astype(np.int64)
+        pk = rng.integers(0, n // 2, nb).astype(np.int64)
+        bv = np.zeros(nb, dtype=bool)
+        bv[:n] = True
+        pv = np.zeros(nb, dtype=bool)
+        pv[:n] = True
+        cap = bucket_capacity(4 * n)
+        out = device_join([jax.numpy.asarray(bk)], jax.numpy.asarray(bv),
+                          [jax.numpy.asarray(pk)], jax.numpy.asarray(pv),
+                          cap, "inner")
+        p_idx, p_take, b_idx, b_take, out_valid, overflow = (
+            np.asarray(a) for a in out
+        )
+        assert not bool(overflow)
+        cnt = np.bincount(bk[:n], minlength=n // 2)
+        assert int(out_valid.sum()) == int(cnt[pk[:n]].sum())
+        sel = np.nonzero(out_valid)[0]
+        # Every emitted pair joins equal keys.
+        assert (pk[p_idx[sel]] == bk[b_idx[sel]]).all()
+        # Per-probe-row emission count matches numpy fan-out.
+        emitted = np.bincount(p_idx[sel], minlength=nb)
+        np.testing.assert_array_equal(emitted[:n], cnt[pk[:n]])
