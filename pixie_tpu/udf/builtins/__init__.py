@@ -11,7 +11,11 @@ from . import (
     math_ops,
     math_sketches,
     ml_ops,
+    net_ops,
+    pii_ops,
+    protocol_ops,
     regex_ops,
+    request_path_ops,
     sql_ops,
     string_ops,
 )
@@ -27,4 +31,8 @@ def register_all(reg):
     regex_ops.register(reg)
     sql_ops.register(reg)
     ml_ops.register(reg)
+    pii_ops.register(reg)
+    request_path_ops.register(reg)
+    net_ops.register(reg)
+    protocol_ops.register(reg)
     introspection.register_introspection(reg)

@@ -218,3 +218,129 @@ class TestSketches:
         full = run_uda(uda, vals, gids, 1)[0]
         split = run_uda(uda, vals, gids, 1, split=1000)[0]
         assert full == split  # HLL merge is exact (register max)
+
+
+class TestPiiOps:
+    def test_redaction_kinds(self, reg):
+        from pixie_tpu.udf.builtins.pii_ops import redact_pii
+
+        assert redact_pii("mail me at bob.a+x@corp.io now") == \
+            "mail me at <REDACTED_EMAIL> now"
+        assert redact_pii("src=10.1.2.3 dst=255.255.255.255") == \
+            "src=<REDACTED_IPV4> dst=<REDACTED_IPV4>"
+        assert "<REDACTED_IPV6>" in redact_pii("at 2001:db8::8a2e:370:7334 ok")
+        assert "<REDACTED_MAC_ADDR>" in redact_pii("nic 00:1B:44:11:3A:B7 up")
+        # Valid Visa test number passes Luhn -> redacted.
+        assert redact_pii("cc 4111 1111 1111 1111 ok") == \
+            "cc <REDACTED_CC_NUMBER> ok"
+        # Luhn-failing digit runs stay (e.g. an order id).
+        assert redact_pii("order 4111111111111112") == \
+            "order 4111111111111112"
+        assert reg.get_scalar("redact_pii_best_effort", (S,)).executor.name \
+            == "HOST_DICT"
+
+
+class TestRequestPathOps:
+    def test_templates(self):
+        from pixie_tpu.udf.builtins.request_path_ops import (
+            cluster_request_path,
+        )
+
+        assert cluster_request_path("/api/v1/users/12345/orders") == \
+            "/api/v1/users/*/orders"
+        assert cluster_request_path(
+            "orgs/9f8b4a12-aaaa-bbbb-cccc-0123456789ab/info"
+        ) == "/orgs/*/info"
+        assert cluster_request_path("/static/app.js?v=3") == "/static/app.js"
+        assert cluster_request_path("/a/deadbeef01/b") == "/a/*/b"
+
+    def test_matcher(self):
+        from pixie_tpu.udf.builtins.request_path_ops import _endpoint_matches
+
+        assert _endpoint_matches("/a/7/c", "/a/*/c")
+        assert not _endpoint_matches("/a/7", "/a/*/c")
+        assert not _endpoint_matches("/a/7/d", "/a/*/c")
+
+
+class TestNetOps:
+    def test_ip_to_int_and_cidr(self):
+        from pixie_tpu.udf.builtins.net_ops import cidr_contains, ip_to_int
+
+        assert ip_to_int("10.0.0.1") == (10 << 24) + 1
+        assert ip_to_int("not an ip") == 0
+        assert cidr_contains("10.1.2.3", "10.0.0.0/8")
+        assert not cidr_contains("11.1.2.3", "10.0.0.0/8")
+        assert not cidr_contains("garbage", "10.0.0.0/8")
+
+    def test_nslookup_falls_back(self, monkeypatch):
+        import socket as _socket
+
+        from pixie_tpu.udf.builtins import net_ops
+
+        def boom(addr):
+            raise OSError("no resolver")
+
+        monkeypatch.setattr(_socket, "gethostbyaddr", boom)
+        net_ops._NSLOOKUP_CACHE.clear()
+        assert net_ops.nslookup("203.0.113.9") == "203.0.113.9"
+        # Cached: the resolver is not consulted again.
+        monkeypatch.setattr(
+            _socket, "gethostbyaddr", lambda a: ("late.example", [], [])
+        )
+        assert net_ops.nslookup("203.0.113.9") == "203.0.113.9"
+
+
+class TestProtocolOps:
+    def test_protocol_name_device_table(self, reg):
+        udf = reg.get_scalar("protocol_name", (I64,))
+        ids = np.asarray(udf.fn(jnp.asarray([0, 1, 3, 10, 99, -1])))
+        names = [udf.out_dict.strings[i] for i in ids]
+        assert names == ["Unknown", "HTTP", "MySQL", "Kafka",
+                         "Unknown", "Unknown"]
+
+    def test_http_resp_message(self, reg):
+        udf = reg.get_scalar("http_resp_message", (I64,))
+        ids = np.asarray(udf.fn(jnp.asarray([200, 404, 503, 999, 7])))
+        names = [udf.out_dict.strings[i] for i in ids]
+        assert names == ["OK", "Not Found", "Service Unavailable",
+                         "Unknown", "Unknown"]
+
+    def test_mysql_and_kafka_names(self, reg):
+        udf = reg.get_scalar("mysql_command_name", (I64,))
+        ids = np.asarray(udf.fn(jnp.asarray([3, 0x16, 200])))
+        names = [udf.out_dict.strings[i] for i in ids]
+        assert names == ["Query", "StmtPrepare", "Unknown"]
+        udf = reg.get_scalar("kafka_api_key_name", (I64,))
+        ids = np.asarray(udf.fn(jnp.asarray([0, 1, 18])))
+        names = [udf.out_dict.strings[i] for i in ids]
+        assert names == ["Produce", "Fetch", "ApiVersions"]
+
+
+class TestNewBuiltinsEndToEnd:
+    def test_pxl_redact_and_cluster(self):
+        from pixie_tpu.exec import Engine
+
+        e = Engine(window_rows=1 << 10)
+        e.append_data("http_events", {
+            "time_": np.arange(4, dtype=np.int64),
+            "req_path": ["/api/users/101", "/api/users/222",
+                         "/api/login", "/api/users/101"],
+            "req_body": ["id=1 from 10.0.0.9", "ok", "x@y.io wrote", "ok"],
+            "protocol": np.array([1, 1, 3, 1], dtype=np.int64),
+        })
+        out = e.execute_query("""
+import px
+df = px.DataFrame(table='http_events')
+df.endpoint = px.cluster_request_path(df.req_path)
+df.clean = px.redact_pii_best_effort(df.req_body)
+df.proto = px.protocol_name(df.protocol)
+s = df.groupby('endpoint').agg(n=('time_', px.count))
+px.display(s, 'by_endpoint')
+px.display(df, 'rows')
+""")
+        by_ep = out["by_endpoint"].to_pydict()
+        assert sorted(by_ep["endpoint"]) == ["/api/login", "/api/users/*"]
+        assert by_ep["n"].sum() == 4
+        rows = out["rows"].to_pydict()
+        assert rows["clean"][0] == "id=? from <REDACTED_IPV4>".replace("?", "1")
+        assert rows["proto"][2] == "MySQL"

@@ -170,25 +170,36 @@ class TestMultiProcessCluster:
         env["PALLAS_AXON_POOL_IPS"] = ""
         env["JAX_PLATFORMS"] = "cpu"
         workers = []
+        err_files = []
         try:
+            import tempfile
+
             for i in range(2):
+                # Worker stderr goes to a FILE, not a pipe: jax emits
+                # kilobytes of warnings and an undrained pipe blocks the
+                # worker before it ever registers.
+                ef = tempfile.TemporaryFile(mode="w+")
+                err_files.append(ef)
                 workers.append(subprocess.Popen(
                     [sys.executable,
                      os.path.join(os.path.dirname(__file__), "pem_worker.py"),
                      str(server.port), f"pem-{i}", str(i), str(self.N)],
                     env=env,
                     stdin=subprocess.PIPE,
-                    stdout=subprocess.PIPE,
+                    stdout=subprocess.DEVNULL,
+                    stderr=ef,
                     text=True,
                 ))
-            deadline = time.time() + 120
+            deadline = time.time() + 240
             while time.time() < deadline:
                 if len(tracker.agent_ids()) >= 3:  # 2 PEMs + kelvin
                     break
-                for w in workers:
+                for w, ef in zip(workers, err_files):
                     if w.poll() is not None:
+                        ef.seek(0)
                         raise AssertionError(
-                            f"worker died rc={w.returncode}"
+                            f"worker died rc={w.returncode}: "
+                            f"{ef.read()[-2000:]}"
                         )
                 time.sleep(0.1)
             assert len(tracker.agent_ids()) >= 3, tracker.agent_ids()
@@ -202,7 +213,7 @@ class TestMultiProcessCluster:
                 "    mean_lat=('latency_ns', px.mean),\n"
                 ")\n"
                 "px.display(s)\n",
-                timeout_s=90.0,
+                timeout_s=180.0,
             )
             got = res["tables"]["output"].to_pydict()
             assert len(res["agent_stats"]) == 2
@@ -233,6 +244,8 @@ class TestMultiProcessCluster:
                     w.wait(timeout=10)
                 except Exception:
                     w.kill()
+            for ef in err_files:
+                ef.close()
             kelvin.stop()
             tracker.close()
             server.close()
