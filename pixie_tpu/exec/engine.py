@@ -31,7 +31,7 @@ from ..types.dtypes import DataType, host_dtypes
 from ..types.relation import Relation
 from ..types.strings import NULL_ID, StringDictionary
 from ..udf.registry import Registry, default_registry
-from .fragment import ColumnMeta, compile_fragment
+from .fragment import ColumnMeta, compile_fragment_cached as compile_fragment
 from .plan import (
     AggOp,
     BridgeSinkOp,
@@ -714,8 +714,14 @@ def _range_mask_fn(capacity: int):
     import jax
     import jax.numpy as jnp
 
-    iota = jnp.arange(capacity, dtype=jnp.int32)
-    return jax.jit(lambda lo, hi: (iota >= lo) & (iota < hi))
+    # The iota must be created INSIDE the traced function: a concrete jax
+    # Array captured as a jit-closure constant permanently degrades every
+    # later dispatch on the axon TPU tunnel.
+    return jax.jit(
+        lambda lo, hi: (
+            (i := jnp.arange(capacity, dtype=jnp.int32)) >= lo
+        ) & (i < hi)
+    )
 
 
 def _col(name):

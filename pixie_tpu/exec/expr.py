@@ -102,13 +102,15 @@ def _bind_func(expr: FuncCall, relation, dicts, registry: Registry) -> BoundExpr
             sibling_dict = b.dict
         elif b.dict is not sibling_dict:
             merged, _, remap = sibling_dict.union(b.dict)
-            remap_j = jnp.asarray(remap)
+            remap_j = np.asarray(remap)
             prev_fn = b.fn
             bound[i] = BoundExpr(
                 fn=(
                     lambda _f, _r: (
                         lambda cols: jnp.where(
-                            (ids := _f(cols)) >= 0, _r[jnp.clip(ids, 0)], NULL_ID
+                            (ids := _f(cols)) >= 0,
+                            jnp.asarray(_r)[jnp.clip(ids, 0)],
+                            NULL_ID,
                         )
                     )
                 )(prev_fn, remap_j),
@@ -176,11 +178,15 @@ def _bind_host_dict(expr, udf, bound, str_literals, relation, dicts, registry) -
     src_fn = src.fn
     if udf.return_type == DataType.STRING:
         new_dict, remap = src_dict.transform(call_one)
-        remap_j = jnp.asarray(remap)
+        remap_j = np.asarray(remap)
 
         def fn(cols):
+            # jnp.asarray at TRACE time: an eagerly-created jax Array
+            # captured as a jit constant poisons axon-tunnel dispatch.
             ids = src_fn(cols)
-            return jnp.where(ids >= 0, remap_j[jnp.clip(ids, 0)], NULL_ID)
+            return jnp.where(
+                ids >= 0, jnp.asarray(remap_j)[jnp.clip(ids, 0)], NULL_ID
+            )
 
         return BoundExpr(fn=fn, dtype=DataType.STRING, dict=new_dict)
 
@@ -197,12 +203,12 @@ def _bind_host_dict(expr, udf, bound, str_literals, relation, dicts, registry) -
         DataType.TIME64NS: np.int64,
     }[udf.return_type]
     table = np.asarray([call_one(s) for s in src_dict.strings] + [null_value], dtype=np_dt)
-    table_j = jnp.asarray(table)
+    table_j = table
     k = len(src_dict.strings)
 
     def fn(cols):
         ids = src_fn(cols)
         safe = jnp.where((ids >= 0) & (ids < k), ids, k)
-        return table_j[safe]
+        return jnp.asarray(table_j)[safe]
 
     return BoundExpr(fn=fn, dtype=udf.return_type)

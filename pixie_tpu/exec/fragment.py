@@ -76,6 +76,67 @@ class CompiledFragment:
     string_carry_sources: tuple = ()  # tuple[(out_name, tuple[col, ...])]
 
 
+_FRAGMENT_CACHE: dict = {}
+_FRAGMENT_CACHE_MAX = 128
+
+
+def _struct_key(x):
+    """Canonical hashable form of a plan-op / expr tree (class names keep
+    e.g. ColumnRef('x') distinct from a bare string)."""
+    import dataclasses
+
+    if dataclasses.is_dataclass(x) and not isinstance(x, type):
+        return (type(x).__name__,) + tuple(
+            _struct_key(getattr(x, f.name)) for f in dataclasses.fields(x)
+        )
+    if isinstance(x, (list, tuple)):
+        return tuple(_struct_key(v) for v in x)
+    if isinstance(x, dict):
+        return tuple(sorted((k, _struct_key(v)) for k, v in x.items()))
+    return x
+
+
+def compile_fragment_cached(ops, input_relation, input_dicts, registry):
+    """``compile_fragment`` memoized on plan structure.
+
+    A fragment's jitted ``update``/``finalize`` closures hold the XLA
+    executables; rebuilding them per query forces a re-trace + compile
+    every ``execute_query`` (~10s/query over the TPU tunnel, BENCH r02's
+    real bottleneck — Carnot similarly reuses compiled plan state,
+    ``src/carnot/carnot.cc:122``). Keyed on the op chain, input schema,
+    the identity+size of every string dictionary (growth re-encodes
+    string literals), and the registry identity. Unhashable chains (not
+    produced by the planner today) fall back to uncached compilation.
+    """
+    from ..config import get_flag
+
+    try:
+        key = (
+            _struct_key(tuple(ops)),
+            tuple(input_relation.items()),
+            tuple(
+                sorted((n, id(d), len(d)) for n, d in input_dicts.items())
+            ),
+            id(registry),
+            get_flag("groupby_impl"),
+        )
+        hash(key)
+    except TypeError:
+        return compile_fragment(ops, input_relation, input_dicts, registry)
+    hit = _FRAGMENT_CACHE.get(key)
+    if hit is None:
+        frag = compile_fragment(ops, input_relation, input_dicts, registry)
+        if len(_FRAGMENT_CACHE) >= _FRAGMENT_CACHE_MAX:
+            _FRAGMENT_CACHE.pop(next(iter(_FRAGMENT_CACHE)))
+        # The entry pins the id()-keyed objects (dicts, registry): a freed
+        # object's address can be recycled, which would otherwise let a
+        # different same-shaped dictionary hit this entry.
+        _FRAGMENT_CACHE[key] = (frag, tuple(input_dicts.values()), registry)
+    else:
+        frag = hit[0]
+    return frag
+
+
 def _bind_pre_stage(ops, relation, dicts, registry):
     """Bind leading Map/Filter ops; returns (apply_fn, relation, dicts)."""
     steps = []  # ("map", [(name, BoundExpr)]) | ("filter", BoundExpr)
@@ -199,7 +260,10 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
             "overflow": jnp.zeros((), dtype=jnp.bool_),
         }
 
-    init_carries = {ae.out_name: uda.init(g) for ae, uda, _, _ in aggs_bound}
+    # NOTE: merge_states materializes neutral carries by calling uda.init(g)
+    # DURING tracing (never precompute them eagerly here): a concrete jax
+    # Array captured as a jit-closure constant permanently degrades every
+    # subsequent dispatch on the axon TPU tunnel to ~65ms/call.
 
     # Per-window group ids: bounded-probe hash table (O(rounds*n)) by
     # default; 'sort' falls back to the multi-key stable sort. The small
@@ -244,13 +308,12 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
         )
         carries = {}
         for ae, uda, _, _ in aggs_bound:
+            neutral = uda.init(g)
             ca = scatter_carry(
-                sa["carries"][ae.out_name], ids_a, sa["valid"], g,
-                init_carries[ae.out_name],
+                sa["carries"][ae.out_name], ids_a, sa["valid"], g, neutral
             )
             cb = scatter_carry(
-                sb["carries"][ae.out_name], ids_b, sb["valid"], g,
-                init_carries[ae.out_name],
+                sb["carries"][ae.out_name], ids_b, sb["valid"], g, neutral
             )
             carries[ae.out_name] = uda.merge(ca, cb)
         overflow = sa["overflow"] | sb["overflow"] | (n_tot > g)

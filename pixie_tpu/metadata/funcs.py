@@ -58,21 +58,18 @@ def register_metadata_funcs(reg, state: MetadataState) -> None:
     his = np.asarray(snap["hi"], dtype=np.uint64)
     los = np.asarray(snap["lo"], dtype=np.uint64)
     table = build_table((his, los), np.arange(n, dtype=np.int32))
-    dev_arrays = (
-        tuple(jnp.asarray(p) for p in table.key_planes),
-        jnp.asarray(table.values),
-        jnp.asarray(table.occupied),
-    )
+    # Constants stay numpy until TRACE time: eagerly-created jax Arrays
+    # captured as jit constants poison axon-tunnel dispatch. device_lookup
+    # converts the table planes inline during tracing.
 
     for fname, attr in _UPID_ATTRS.items():
         d = StringDictionary()
-        ids = d.encode(snap[attr] + [""])  # [n+1]; slot n = miss -> ""
-        ids_j = jnp.asarray(ids)
+        ids = np.asarray(d.encode(snap[attr] + [""]))  # [n+1]; n = miss -> ""
 
-        def fn(upid, _tbl=table, _dev=dev_arrays, _ids=ids_j, _n=n):
+        def fn(upid, _tbl=table, _ids=ids, _n=n):
             hi, lo = upid
-            vals, found = device_lookup(_tbl, (hi, lo), _dev)
-            return _ids[jnp.where(found, vals, _n)]
+            vals, found = device_lookup(_tbl, (hi, lo))
+            return jnp.asarray(_ids)[jnp.where(found, vals, _n)]
 
         reg.scalar(
             fname, (UINT128,), STRING, fn, out_dict=d,

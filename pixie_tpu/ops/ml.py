@@ -21,8 +21,11 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-_EMPTY = jnp.float32(jnp.inf)  # priority of an empty reservoir slot
+# numpy, not jnp: an eagerly-created jax scalar captured as a jit
+# constant permanently poisons axon-tunnel dispatch.
+_EMPTY = np.float32(np.inf)  # priority of an empty reservoir slot
 
 
 def row_priorities(values, salt: int = 0x9E3779B9):

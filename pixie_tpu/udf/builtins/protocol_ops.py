@@ -112,11 +112,11 @@ def _dense_table_udf(mapping, size, unknown="Unknown"):
     table = np.full(size + 1, d.lookup(unknown), dtype=np.int32)
     for code, name in mapping.items():
         table[code] = d.lookup(name)
-    table_j = jnp.asarray(table)
-
     def fn(x):
+        # jnp.asarray at TRACE time (an eager jax Array captured as a jit
+        # constant poisons axon-tunnel dispatch).
         safe = jnp.clip(x.astype(jnp.int32), 0, size)
-        ids = table_j[safe]
+        ids = jnp.asarray(table)[safe]
         return jnp.where(x.astype(jnp.int32) == safe, ids, table[size]).astype(
             jnp.int32
         )
