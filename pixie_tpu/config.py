@@ -89,9 +89,17 @@ define_flag("max_groups", 4096,
             "Initial group-by capacity; overflow doubles it and re-runs.")
 define_flag("max_groups_limit", 1 << 22,
             "Hard cap for group-by rebucketing growth.")
-define_flag("groupby_impl", "hash",
-            "Per-window group-id algorithm: 'hash' (bounded-probe device "
-            "table) or 'sort' (multi-key stable sort).")
+define_flag("groupby_impl", "sort",
+            "Per-window group-id algorithm for keys WITHOUT a static dense "
+            "domain: 'sort' (multi-key stable sort; data-independent "
+            "runtime, the TPU-friendly default) or 'hash' (bounded-probe "
+            "device table; its data-dependent while-loop executes poorly "
+            "on the tunnel's synchronous dispatch mode).")
+define_flag("dense_domain_limit", 1 << 20,
+            "Group-bys whose key columns all have statically-known domains "
+            "(dictionary-encoded strings, booleans) with product <= this "
+            "use the packed key AS the group id: no sort, no hash, and "
+            "slot-aligned (regroup-free) state merges.")
 define_flag("device_residency", True,
             "Stage full table windows into device memory (HBM) at append "
             "time so steady-state queries run without host transfers.")

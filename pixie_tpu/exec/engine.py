@@ -66,6 +66,10 @@ class AggStatePayload:
     input_relation: object  # Relation at fragment input
     input_dicts: dict  # {col: StringDictionary} at fragment input
     state: dict  # group-state pytree (numpy leaves)
+    # Dense-domain states ship no key planes (slot index IS the packed
+    # key); the producing fragment's domains let the merge side expand
+    # them back to explicit keys (dictionaries may differ per agent).
+    dense_domains: tuple = ()
 
 
 @dataclass
@@ -82,6 +86,32 @@ class _PendingAggBridge:
     payloads: list  # list[AggStatePayload]
 
 
+def _expand_dense_payload(p, group_rel, key_plane_index):
+    """Expand a dense-domain AggStatePayload to explicit key planes.
+
+    Dense states carry no keys (slot index IS the packed key); the merge
+    tier reconstructs them with the same unpack arithmetic the producing
+    fragment's finalize uses, so the generic realign/merge path applies.
+    """
+    import dataclasses
+
+    from .fragment import unpack_dense_slots
+
+    doms = getattr(p, "dense_domains", ())
+    if not doms:
+        return p
+    gd = len(p.state["valid"])
+    keys = unpack_dense_slots(
+        np.arange(gd, dtype=np.int64),
+        doms,
+        [group_rel.col_type(c) for c, _i in key_plane_index],
+        np,
+    )
+    return dataclasses.replace(
+        p, state={**p.state, "keys": tuple(keys)}, dense_domains=()
+    )
+
+
 class QueryError(Exception):
     pass
 
@@ -96,6 +126,80 @@ class _Stream:
 
     def extend(self, op):
         return _Stream(self.relation, self.dicts, self.chain + [op], self.source, self.source_op)
+
+
+class DeviceResult:
+    """Device-resident aggregate query output.
+
+    Holds the finalized [G] column planes + validity on device. The axon
+    TPU tunnel journals device work lazily until a process's first
+    device-to-host readback; that flush executes everything recorded and
+    switches later dispatches to a synchronous mode (~65ms round trip
+    each) in which compiling NEW programs can stall. Callers therefore
+    compile/warm with ``materialize=False`` and control when the single
+    readback — ``to_host()``, which also resolves group-overflow
+    rebucketing — happens. ``block_until_ready()`` fences without
+    reading back (it does NOT flush the journal).
+
+    Reference contrast: Carnot's MemorySink always lands rows host-side
+    (``src/carnot/exec/memory_sink_node.cc``); on TPU the result's natural
+    home is HBM until a client asks for bytes.
+    """
+
+    def __init__(self, engine, stream, frag, cols, valid, overflow, stats=None):
+        self._engine = engine
+        self._stream = stream
+        self._frag = frag
+        self._cols = cols
+        self._valid = valid
+        self._overflow = overflow
+        self._stats = stats
+        self._host: Optional[HostBatch] = None
+
+    @property
+    def relation(self):
+        return self._frag.relation
+
+    def block_until_ready(self) -> "DeviceResult":
+        import jax
+
+        jax.block_until_ready((self._cols, self._valid, self._overflow))
+        return self
+
+    def to_host(self) -> HostBatch:
+        if self._host is not None:
+            return self._host
+        eng, stream, frag = self._engine, self._stream, self._frag
+        cols, valid, overflow = self._cols, self._valid, self._overflow
+        qstats = getattr(eng, "_query_stats", None)
+        stats = self._stats
+        while bool(overflow):
+            # Rebucket: double max_groups and re-run the stream (the same
+            # recovery the device join uses on output overflow; Carnot's
+            # hash map grows instead, ``agg_node.cc``).
+            stream = _double_agg_groups(stream)
+            frag = compile_fragment(
+                stream.chain, stream.relation, stream.dicts, eng.registry
+            )
+            if qstats is not None:
+                # Fresh per-attempt stats: totals stay true wall time,
+                # per-fragment rows/windows stay per-attempt.
+                stats = qstats.new_fragment(stream.chain)
+                stats.ops = stats.ops + ("rebucket",)
+            state = eng._fold_agg_state(stream, frag, stats)
+            with _timed(stats, "finalize"):
+                cols, valid, overflow = frag.finalize(state)
+                _block_if(stats, (cols, valid, overflow))
+        with _timed(stats, "materialize"):
+            out = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
+        if stats is not None:
+            stats.rows_out = out.length
+        self._host = _apply_limit(out, frag.limit)
+        self._cols = self._valid = self._overflow = None  # release HBM
+        return self._host
+
+    def to_pydict(self, **kw):
+        return self.to_host().to_pydict(**kw)
 
 
 class Engine:
@@ -147,10 +251,13 @@ class Engine:
     # -- execution -----------------------------------------------------------
     def execute_query(self, query: str, now_ns: int = 0,
                       max_output_rows: int = 10_000,
-                      analyze: bool = False) -> dict:
+                      analyze: bool = False,
+                      materialize: bool = True) -> dict:
         """Compile a PxL script and execute it (Carnot::ExecuteQuery parity,
         ``src/carnot/carnot.cc:122-134``). Returns {output name: HostBatch}.
-        ``analyze`` records per-fragment stats on ``self.last_stats``."""
+        ``analyze`` records per-fragment stats on ``self.last_stats``.
+        ``materialize=False`` leaves aggregate outputs device-resident
+        (returns DeviceResult — call ``.to_host()`` for bytes)."""
         from ..planner import CompilerState, compile_pxl
 
         state = CompilerState(
@@ -160,7 +267,9 @@ class Engine:
             max_output_rows=max_output_rows,
         )
         compiled = compile_pxl(query, state)
-        return self.execute_plan(compiled.plan, analyze=analyze)
+        return self.execute_plan(
+            compiled.plan, analyze=analyze, materialize=materialize
+        )
 
     def set_metadata_state(self, state) -> None:
         """Attach k8s metadata; rebinds the metadata UDFs to a snapshot of
@@ -175,7 +284,7 @@ class Engine:
 
     def execute_plan(
         self, plan: Plan, bridge_inputs: dict | None = None,
-        analyze: bool = False,
+        analyze: bool = False, materialize: bool = True,
     ) -> dict:
         """Execute a plan. Whole plans return {sink name: HostBatch}.
 
@@ -193,16 +302,17 @@ class Engine:
             self._query_stats = QueryStats()
             t_start = time.perf_counter()
             try:
-                out = self._execute_plan_inner(plan, bridge_inputs)
+                out = self._execute_plan_inner(plan, bridge_inputs, materialize)
             finally:
                 self._query_stats.total_seconds = time.perf_counter() - t_start
                 self.last_stats = self._query_stats
                 self._query_stats = None
             return out
-        return self._execute_plan_inner(plan, bridge_inputs)
+        return self._execute_plan_inner(plan, bridge_inputs, materialize)
 
     def _execute_plan_inner(
-        self, plan: Plan, bridge_inputs: dict | None = None
+        self, plan: Plan, bridge_inputs: dict | None = None,
+        materialize: bool = True,
     ) -> dict:
         results: dict[int, object] = {}
         outputs: dict = {}
@@ -277,7 +387,18 @@ class Engine:
                 mats = [mat_input(i) for i in node.inputs]
                 results[nid] = _union_host(mats)
             elif isinstance(op, ResultSinkOp):
-                outputs[op.name] = mat_input(node.inputs[0])
+                src_id = node.inputs[0]
+                r = results[src_id]
+                if (
+                    not materialize
+                    and isinstance(r, _Stream)
+                    and consumers.get(src_id, 0) <= 1
+                ):
+                    # Device-resident result: the readback (and any
+                    # overflow rebucket) happens in DeviceResult.to_host.
+                    outputs[op.name] = self._run_fragment(r)
+                else:
+                    outputs[op.name] = mat_input(src_id)
             elif isinstance(op, OTelExportSinkOp):
                 from .otel import batch_to_otlp
 
@@ -355,6 +476,7 @@ class Engine:
                 input_relation=res.relation,
                 input_dicts=dict(res.dicts),
                 state=jax.tree_util.tree_map(np.asarray, state),
+                dense_domains=frag.dense_domains,
             )
         return RowsPayload(batch=self._materialize(res))
 
@@ -386,22 +508,35 @@ class Engine:
 
         p0 = pending.payloads[0]
         # Agents may have rebucketed independently; merge at the largest
-        # capacity (smaller states pad with neutral slots below).
+        # capacity (smaller states pad with neutral slots below). Dense-
+        # domain states may be larger than any max_groups — their slot
+        # count bounds the distinct groups, so include it.
         g = max(
             op.max_groups
             for p in pending.payloads
             for op in p.chain
             if isinstance(op, AggOp)
         )
+        g = max([g] + [len(p.state["valid"]) for p in pending.payloads])
         chain = [
             dataclasses.replace(op, max_groups=g) if isinstance(op, AggOp) else op
             for op in p0.chain
         ]
+        # The merge fragment is compiled WITHOUT dense mode: agents encode
+        # against their own dictionaries, so dense slot spaces are not
+        # comparable across payloads — expand each dense state to explicit
+        # key planes and realign through the generic (sort-space) path.
         frag = compile_fragment(
-            chain, p0.input_relation, dict(p0.input_dicts), self.registry
+            chain, p0.input_relation, dict(p0.input_dicts), self.registry,
+            allow_dense=False,
         )
         key_plane_index = frag.key_plane_index
         group_rel = frag.group_relation
+        pending = _PendingAggBridge(payloads=[
+            _expand_dense_payload(p, group_rel, key_plane_index)
+            for p in pending.payloads
+        ])
+        p0 = pending.payloads[0]
         if frag.string_carry_sources and len(pending.payloads) > 1:
             # String ids inside a CARRY (not a group key) cannot be
             # realigned after the fact; reject unless every agent encoded
@@ -633,7 +768,19 @@ class Engine:
     def _materialize(self, res) -> HostBatch:
         if isinstance(res, HostBatch):
             return res
-        stream: _Stream = res
+        if isinstance(res, DeviceResult):
+            return res.to_host()
+        dr = self._run_fragment(res)
+        if isinstance(dr, DeviceResult):
+            return dr.to_host()
+        return dr
+
+    def _run_fragment(self, stream: "_Stream"):
+        """Run a stream's fragment; agg chains return a DeviceResult
+        (device-resident, no host readback — the first device-to-host
+        transfer permanently switches the axon tunnel into a slow
+        synchronous dispatch mode, so callers defer it as long as
+        possible), non-agg chains a HostBatch."""
         frag = compile_fragment(
             stream.chain, stream.relation, stream.dicts, self.registry
         )
@@ -641,30 +788,11 @@ class Engine:
         stats = qstats.new_fragment(stream.chain) if qstats is not None else None
 
         if frag.is_agg:
-            while True:
-                state = self._fold_agg_state(stream, frag, stats)
-                with _timed(stats, "finalize"):
-                    cols, valid, overflow = frag.finalize(state)
-                    _block_if(stats, (cols, valid, overflow))
-                if not bool(overflow):
-                    break
-                # Rebucket: double max_groups and re-run the stream (the
-                # same recovery the device join uses on output overflow;
-                # Carnot's hash map grows instead, ``agg_node.cc``).
-                stream = _double_agg_groups(stream)
-                frag = compile_fragment(
-                    stream.chain, stream.relation, stream.dicts, self.registry
-                )
-                if qstats is not None:
-                    # Fresh per-attempt stats: totals stay true wall time,
-                    # per-fragment rows/windows stay per-attempt.
-                    stats = qstats.new_fragment(stream.chain)
-                    stats.ops = stats.ops + ("rebucket",)
-            with _timed(stats, "materialize"):
-                out = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
-            if stats is not None:
-                stats.rows_out = out.length
-            return _apply_limit(out, frag.limit)
+            state = self._fold_agg_state(stream, frag, stats)
+            with _timed(stats, "finalize"):
+                cols, valid, overflow = frag.finalize(state)
+                _block_if(stats, (cols, valid, overflow))
+            return DeviceResult(self, stream, frag, cols, valid, overflow, stats)
 
         # Non-agg: stream windows, stop early once a limit is satisfied.
         _, _, rows_step = self._compile_steps(frag)

@@ -74,6 +74,9 @@ class CompiledFragment:
     # Group keys realign across agents; carries do not — the bridge merge
     # rejects such payloads unless every agent shares the dictionaries.
     string_carry_sources: tuple = ()  # tuple[(out_name, tuple[col, ...])]
+    # Dense-domain mode: per-group-col static domain sizes (the packed key
+    # IS the group id; state["keys"] is empty). () = not dense.
+    dense_domains: tuple = ()
 
 
 _FRAGMENT_CACHE: dict = {}
@@ -96,7 +99,8 @@ def _struct_key(x):
     return x
 
 
-def compile_fragment_cached(ops, input_relation, input_dicts, registry):
+def compile_fragment_cached(ops, input_relation, input_dicts, registry,
+                            allow_dense: bool = True):
     """``compile_fragment`` memoized on plan structure.
 
     A fragment's jitted ``update``/``finalize`` closures hold the XLA
@@ -119,13 +123,18 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry):
             ),
             id(registry),
             get_flag("groupby_impl"),
+            get_flag("dense_domain_limit") if allow_dense else -1,
         )
         hash(key)
     except TypeError:
-        return compile_fragment(ops, input_relation, input_dicts, registry)
+        return compile_fragment(
+            ops, input_relation, input_dicts, registry, allow_dense
+        )
     hit = _FRAGMENT_CACHE.get(key)
     if hit is None:
-        frag = compile_fragment(ops, input_relation, input_dicts, registry)
+        frag = compile_fragment(
+            ops, input_relation, input_dicts, registry, allow_dense
+        )
         if len(_FRAGMENT_CACHE) >= _FRAGMENT_CACHE_MAX:
             _FRAGMENT_CACHE.pop(next(iter(_FRAGMENT_CACHE)))
         # The entry pins the id()-keyed objects (dicts, registry): a freed
@@ -200,7 +209,8 @@ def _split_chain(ops):
     return pre, agg, post, limit
 
 
-def compile_fragment(ops, input_relation, input_dicts, registry: Registry) -> CompiledFragment:
+def compile_fragment(ops, input_relation, input_dicts, registry: Registry,
+                     allow_dense: bool = True) -> CompiledFragment:
     pre, agg, post, limit = _split_chain(ops)
     apply_pre, rel1, dicts1 = _bind_pre_stage(pre, input_relation, dict(input_dicts), registry)
 
@@ -220,14 +230,80 @@ def compile_fragment(ops, input_relation, input_dicts, registry: Registry) -> Co
             limit=limit, apply_rows=apply_pre,
         )
 
-    return _compile_agg(agg, post, limit, apply_pre, rel1, dicts1, registry)
+    return _compile_agg(
+        agg, post, limit, apply_pre, rel1, dicts1, registry,
+        allow_dense=allow_dense,
+    )
 
 
-def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
+def unpack_dense_slots(iota, doms, col_types, xp):
+    """Dense slot indices -> per-group-col key planes.
+
+    The single source of the unpack arithmetic, shared by the traced
+    finalize (xp=jnp) and the bridge-payload expansion (xp=np) so the
+    packing order / NULL encoding can never diverge between them.
+    """
+    import numpy as np
+
+    planes = []
+    stride = 1
+    for d in doms:
+        stride *= d
+    for dt, dom in zip(col_types, doms):
+        stride //= dom
+        code = (iota // stride) % dom
+        if dt == DataType.BOOLEAN:
+            planes.append(code.astype(np.bool_))
+        else:  # STRING: last sub-slot decodes back to NULL_ID (-1)
+            planes.append(
+                xp.where(code == dom - 1, -1, code).astype(np.int32)
+            )
+    return planes
+
+
+def _static_key_domains(rel1, dicts1, group_cols):
+    """Per-column static key-domain sizes, or None when any column's
+    domain is not statically known.
+
+    Dictionary-encoded STRING columns have exactly ``len(dict) + 1``
+    possible device codes (ids 0..len-1 plus NULL_ID), BOOLEANs two.
+    Integer/float/time keys have no static bound -> None.
+    """
+    doms = []
+    for c in group_cols:
+        dt = rel1.col_type(c)
+        if dt == DataType.STRING and dicts1.get(c) is not None:
+            doms.append(len(dicts1[c]) + 1)  # last slot = NULL_ID
+        elif dt == DataType.BOOLEAN:
+            doms.append(2)
+        else:
+            return None
+    return doms
+
+
+def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
+                 allow_dense=True):
     g = agg.max_groups
     for c in agg.group_cols:
         if not rel1.has_column(c):
             raise BindError(f"group column {c!r} not in {rel1}")
+
+    # Static dense key domain: when every group column's device code has a
+    # statically-known small domain, the PACKED CODE is the group id —
+    # no per-window sort or hash, and state merges are slot-aligned
+    # (regroup-free), the shape XLA/TPU executes best. Carnot has no
+    # analog (its RowTuple hash map is domain-oblivious,
+    # ``src/carnot/exec/agg_node.h:66``); this is the TPU-first design.
+    dense_domains = None
+    if allow_dense and agg.group_cols:
+        doms = _static_key_domains(rel1, dicts1, list(agg.group_cols))
+        if doms is not None:
+            total = 1
+            for d in doms:
+                total *= d
+            if total <= get_flag("dense_domain_limit"):
+                dense_domains = tuple(doms)
+                g = total
 
     # Bind aggregate input expressions and resolve UDAs.
     aggs_bound = []  # (AggExpr, UDADef, [BoundExpr], [cast pairs])
@@ -244,14 +320,17 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
             key_plane_index.append((c, i))
 
     def init_state():
-        keys = tuple(
-            jnp.full(
-                g,
-                pad_values(rel1.col_type(c))[i],
-                dtype=device_dtypes(rel1.col_type(c))[i],
+        if dense_domains is not None:
+            keys = ()  # implicit: slot index IS the packed key
+        else:
+            keys = tuple(
+                jnp.full(
+                    g,
+                    pad_values(rel1.col_type(c))[i],
+                    dtype=device_dtypes(rel1.col_type(c))[i],
+                )
+                for c, i in key_plane_index
             )
-            for c, i in key_plane_index
-        )
         carries = {ae.out_name: uda.init(g) for ae, uda, _, _ in aggs_bound}
         return {
             "keys": keys,
@@ -260,14 +339,36 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
             "overflow": jnp.zeros((), dtype=jnp.bool_),
         }
 
+    def dense_slot_ids(cols, valid):
+        """Packed key code per row: slot = sum(code_i * stride_i), with
+        NULL_ID (-1) codes landing in each column's last sub-slot and
+        masked rows in the trash slot g."""
+        slot = None
+        for (c, _i), dom in zip(key_plane_index, dense_domains):
+            p = cols[c][0]
+            code = jnp.clip(
+                jnp.where(p < 0, dom - 1, p).astype(jnp.int32), 0, dom - 1
+            )
+            slot = code if slot is None else slot * jnp.int32(dom) + code
+        return jnp.where(valid, slot, g).astype(jnp.int32)
+
+    def dense_key_planes():
+        """Reconstruct the [g] key planes from the slot index (traced)."""
+        return unpack_dense_slots(
+            jnp.arange(g, dtype=jnp.int32),
+            dense_domains,
+            [rel1.col_type(c) for c, _i in key_plane_index],
+            jnp,
+        )
+
     # NOTE: merge_states materializes neutral carries by calling uda.init(g)
     # DURING tracing (never precompute them eagerly here): a concrete jax
     # Array captured as a jit-closure constant permanently degrades every
     # subsequent dispatch on the axon TPU tunnel to ~65ms/call.
 
-    # Per-window group ids: bounded-probe hash table (O(rounds*n)) by
-    # default; 'sort' falls back to the multi-key stable sort. The small
-    # [2G] regroup merges below always use the sort path.
+    # Per-window group ids for NON-dense keys: multi-key stable sort by
+    # default (data-independent runtime); 'hash' selects the bounded-probe
+    # device table. The small [2G] regroup merges below always sort.
     window_group_ids = (
         dense_group_ids_hash
         if get_flag("groupby_impl") == "hash"
@@ -277,8 +378,16 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
     def window_state(cols, valid):
         """Fold one window of rows into a fresh [G]-slot group state."""
         cols, valid = apply_pre(cols, valid)
-        key_planes = [cols[c][i] for c, i in key_plane_index]
-        gids, keys_w, valid_w, n_w = window_group_ids(key_planes, valid, g)
+        if dense_domains is not None:
+            gids = dense_slot_ids(cols, valid)
+            keys_w = ()
+            valid_w = (
+                jnp.zeros(g + 1, dtype=jnp.bool_).at[gids].set(True)[:g]
+            )
+            n_w = jnp.int32(0)  # dense slots cannot overflow
+        else:
+            key_planes = [cols[c][i] for c, i in key_plane_index]
+            gids, keys_w, valid_w, n_w = window_group_ids(key_planes, valid, g)
 
         carries_w = {}
         for ae, uda, arg_bound, casts in aggs_bound:
@@ -302,7 +411,21 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
         distributed finalize: per-device partial states gathered over the
         mesh merge through it, replacing Carnot's UDA Serialize -> GRPC ->
         finalize-agg pipeline (``planner/distributed/splitter/partial_op_mgr``).
+        Dense-domain states merge slot-for-slot — no regroup sort at all.
         """
+        if dense_domains is not None:
+            carries = {
+                ae.out_name: uda.merge(
+                    sa["carries"][ae.out_name], sb["carries"][ae.out_name]
+                )
+                for ae, uda, _, _ in aggs_bound
+            }
+            return {
+                "keys": (),
+                "valid": sa["valid"] | sb["valid"],
+                "carries": carries,
+                "overflow": sa["overflow"] | sb["overflow"],
+            }
         ids_a, ids_b, m_keys, m_valid, n_tot = regroup_pair(
             sa["keys"], sa["valid"], sb["keys"], sb["valid"], g
         )
@@ -379,11 +502,17 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
     @jax.jit
     def finalize(state):
         cols = {}
-        for c, _ in zip(group_cols, range(len(group_cols))):
-            planes = tuple(
-                kp for kp, (kc, _i) in zip(state["keys"], key_plane_index) if kc == c
-            )
-            cols[c] = planes
+        if dense_domains is not None:
+            for c, plane in zip(group_cols, dense_key_planes()):
+                cols[c] = (plane,)
+        else:
+            for c, _ in zip(group_cols, range(len(group_cols))):
+                planes = tuple(
+                    kp
+                    for kp, (kc, _i) in zip(state["keys"], key_plane_index)
+                    if kc == c
+                )
+                cols[c] = planes
         for ae, uda, _, _ in aggs_bound:
             out = uda.finalize(state["carries"][ae.out_name])
             cols[ae.out_name] = (out,)
@@ -419,6 +548,7 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry):
         key_plane_index=tuple(key_plane_index),
         group_relation=rel1,
         string_carry_sources=tuple(string_carry_sources),
+        dense_domains=dense_domains or (),
     )
 
 

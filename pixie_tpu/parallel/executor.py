@@ -107,7 +107,8 @@ class DistributedEngine(Engine):
         self.distributed_state = distributed_state
         self.last_distributed_plan = None
 
-    def execute_plan(self, plan, bridge_inputs=None, analyze=False):
+    def execute_plan(self, plan, bridge_inputs=None, analyze=False,
+                     materialize=True):
         """Replan against the live agent set before executing (the
         reference pulls DistributedState fresh per query —
         ``query_executor.go:415``).
@@ -118,7 +119,10 @@ class DistributedEngine(Engine):
         plan), and bridges are stitched against that executing mesh.
         """
         if self.distributed_state is None:
-            return super().execute_plan(plan, bridge_inputs=bridge_inputs, analyze=analyze)
+            return super().execute_plan(
+                plan, bridge_inputs=bridge_inputs, analyze=analyze,
+                materialize=materialize,
+            )
 
         from ..exec.engine import QueryError
         from ..planner.distributed import DistributedPlanner
@@ -146,7 +150,10 @@ class DistributedEngine(Engine):
         saved = (self.mesh, self.n_devices)
         self.mesh, self.n_devices = mesh, int(np.prod(mesh.devices.shape))
         try:
-            return super().execute_plan(plan, bridge_inputs=bridge_inputs, analyze=analyze)
+            return super().execute_plan(
+                plan, bridge_inputs=bridge_inputs, analyze=analyze,
+                materialize=materialize,
+            )
         finally:
             self.mesh, self.n_devices = saved
 

@@ -22,7 +22,7 @@ import struct
 
 import numpy as np
 
-WIRE_VERSION = 1
+WIRE_VERSION = 2  # v2: AggStatePayload.dense_domains
 
 _U32 = struct.Struct("<I")
 _I64 = struct.Struct("<q")

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..udf import BOOLEAN, FLOAT64, INT64, STRING, TIME64NS
 
@@ -95,11 +96,58 @@ def register(reg):
     # Float carries are f64 even though column planes are f32: [G]-sized,
     # sort-free accumulators keep billions-row sums exact without tripping
     # the f64-sort compile blowup (see types/dtypes.py).
+    # 64-bit INTEGER segment reductions avoid XLA scatter: a 64-bit
+    # scatter-add on a 2M-row window costs ~125ms real on the TPU (vs
+    # ~15ms for i32) — the sort-based form (argsort group ids once,
+    # cumsum, boundary gathers) is ~2x cheaper per agg, and the shared
+    # argsort/searchsorted CSE away across the aggs of one fused window
+    # program. 32-bit-and-smaller dtypes keep the plain scatter (cheaper
+    # than a sort), and so do floats (prefix-difference sums cancel).
+
+    def _seg_order(gids, mask, g):
+        """(order, sorted_gids, ends): rows sorted by group id, invalid
+        rows last (slot g); ends[k] = one past segment k's last row.
+        Pure function of (gids, mask) — duplicated calls CSE under jit."""
+        gi = jnp.where(mask, gids, g).astype(jnp.int32)
+        order = jnp.argsort(gi).astype(jnp.int32)
+        sg = gi[order]
+        ends = jnp.searchsorted(
+            sg, jnp.arange(g, dtype=jnp.int32), side="right"
+        ).astype(jnp.int32)
+        return order, sg, ends
+
     def _seg_sum(carry, gids, mask, v):
         g = carry.shape[0]
         v = v.astype(carry.dtype)
-        contrib = jnp.where(mask, v, jnp.zeros((), v.dtype))
-        return carry + jax.ops.segment_sum(contrib, jnp.where(mask, gids, g), num_segments=g + 1)[:-1]
+        # Floats keep the scatter: the cumsum-diff trick subtracts window-
+        # wide prefixes, which catastrophically cancels when a huge-sum
+        # group precedes a tiny one. Int64 is safe (wraparound differences
+        # are exact).
+        if (
+            np.dtype(carry.dtype).itemsize <= 4
+            or not jnp.issubdtype(carry.dtype, jnp.integer)
+        ):
+            contrib = jnp.where(mask, v, jnp.zeros((), v.dtype))
+            return carry + jax.ops.segment_sum(
+                contrib, jnp.where(mask, gids, g), num_segments=g + 1
+            )[:-1]
+        order, _sg, ends = _seg_order(gids, mask, g)
+        contrib = jnp.where(mask, v, jnp.zeros((), v.dtype))[order]
+        cs0 = jnp.concatenate(
+            [jnp.zeros(1, contrib.dtype), jnp.cumsum(contrib)]
+        )
+        tot = cs0[ends]  # cumulative sum up to each segment's end
+        return carry + tot - jnp.concatenate(
+            [jnp.zeros(1, tot.dtype), tot[:-1]]
+        )
+
+    def _seg_count(carry, gids, mask):
+        """Row count per group: boundary diffs on the shared sorted ids —
+        no value gather, no cumsum, no scatter."""
+        g = carry.shape[0]
+        _order, _sg, ends = _seg_order(gids, mask, g)
+        cnt = ends - jnp.concatenate([jnp.zeros(1, ends.dtype), ends[:-1]])
+        return carry + cnt.astype(carry.dtype)
 
     for dt, zdtype in ((INT64, jnp.int64), (FLOAT64, jnp.float64)):
         reg.uda(
@@ -127,7 +175,7 @@ def register(reg):
         (FLOAT64,),
         INT64,
         init=lambda g: jnp.zeros(g, dtype=jnp.int64),
-        update=lambda c, gids, mask, v: _seg_sum(c, gids, mask, jnp.ones_like(v, dtype=jnp.int64)),
+        update=lambda c, gids, mask, v: _seg_count(c, gids, mask),
         merge=lambda a, b: a + b,
         finalize=lambda c: c,
         doc="Number of rows in the group.",
@@ -140,21 +188,47 @@ def register(reg):
         init=lambda g: (jnp.zeros(g, dtype=jnp.float64), jnp.zeros(g, dtype=jnp.float64)),
         update=lambda c, gids, mask, v: (
             _seg_sum(c[0], gids, mask, v),
-            _seg_sum(c[1], gids, mask, jnp.ones_like(v)),
+            _seg_count(c[1], gids, mask),
         ),
         merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
         finalize=lambda c: jnp.where(c[1] > 0, c[0] / jnp.maximum(c[1], 1.0), jnp.nan),
         doc="Arithmetic mean of the group (sum/count carry; merges exactly).",
     )
 
+    def _seg_extreme64(carry, gids, mask, v, neutral, is_max):
+        """64-bit int min/max without a 64-bit scatter: two-key sort
+        (group id primary, value secondary) makes each segment's extreme
+        its first/last element; the group-id sort CSEs with the other
+        aggs' _seg_order."""
+        g = carry.shape[0]
+        n = v.shape[0]
+        gi = jnp.where(mask, gids, g).astype(jnp.int32)
+        ov = jnp.argsort(v, stable=True).astype(jnp.int32)
+        order = ov[jnp.argsort(gi[ov], stable=True).astype(jnp.int32)]
+        sv = v[order]
+        ends = jnp.searchsorted(
+            gi[order], jnp.arange(g, dtype=jnp.int32), side="right"
+        ).astype(jnp.int32)
+        starts = jnp.concatenate([jnp.zeros(1, ends.dtype), ends[:-1]])
+        if is_max:
+            val = sv[jnp.clip(ends - 1, 0, max(n - 1, 0))]
+        else:
+            val = sv[jnp.clip(starts, 0, max(n - 1, 0))]
+        upd = jnp.where(ends > starts, val, jnp.full((), neutral, v.dtype))
+        return jnp.maximum(carry, upd) if is_max else jnp.minimum(carry, upd)
+
     def _seg_min(carry, gids, mask, v, neutral):
         g = carry.shape[0]
+        if np.dtype(v.dtype).itemsize > 4 and jnp.issubdtype(v.dtype, jnp.integer):
+            return _seg_extreme64(carry, gids, mask, v, neutral, is_max=False)
         contrib = jnp.where(mask, v, jnp.full((), neutral, v.dtype))
         upd = jax.ops.segment_min(contrib, jnp.where(mask, gids, g), num_segments=g + 1)[:-1]
         return jnp.minimum(carry, upd)
 
     def _seg_max(carry, gids, mask, v, neutral):
         g = carry.shape[0]
+        if np.dtype(v.dtype).itemsize > 4 and jnp.issubdtype(v.dtype, jnp.integer):
+            return _seg_extreme64(carry, gids, mask, v, neutral, is_max=True)
         contrib = jnp.where(mask, v, jnp.full((), neutral, v.dtype))
         upd = jax.ops.segment_max(contrib, jnp.where(mask, gids, g), num_segments=g + 1)[:-1]
         return jnp.maximum(carry, upd)

@@ -138,8 +138,10 @@ class TestWireCodec:
         assert not bool(got.state["overflow"])
 
     def test_version_and_errors(self):
+        from pixie_tpu.services.wire import WIRE_VERSION
+
         buf = encode({"x": 1})
-        assert buf[0] == 1
+        assert buf[0] == WIRE_VERSION
         with pytest.raises(WireError, match="version"):
             decode(b"\x63" + buf[1:])
         with pytest.raises(WireError):
