@@ -44,14 +44,18 @@ from pixie_tpu.utils.cache import jax_cache_dir  # noqa: E402
 
 CACHE_DIR = os.environ.get("JAX_COMPILATION_CACHE_DIR", jax_cache_dir())
 
-ALL_SHAPES = (
-    "http_stats",
-    "service_stats",
-    "net_flow_graph",
-    "sql_stats",
-    "perf_flamegraph",
-    "device_join",
-)
+# Shape registry: name -> (shape fn attr, rows divisor vs headline n).
+# Single source for the launcher's shape list, inner's dispatch, and the
+# per-shape row scaling (join/regex shapes are heavier per row).
+SHAPE_DEFS = {
+    "http_stats": ("_shape_http_stats", 1),
+    "service_stats": ("_shape_service_stats", 1),
+    "net_flow_graph": ("_shape_net_flow_graph", 2),
+    "sql_stats": ("_shape_sql_stats", 4),
+    "perf_flamegraph": ("_shape_perf_flamegraph", 4),
+    "device_join": ("_shape_device_join", 4),
+}
+ALL_SHAPES = tuple(SHAPE_DEFS)
 
 
 def log(*a):
@@ -130,6 +134,7 @@ def launcher() -> int:
         if s.strip()
     ]
     rows_env = os.environ.get("PIXIE_TPU_BENCH_ROWS")
+    head_shape = next((s for s in want if s in ALL_SHAPES), "http_stats")
     shapes: dict = {}
     device = None
 
@@ -143,9 +148,9 @@ def launcher() -> int:
         if left() < 60:
             shapes[shape] = {"skipped": "deadline"}
             continue
-        # The headline gets the lion's share and a retry (the tunnel can be
-        # transiently UNAVAILABLE); tails split what remains.
-        is_head = shape == "http_stats"
+        # The headline (first requested shape) gets the lion's share and
+        # a retry (the tunnel can be transiently UNAVAILABLE).
+        is_head = shape == head_shape
         cap = 240.0 if is_head else 150.0
         timeout = min(cap, left() - (30 if is_head else 10))
         rows = int(rows_env) if rows_env else None
@@ -167,18 +172,19 @@ def launcher() -> int:
         shapes[shape] = res["result"]
         device = device or res.get("platform")
 
-    head = shapes.get("http_stats") or {}
+    head = shapes.get(head_shape) or {}
+    metric = f"{head_shape}_rows_per_sec"
     if "rows_per_sec" not in head:
         log("[bench] headline shape failed")
         # Still print a parseable line so the round records the failure.
         print(json.dumps({
-            "metric": "http_stats_rows_per_sec", "value": 0,
+            "metric": metric, "value": 0,
             "unit": "rows/s", "vs_baseline": 0.0,
             "device": device or "none", "shapes": shapes,
         }), flush=True)
         return 1
     print(json.dumps({
-        "metric": "http_stats_rows_per_sec",
+        "metric": metric,
         "value": head["rows_per_sec"],
         "unit": "rows/s",
         "vs_baseline": head["vs_baseline"],
@@ -637,30 +643,9 @@ px.display(out)
     }
 
 
-SHAPE_FNS = {
-    "http_stats": _shape_http_stats,
-    "service_stats": _shape_service_stats,
-    "net_flow_graph": _shape_net_flow_graph,
-    "sql_stats": _shape_sql_stats,
-    "perf_flamegraph": _shape_perf_flamegraph,
-    "device_join": _shape_device_join,
-}
-
-# Default row counts relative to the headline n (join/regex shapes are
-# heavier per row).
-SHAPE_ROWS_DIV = {
-    "http_stats": 1,
-    "service_stats": 1,
-    "net_flow_graph": 2,
-    "sql_stats": 4,
-    "perf_flamegraph": 4,
-    "device_join": 4,
-}
-
-
 def inner() -> int:
     shape = os.environ.get("PIXIE_TPU_BENCH_SHAPES", "http_stats").strip()
-    if shape not in SHAPE_FNS:
+    if shape not in SHAPE_DEFS:
         log(f"[bench] unknown shape {shape!r}")
         return 1
 
@@ -670,7 +655,8 @@ def inner() -> int:
     log(f"[bench] devices: {jax.devices()}")
     default_rows = 16 * 1024 * 1024 if platform == "tpu" else 2 * 1024 * 1024
     n = int(os.environ.get("PIXIE_TPU_BENCH_ROWS", default_rows))
-    n //= SHAPE_ROWS_DIV[shape]
+    fn_name, rows_div = SHAPE_DEFS[shape]
+    n //= rows_div
     window = int(os.environ.get("PIXIE_TPU_BENCH_WINDOW", 1 << 21))
     # Device residency stages table windows at append time; the staging
     # window size must match the engines' query window size.
@@ -678,7 +664,7 @@ def inner() -> int:
 
     log(f"[bench] {shape} @ {n:,} rows ...")
     try:
-        res = SHAPE_FNS[shape](n, window)
+        res = globals()[fn_name](n, window)
         log(f"[bench] {shape}: {res}")
     except Exception as e:  # a broken shape must not zero the headline
         log(f"[bench] {shape} FAILED: {e!r}")

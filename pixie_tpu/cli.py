@@ -1,0 +1,220 @@
+"""px-style command-line client.
+
+Reference parity: ``/root/reference/src/pixie_cli`` (the ``px`` binary:
+``px run <script>``, ``px script list``, ``px get viziers`` ...). The
+transport is the framed-TCP netbus to a broker running ``serve()``
+(VizierService.ExecuteScript analog); ``--local`` runs scripts against
+an in-process engine instead (useful for replays and development).
+
+Usage:
+  python -m pixie_tpu.cli run px/http_stats [--broker HOST:PORT]
+  python -m pixie_tpu.cli run my_query.pxl --local --replay events.npz
+  python -m pixie_tpu.cli script list | script show px/http_stats
+  python -m pixie_tpu.cli explain px/http_stats
+  python -m pixie_tpu.cli tables|agents --broker HOST:PORT
+  python -m pixie_tpu.cli docs
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load_query(name_or_path: str) -> str:
+    from .scripts import list_scripts, load_script
+
+    if name_or_path in list_scripts():
+        return load_script(name_or_path).pxl
+    if os.path.exists(name_or_path):
+        with open(name_or_path) as f:
+            return f.read()
+    raise SystemExit(
+        f"no script named {name_or_path!r} (library: "
+        f"{', '.join(list_scripts())}) and no such file"
+    )
+
+
+def _print_batch(name: str, hb, fmt: str) -> None:
+    d = hb.to_pydict()
+    cols = list(d)
+    if fmt == "json":
+        rows = [
+            {c: _py(d[c][i]) for c in cols} for i in range(hb.length)
+        ]
+        print(json.dumps({"table": name, "rows": rows}))
+        return
+    widths = {
+        c: max(len(c), *(len(str(v)) for v in d[c][:200]), 1) if hb.length else len(c)
+        for c in cols
+    }
+    print(f"== {name} ({hb.length} rows) ==")
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    for i in range(min(hb.length, 200)):
+        print("  ".join(str(_py(d[c][i])).ljust(widths[c]) for c in cols))
+    if hb.length > 200:
+        print(f"... {hb.length - 200} more rows")
+
+
+def _py(v):
+    return v.item() if hasattr(v, "item") else v
+
+
+def _broker_request(addr: str, topic: str, msg: dict, timeout_s: float):
+    from .services.netbus import RemoteBus
+
+    host, _, port = addr.rpartition(":")
+    bus = RemoteBus(host or "127.0.0.1", int(port))
+    try:
+        return bus.request(topic, msg, timeout_s=timeout_s)
+    finally:
+        bus.close()
+
+
+def cmd_run(args) -> int:
+    query = _load_query(args.script)
+    if args.broker:
+        res = _broker_request(
+            args.broker, "broker.execute",
+            {"query": query, "timeout_s": args.timeout,
+             "max_output_rows": args.max_rows},
+            timeout_s=args.timeout + 5,
+        )
+        if not res.get("ok"):
+            print(f"error: {res.get('error')}", file=sys.stderr)
+            return 1
+        for name, hb in sorted(res["tables"].items()):
+            _print_batch(name, hb, args.output)
+        if args.output != "json":
+            stats = res.get("agent_stats", {})
+            if stats:
+                worst = max(s["exec_time_s"] for s in stats.values())
+                print(f"[{len(stats)} agents, slowest {worst * 1e3:.1f}ms]")
+        return 0
+    # Local mode: one in-process engine over replays.
+    from .exec.engine import Engine
+    from .ingest.schemas import init_schemas
+
+    eng = Engine()
+    init_schemas(eng)
+    if args.synthetic:
+        from .ingest.replay import replay_into
+
+        replay_into(eng, args.synthetic)
+    for path in args.replay or []:
+        from .ingest.replay import load_npz
+
+        for records in load_npz(path):
+            eng.append_data("http_events", records)
+    out = eng.execute_query(query, max_output_rows=args.max_rows)
+    for name, hb in sorted(out.items()):
+        _print_batch(name, hb, args.output)
+    return 0
+
+
+def cmd_script(args) -> int:
+    from .scripts import list_scripts, load_script
+
+    if args.action == "list":
+        for n in list_scripts():
+            s = load_script(n)
+            print(f"{n:28s} {s.manifest.get('short', '')}")
+        return 0
+    s = load_script(args.name)
+    print(s.pxl)
+    return 0
+
+
+def cmd_explain(args) -> int:
+    from .planner.debug import explain_pxl
+    from .types.dtypes import DataType
+    from .types.relation import Relation
+
+    query = _load_query(args.script)
+    if args.broker:
+        res = _broker_request(args.broker, "broker.schemas", {}, 10.0)
+        schemas = res.get("schemas", {})
+    else:
+        # Offline explain: synthesize schemas for the canonical tables the
+        # script references (shipped output-table relations).
+        from .ingest.schemas import CANONICAL_SCHEMAS
+
+        schemas = dict(CANONICAL_SCHEMAS)
+        schemas.setdefault(
+            "t", Relation([("time_", DataType.TIME64NS)])
+        )
+    print(explain_pxl(query, schemas))
+    return 0
+
+
+def cmd_tables(args) -> int:
+    res = _broker_request(args.broker, "broker.schemas", {}, 10.0)
+    for name, rel in sorted(res.get("schemas", {}).items()):
+        print(f"{name}: {rel}")
+    return 0
+
+
+def cmd_agents(args) -> int:
+    res = _broker_request(args.broker, "broker.agents", {}, 10.0)
+    for a in res.get("agents", []):
+        print(
+            f"{a['agent_id']:14s} asid={a['asid']:<4d} {a['kind']:6s} "
+            f"hb={a['last_heartbeat_s']:.1f}s tables={a['num_tables']}"
+        )
+    return 0
+
+
+def cmd_docs(args) -> int:
+    from .udf.docgen import generate_markdown
+
+    print(generate_markdown())
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="px", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="execute a PxL script")
+    run.add_argument("script", help="library script name or .pxl path")
+    run.add_argument("--broker", help="broker netbus HOST:PORT")
+    run.add_argument("--local", action="store_true", help="in-process engine")
+    run.add_argument("--replay", action="append",
+                     help="saved http_events replay .npz (local)")
+    run.add_argument("--synthetic", type=int, metavar="N",
+                     help="generate an N-row synthetic replay (local)")
+    run.add_argument("--timeout", type=float, default=30.0)
+    run.add_argument("--max-rows", type=int, default=10_000)
+    run.add_argument("-o", "--output", choices=("table", "json"),
+                     default="table")
+    run.set_defaults(fn=cmd_run)
+
+    sc = sub.add_parser("script", help="script library")
+    sc.add_argument("action", choices=("list", "show"))
+    sc.add_argument("name", nargs="?")
+    sc.set_defaults(fn=cmd_script)
+
+    ex = sub.add_parser("explain", help="render a script's physical plan")
+    ex.add_argument("script")
+    ex.add_argument("--broker", help="use live schemas from this broker")
+    ex.set_defaults(fn=cmd_explain)
+
+    tb = sub.add_parser("tables", help="list cluster table schemas")
+    tb.add_argument("--broker", required=True)
+    tb.set_defaults(fn=cmd_tables)
+
+    ag = sub.add_parser("agents", help="list live agents")
+    ag.add_argument("--broker", required=True)
+    ag.set_defaults(fn=cmd_agents)
+
+    dc = sub.add_parser("docs", help="dump the function reference (markdown)")
+    dc.set_defaults(fn=cmd_docs)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,12 @@ class QueryError(Exception):
     pass
 
 
+class QueryCancelled(QueryError):
+    """Raised mid-stream when a query's cancel event fires (the
+    ExecState::keep_running / exec_graph abort path,
+    ``src/carnot/exec/exec_state.h``)."""
+
+
 @dataclass
 class _Stream:
     relation: Relation
@@ -171,9 +177,13 @@ class DeviceResult:
             return self._host
         eng, stream, frag = self._engine, self._stream, self._frag
         cols, valid, overflow = self._cols, self._valid, self._overflow
-        qstats = getattr(eng, "_query_stats", None)
         stats = self._stats
         while bool(overflow):
+            # NOTE: the rebucket re-folds the source table AS IT IS NOW —
+            # rows appended between execute and to_host are included,
+            # unlike the no-overflow snapshot. Callers needing snapshot
+            # semantics materialize before further ingest (the service
+            # shell serializes queries against appends anyway).
             # Rebucket: double max_groups and re-run the stream (the same
             # recovery the device join uses on output overflow; Carnot's
             # hash map grows instead, ``agg_node.cc``).
@@ -181,11 +191,6 @@ class DeviceResult:
             frag = compile_fragment(
                 stream.chain, stream.relation, stream.dicts, eng.registry
             )
-            if qstats is not None:
-                # Fresh per-attempt stats: totals stay true wall time,
-                # per-fragment rows/windows stay per-attempt.
-                stats = qstats.new_fragment(stream.chain)
-                stats.ops = stats.ops + ("rebucket",)
             state = eng._fold_agg_state(stream, frag, stats)
             with _timed(stats, "finalize"):
                 cols, valid, overflow = frag.finalize(state)
@@ -216,6 +221,7 @@ class Engine:
         self.window_rows = window_rows or get_flag("window_rows")
         self.last_stats = None
         self._query_stats = None
+        self._cancel = None  # per-query cancel event (execute_plan arg)
 
     @property
     def tables(self) -> dict:
@@ -285,6 +291,7 @@ class Engine:
     def execute_plan(
         self, plan: Plan, bridge_inputs: dict | None = None,
         analyze: bool = False, materialize: bool = True,
+        cancel=None,
     ) -> dict:
         """Execute a plan. Whole plans return {sink name: HostBatch}.
 
@@ -296,6 +303,7 @@ class Engine:
         ``analyze`` records per-fragment, per-stage execution stats
         (exec_node.h:40 ExecNodeStats analog) on ``self.last_stats``.
         """
+        self._cancel = cancel
         if analyze:
             from .analyze import QueryStats
 
@@ -307,8 +315,12 @@ class Engine:
                 self._query_stats.total_seconds = time.perf_counter() - t_start
                 self.last_stats = self._query_stats
                 self._query_stats = None
+                self._cancel = None
             return out
-        return self._execute_plan_inner(plan, bridge_inputs, materialize)
+        try:
+            return self._execute_plan_inner(plan, bridge_inputs, materialize)
+        finally:
+            self._cancel = None
 
     def _execute_plan_inner(
         self, plan: Plan, bridge_inputs: dict | None = None,
@@ -644,7 +656,8 @@ class Engine:
                 for op in chain
             ]
             frag = compile_fragment(
-                chain, p0.input_relation, dict(p0.input_dicts), self.registry
+                chain, p0.input_relation, dict(p0.input_dicts), self.registry,
+                allow_dense=False,  # states carry explicit key planes
             )
         meta = [
             (
@@ -709,6 +722,11 @@ class Engine:
         db = hb.to_device(capacity)
         return db.cols, db.valid
 
+    def _check_cancel(self) -> None:
+        c = getattr(self, "_cancel", None)
+        if c is not None and c.is_set():
+            raise QueryCancelled("query cancelled")
+
     def _staged_windows(self, stream: "_Stream", stats=None):
         """Yield (cols, valid) device-staged windows for a stream.
 
@@ -742,6 +760,7 @@ class Engine:
                 for win, lo, hi in t.device_scan(
                     start, stop, window_rows=self.window_rows
                 ):
+                    self._check_cancel()
                     with _timed(stats, "stage", rows=hi - lo):
                         valid = mask_fn(
                             np.int32(lo - win.row0), np.int32(hi - win.row0)
@@ -752,6 +771,7 @@ class Engine:
                     yield win.cols, valid
             return
         for hb in self._windows(stream):
+            self._check_cancel()
             with _timed(stats, "stage", rows=hb.length):
                 cols, valid = self._stage(hb, self._window_capacity(hb.length))
                 _block_if(stats, cols)

@@ -1,0 +1,87 @@
+"""Self-sampling perf profiler connector.
+
+Reference parity: the continuous profiler
+(``/root/reference/src/stirling/source_connectors/perf_profiler/
+perf_profiler_connector.h`` — eBPF stack sampling folded into the
+``stack_traces.beta`` table). Without eBPF in scope (SURVEY.md §7 stage
+7), the TPU-side analog samples THIS process's Python threads via
+``sys._current_frames`` at the sampling period and folds identical
+stacks into (stack_trace, count) rows — the same ``;``-joined
+flamegraph-folded encoding the reference emits, queryable by the shipped
+``px/perf_flamegraph`` script.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from ..utils.upid import UPID
+from .core import SourceConnector
+from .schemas import STACK_TRACES_RELATION
+
+
+def _fold_stack(frame, max_depth: int = 64) -> str:
+    """Flamegraph-folded stack string: outermost;...;innermost."""
+    parts: list[str] = []
+    while frame is not None and len(parts) < max_depth:
+        code = frame.f_code
+        parts.append(f"{os.path.basename(code.co_filename)}:{code.co_name}")
+        frame = frame.f_back
+    return ";".join(reversed(parts))
+
+
+class PerfProfilerConnector(SourceConnector):
+    """Sample all Python threads; publish folded stacks with counts."""
+
+    name = "perf_profiler"
+    tables = [("stack_traces.beta", STACK_TRACES_RELATION)]
+    default_sampling_period_s = 0.01  # 100Hz, the reference's default rate
+    default_push_period_s = 1.0
+
+    def __init__(self, pod: str = "default/self", asid: int = 0, **kw):
+        super().__init__(**kw)
+        self.pod = pod
+        self.upid = UPID(asid=asid, pid=os.getpid(), start_ts=0)
+        self._counts: dict[str, int] = {}
+        self._ids: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def sample(self) -> None:
+        """One sampling tick: fold every live thread's current stack."""
+        me = threading.get_ident()
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # the collector thread observing itself is noise
+            folded = _fold_stack(frame)
+            if not folded:
+                continue
+            with self._lock:
+                self._counts[folded] = self._counts.get(folded, 0) + 1
+                self._ids.setdefault(folded, len(self._ids))
+
+    def transfer_data(self, ctx, data_tables) -> None:
+        # The collector calls transfer_data on the sampling cadence; fold
+        # a sample each call and drain the accumulated counts every call —
+        # the DataTable buffers until the push period fires (the BPF map
+        # drain analog).
+        self.sample()
+        with self._lock:
+            if not self._counts:
+                return
+            stacks = list(self._counts)
+            counts = [self._counts[s] for s in stacks]
+            ids = [self._ids[s] for s in stacks]
+            self._counts.clear()
+        now = time.time_ns()
+        n = len(stacks)
+        data_tables["stack_traces.beta"].append({
+            "time_": [now] * n,
+            "upid": [self.upid.value()] * n,
+            "stack_trace_id": ids,
+            "stack_trace": stacks,
+            "count": counts,
+            "pod": [self.pod] * n,
+        })

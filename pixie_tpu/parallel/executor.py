@@ -108,7 +108,7 @@ class DistributedEngine(Engine):
         self.last_distributed_plan = None
 
     def execute_plan(self, plan, bridge_inputs=None, analyze=False,
-                     materialize=True):
+                     materialize=True, cancel=None):
         """Replan against the live agent set before executing (the
         reference pulls DistributedState fresh per query —
         ``query_executor.go:415``).
@@ -152,7 +152,7 @@ class DistributedEngine(Engine):
         try:
             return super().execute_plan(
                 plan, bridge_inputs=bridge_inputs, analyze=analyze,
-                materialize=materialize,
+                materialize=materialize, cancel=cancel,
             )
         finally:
             self.mesh, self.n_devices = saved

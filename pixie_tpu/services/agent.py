@@ -69,6 +69,9 @@ class Agent:
         # cancelled query must be dropped, not backlogged forever).
         self._cancelled: "dict[str, None]" = {}
         self._max_cancelled = 1024
+        # qid -> threading.Event for fragments currently executing: a
+        # cancel mid-stream aborts between windows (ExecState keep_running).
+        self._running: "dict[str, object]" = {}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Agent":
@@ -205,22 +208,43 @@ class Agent:
             while len(self._cancelled) > self._max_cancelled:
                 self._cancelled.pop(next(iter(self._cancelled)))
             self._pending_merges.pop(msg["qid"], None)
+            ev = self._running.get(msg["qid"])
+        if ev is not None:
+            ev.set()
 
     def _on_execute(self, msg):
         """Run a data fragment; ship bridge payloads to the merge agent."""
         qid, plan = msg["qid"], msg["plan"]
-        if qid in self._cancelled:
-            return
+        import threading as _threading
+
+        ev = _threading.Event()
+        with self._lock:
+            # Atomic with _on_cancel: a cancel that lands between the
+            # check and the registration must either stop us here or find
+            # the event to set.
+            if qid in self._cancelled:
+                return
+            self._running[qid] = ev
         try:
             t0 = time.perf_counter()
-            outputs = self.engine.execute_plan(plan)
+            outputs = self.engine.execute_plan(plan, cancel=ev)
             elapsed = time.perf_counter() - t0
         except Exception as e:
-            self.bus.publish(
-                f"query.{qid}.results",
-                {"error": f"{self.agent_id}: {e}", "trace": traceback.format_exc()},
-            )
+            with self._lock:
+                self._running.pop(qid, None)
+            if qid not in self._cancelled:
+                self.bus.publish(
+                    f"query.{qid}.results",
+                    {
+                        "error": f"{self.agent_id}: {e}",
+                        "trace": traceback.format_exc(),
+                    },
+                )
             return
+        with self._lock:
+            self._running.pop(qid, None)
+            if qid in self._cancelled:
+                return  # cancelled during execution: results are dropped
         merge_agent = msg.get("merge_agent")
         for key, val in outputs.items():
             if isinstance(key, tuple) and key[0] == "bridge":

@@ -1,0 +1,112 @@
+"""Query load tester: concurrency sweep against a broker.
+
+Reference parity: the vizier query load tester
+(``/root/reference/src/vizier/utils/loadtester``) — N concurrent
+clients, M queries each, latency percentiles and error counts. Works
+against an in-process ``QueryBroker`` or a remote broker over the
+netbus (``RemoteBus`` + the ``broker.execute`` topic).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LoadReport:
+    queries: int = 0
+    errors: int = 0
+    latencies_s: list = field(default_factory=list)
+    wall_s: float = 0.0
+
+    def percentile(self, p: float) -> float:
+        if not self.latencies_s:
+            return float("nan")
+        xs = sorted(self.latencies_s)
+        i = min(len(xs) - 1, int(p / 100.0 * len(xs)))
+        return xs[i]
+
+    def to_dict(self) -> dict:
+        return {
+            "queries": self.queries,
+            "errors": self.errors,
+            "qps": (
+                round(self.queries / self.wall_s, 2) if self.wall_s else 0.0
+            ),
+            "p50_ms": round(self.percentile(50) * 1e3, 2),
+            "p95_ms": round(self.percentile(95) * 1e3, 2),
+            "p99_ms": round(self.percentile(99) * 1e3, 2),
+            "wall_s": round(self.wall_s, 2),
+        }
+
+
+def run_load(
+    execute,
+    query: str,
+    workers: int = 4,
+    per_worker: int = 10,
+    timeout_s: float = 30.0,
+) -> LoadReport:
+    """Fire ``workers * per_worker`` queries through ``execute``.
+
+    ``execute(query, timeout_s)`` is any callable that raises on failure —
+    ``broker_executor`` / ``remote_executor`` below adapt the two broker
+    surfaces to it.
+    """
+    report = LoadReport()
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(per_worker):
+            t0 = time.perf_counter()
+            ok = True
+            try:
+                execute(query, timeout_s)
+            except Exception:
+                ok = False
+            dt = time.perf_counter() - t0
+            with lock:
+                report.queries += 1
+                if ok:
+                    report.latencies_s.append(dt)
+                else:
+                    report.errors += 1
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    report.wall_s = time.perf_counter() - t_start
+    return report
+
+
+def broker_executor(broker):
+    """Adapter for an in-process QueryBroker."""
+
+    def execute(query, timeout_s):
+        broker.execute_script(query, timeout_s=timeout_s)
+
+    return execute
+
+
+def remote_executor(host: str, port: int):
+    """Adapter for a served broker over the netbus (one shared conn)."""
+    from .netbus import RemoteBus
+
+    bus = RemoteBus(host, port)
+
+    def execute(query, timeout_s):
+        res = bus.request(
+            "broker.execute",
+            {"query": query, "timeout_s": timeout_s},
+            timeout_s=timeout_s + 5,
+        )
+        if not res.get("ok"):
+            raise RuntimeError(res.get("error", "unknown broker error"))
+
+    execute.close = bus.close  # type: ignore[attr-defined]
+    return execute

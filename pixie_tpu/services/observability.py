@@ -121,11 +121,19 @@ class ObservabilityServer:
             return (200 if ok else 503, "text/plain", msg + "\n")
         if path == "/statusz":
             from ..config import all_flags
+            from ..version import version_info
 
-            status = {"flags": {k: v for k, (v, _) in all_flags().items()}}
+            status = {
+                "version": version_info(),
+                "flags": {k: v for k, (v, _) in all_flags().items()},
+            }
             if self.statusz_fn is not None:
                 status.update(self.statusz_fn())
             return (200, "application/json", json.dumps(status, indent=1))
+        if path == "/version":
+            from ..version import version_info
+
+            return (200, "application/json", json.dumps(version_info()))
         if path == "/metrics":
             return (200, "text/plain; version=0.0.4", self.registry.render())
         return (404, "text/plain", "not found\n")

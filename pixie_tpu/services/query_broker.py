@@ -223,3 +223,60 @@ class QueryBroker:
         if mutation_states is not None:
             result["mutations"] = mutation_states
         return result
+
+    # -- bus API (the VizierService gRPC surface analog) ---------------------
+
+    def serve(self) -> None:
+        """Expose the broker on bus topics so remote clients (CLI/API over
+        the framed-TCP netbus) can execute scripts and introspect the
+        cluster — the api.vizierpb.VizierService analog
+        (``src/api/proto/vizierpb/vizierapi.proto`` ExecuteScript).
+
+        Topics (all request/reply via ``_reply_to``):
+          broker.execute  {query, timeout_s?, max_output_rows?}
+                          -> {ok, qid, tables, agent_stats} | {ok: False, error}
+          broker.schemas  {} -> {ok, schemas: {table: Relation}}
+          broker.agents   {} -> {ok, agents: [agent info dict]}
+          broker.scripts  {} -> {ok, scripts: [name]}
+        """
+
+        def _reply(msg, payload):
+            inbox = msg.get("_reply_to")
+            if inbox:
+                self.bus.publish(inbox, payload)
+
+        def _on_execute(msg):
+            try:
+                res = self.execute_script(
+                    msg["query"],
+                    timeout_s=float(msg.get("timeout_s", 30.0)),
+                    now_ns=int(msg.get("now_ns", 0)),
+                    max_output_rows=int(msg.get("max_output_rows", 10_000)),
+                )
+                _reply(msg, {
+                    "ok": True,
+                    "qid": res.get("qid"),
+                    "tables": res.get("tables", {}),
+                    "agent_stats": res.get("agent_stats", {}),
+                    "mutations": res.get("mutations"),
+                })
+            except Exception as e:  # errors cross the wire as data
+                _reply(msg, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+        def _on_schemas(msg):
+            _reply(msg, {"ok": True, "schemas": self.tracker.schemas()})
+
+        def _on_agents(msg):
+            _reply(msg, {"ok": True, "agents": self.tracker.agents_info()})
+
+        def _on_scripts(msg):
+            from ..scripts import list_scripts
+
+            _reply(msg, {"ok": True, "scripts": list_scripts()})
+
+        self._serve_subs = [
+            self.bus.subscribe("broker.execute", _on_execute),
+            self.bus.subscribe("broker.schemas", _on_schemas),
+            self.bus.subscribe("broker.agents", _on_agents),
+            self.bus.subscribe("broker.scripts", _on_scripts),
+        ]

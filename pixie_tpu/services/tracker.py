@@ -91,12 +91,11 @@ class AgentTracker:
                     asid=rec.info.asid,
                 )
 
-    def _on_agent_status_request(self, msg: dict):
-        """MDS stub service for the GetAgentStatus UDTF
-        (``md_udtfs_impl.h:258`` hits MDS the same way)."""
+    def agents_info(self) -> list:
+        """Live-agent status rows (id, asid, kind, heartbeat age, tables)."""
         now = time.monotonic()
         with self._lock:
-            rows = [
+            return [
                 {
                     "agent_id": aid,
                     "asid": rec.info.asid,
@@ -108,7 +107,11 @@ class AgentTracker:
                 }
                 for aid, rec in sorted(self._agents.items())
             ]
-        self.bus.publish(msg["_reply_to"], {"agents": rows})
+
+    def _on_agent_status_request(self, msg: dict):
+        """MDS stub service for the GetAgentStatus UDTF
+        (``md_udtfs_impl.h:258`` hits MDS the same way)."""
+        self.bus.publish(msg["_reply_to"], {"agents": self.agents_info()})
 
     # -- expiry --------------------------------------------------------------
     def _expiry_loop(self):

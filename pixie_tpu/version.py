@@ -1,0 +1,27 @@
+"""Build/version metadata.
+
+Reference parity: ``/root/reference/src/shared/version`` (version.h
+``VersionInfo``: semver + git commit + build time, surfaced on statusz
+and the artifacts API). Populated from the environment at build/deploy
+time; falls back to the dev defaults.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+VERSION = os.environ.get("PIXIE_TPU_VERSION", "0.3.0-dev")
+GIT_COMMIT = os.environ.get("PIXIE_TPU_GIT_COMMIT", "unknown")
+BUILD_TIME_S = int(os.environ.get("PIXIE_TPU_BUILD_TIME", "0")) or None
+_PROCESS_START_S = time.time()
+
+
+def version_info() -> dict:
+    """The VersionInfo struct: shipped on statusz and the CLI."""
+    return {
+        "version": VERSION,
+        "git_commit": GIT_COMMIT,
+        "build_time_s": BUILD_TIME_S,
+        "uptime_s": round(time.time() - _PROCESS_START_S, 1),
+    }

@@ -1,0 +1,99 @@
+"""Auxiliary subsystems: perf profiler connector, per-query cancel,
+version info endpoint."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec.engine import Engine, QueryCancelled
+from pixie_tpu.ingest.collector import Collector
+from pixie_tpu.ingest.profiler import PerfProfilerConnector, _fold_stack
+
+
+class TestPerfProfiler:
+    def test_samples_live_threads_into_stack_traces(self):
+        eng = Engine()
+        stop = threading.Event()
+
+        def busy_loop_marker():
+            while not stop.is_set():
+                time.sleep(0.001)
+
+        t = threading.Thread(target=busy_loop_marker, daemon=True)
+        t.start()
+        conn = PerfProfilerConnector(
+            pod="ns/pod-x", sampling_period_s=0.0, push_period_s=0.0
+        )
+        coll = Collector()
+        coll.wire_to(eng)
+        coll.register_source(conn)
+        try:
+            for _ in range(20):
+                conn.transfer_data(coll, coll._data_tables)
+                time.sleep(0.002)
+            coll.flush()
+        finally:
+            stop.set()
+            t.join()
+
+        out = eng.execute_query(
+            "import px\n"
+            "df = px.DataFrame(table='stack_traces.beta')\n"
+            "df = df.groupby('stack_trace').agg(n=('count', px.sum))\n"
+            "px.display(df)"
+        )["output"].to_pydict()
+        stacks = list(out["stack_trace"])
+        assert stacks, "no samples collected"
+        assert any("busy_loop_marker" in s for s in stacks)
+        # Folded encoding: outermost;...;innermost file:func frames.
+        assert all(":" in s for s in stacks)
+
+    def test_fold_stack_shape(self):
+        import sys
+
+        frame = sys._getframe()
+        s = _fold_stack(frame)
+        assert s.endswith("test_aux.py:test_fold_stack_shape")
+
+
+class TestQueryCancel:
+    def test_cancel_mid_stream(self):
+        eng = Engine(window_rows=1 << 10)
+        n = 100_000
+        eng.append_data("t", {
+            "time_": np.arange(n, dtype=np.int64),
+            "v": np.arange(n, dtype=np.int64) % 97,
+        })
+        from pixie_tpu.planner import CompilerState, compile_pxl
+
+        q = (
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df = df.groupby('v').agg(n=('v', px.count))\npx.display(df)"
+        )
+        state = CompilerState(
+            schemas={nm: t.relation for nm, t in eng.tables.items()},
+            registry=eng.registry,
+        )
+        plan = compile_pxl(q, state).plan
+        ev = threading.Event()
+        ev.set()  # cancelled before the first window
+        with pytest.raises(QueryCancelled):
+            eng.execute_plan(plan, cancel=ev)
+        # Un-cancelled run still works on the same engine.
+        out = eng.execute_plan(plan)
+        assert out["output"].length == 97
+
+
+class TestVersion:
+    def test_statusz_and_version_endpoints(self):
+        from pixie_tpu.services.observability import ObservabilityServer
+
+        srv = ObservabilityServer()
+        code, ctype, body = srv.handle("/version")
+        assert code == 200 and "version" in body
+        code, _ct, body = srv.handle("/statusz")
+        assert code == 200 and "git_commit" in body

@@ -1,0 +1,193 @@
+"""CLI client, broker bus API, plan debugger, docgen, load tester.
+
+Reference parity targets: ``src/pixie_cli`` (px run/script/get),
+``src/api/proto/vizierpb`` ExecuteScript service surface,
+``src/vizier/utils/loadtester``, and the planner debug dump.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+from pixie_tpu.cli import main as cli_main
+from pixie_tpu.services.agent import KelvinAgent, PEMAgent
+from pixie_tpu.services.load_tester import broker_executor, run_load
+from pixie_tpu.services.msgbus import MessageBus
+from pixie_tpu.services.query_broker import QueryBroker
+from pixie_tpu.services.tracker import AgentTracker
+
+FAST = dict(heartbeat_interval_s=0.05)
+
+QUERY = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.groupby('service').agg(n=('latency_ns', px.count))
+px.display(df)
+"""
+
+
+@pytest.fixture()
+def served_cluster():
+    bus = MessageBus()
+    tracker = AgentTracker(bus, expiry_s=60.0, check_interval_s=60.0)
+    pems = [PEMAgent(bus, f"pem-{i}", **FAST).start() for i in range(2)]
+    kelvin = KelvinAgent(bus, "kelvin-0", **FAST).start()
+    rng = np.random.default_rng(0)
+    for i, pem in enumerate(pems):
+        n = 1500
+        pem.append_data(
+            "http_events",
+            {
+                "time_": np.arange(n, dtype=np.int64),
+                "latency_ns": rng.integers(1000, 1_000_000, n),
+                "resp_status": rng.choice(np.array([200, 404]), n),
+                "service": [f"svc-{(i + j) % 3}" for j in range(n)],
+                "req_path": [f"/api/v{j % 2}/x" for j in range(n)],
+            },
+        )
+        pem._register()
+    deadline = time.time() + 5
+    while time.time() < deadline and len(tracker.schemas()) < 1:
+        time.sleep(0.01)
+    broker = QueryBroker(bus, tracker)
+    broker.serve()
+    yield bus, tracker, broker
+    for a in pems + [kelvin]:
+        a.stop()
+    tracker.close()
+
+
+class TestBrokerBusAPI:
+    def test_execute_over_bus(self, served_cluster):
+        bus, _tracker, _broker = served_cluster
+        res = bus.request(
+            "broker.execute", {"query": QUERY, "timeout_s": 20.0},
+            timeout_s=25.0,
+        )
+        assert res["ok"], res
+        hb = res["tables"]["output"]
+        got = hb.to_pydict()
+        assert sorted(got["service"]) == ["svc-0", "svc-1", "svc-2"]
+        assert int(got["n"].sum()) == 3000
+        assert res["agent_stats"]
+
+    def test_execute_error_in_band(self, served_cluster):
+        bus, _t, _b = served_cluster
+        res = bus.request(
+            "broker.execute",
+            {"query": "import px\npx.display(px.DataFrame(table='nope'))"},
+            timeout_s=10.0,
+        )
+        assert not res["ok"]
+        assert "nope" in res["error"]
+
+    def test_schemas_agents_scripts(self, served_cluster):
+        bus, _t, _b = served_cluster
+        schemas = bus.request("broker.schemas", {}, timeout_s=5.0)
+        assert schemas["ok"] and "http_events" in schemas["schemas"]
+        agents = bus.request("broker.agents", {}, timeout_s=5.0)
+        kinds = {a["kind"] for a in agents["agents"]}
+        assert kinds == {"pem", "kelvin"}
+        scripts = bus.request("broker.scripts", {}, timeout_s=5.0)
+        assert "px/http_stats" in scripts["scripts"]
+
+
+class TestLoadTester:
+    def test_percentiles_and_errors(self, served_cluster):
+        _bus, _t, broker = served_cluster
+        rep = run_load(
+            broker_executor(broker), QUERY, workers=2, per_worker=3,
+            timeout_s=20.0,
+        )
+        d = rep.to_dict()
+        assert d["queries"] == 6 and d["errors"] == 0
+        assert d["p50_ms"] > 0 and d["p99_ms"] >= d["p50_ms"]
+
+        bad = run_load(
+            broker_executor(broker),
+            "import px\npx.display(px.DataFrame(table='nope'))",
+            workers=1, per_worker=2, timeout_s=5.0,
+        )
+        assert bad.errors == 2
+
+
+def _run_cli(*argv) -> str:
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(list(argv))
+    assert rc == 0, buf.getvalue()
+    return buf.getvalue()
+
+
+class TestCLI:
+    def test_script_list_and_show(self):
+        out = _run_cli("script", "list")
+        assert "px/http_stats" in out
+        out = _run_cli("script", "show", "px/http_stats")
+        assert "groupby" in out
+
+    def test_docs(self):
+        out = _run_cli("docs")
+        assert "## Scalar functions" in out
+        assert "`mean`" in out and "`count`" in out
+
+    def test_explain_offline(self):
+        out = _run_cli("explain", "px/http_stats")
+        assert "MemorySource" in out and "Agg" in out
+        assert "ResultSink" in out
+
+    def test_run_local_synthetic(self):
+        out = _run_cli(
+            "run", "px/http_stats", "--local", "--synthetic", "5000",
+            "-o", "json",
+        )
+        assert '"table": "output"' in out
+
+    def test_run_against_served_broker(self, served_cluster, tmp_path):
+        # End to end over the real framed-TCP netbus.
+        from pixie_tpu.services.netbus import BusServer
+
+        bus, _t, _b = served_cluster
+        server = BusServer(bus)
+        try:
+            addr = f"127.0.0.1:{server.port}"
+            out = _run_cli("run", "px/http_stats", "--broker", addr)
+            assert "output" in out
+            out = _run_cli("tables", "--broker", addr)
+            assert "http_events" in out
+            out = _run_cli("agents", "--broker", addr)
+            assert "pem" in out and "kelvin" in out
+        finally:
+            server.close()
+
+
+class TestPlanDebug:
+    def test_stats_annotation(self):
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.planner.debug import explain_plan
+        from pixie_tpu.planner import CompilerState, compile_pxl
+
+        eng = Engine()
+        eng.create_table("t")
+        eng.append_data("t", {
+            "time_": np.arange(100, dtype=np.int64),
+            "v": np.arange(100, dtype=np.int64),
+        })
+        q = (
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df = df.groupby('v').agg(n=('v', px.count))\npx.display(df)"
+        )
+        eng.execute_query(q, analyze=True)
+        state = CompilerState(
+            schemas={n: t.relation for n, t in eng.tables.items()},
+            registry=eng.registry,
+        )
+        plan = compile_pxl(q, state).plan
+        txt = explain_plan(plan, stats=eng.last_stats)
+        assert "Agg by=[v]" in txt
+        assert "stats: windows=" in txt

@@ -489,3 +489,67 @@ class TestReviewRegressions:
         out = run(engine, p).to_pydict()
         assert len(out["service"]) == 7
         np.testing.assert_array_equal(out["n"], out["n_y"])
+
+
+class TestDenseDomain:
+    """Dense-domain group-by (packed dict codes as slot ids) must agree
+    with the generic sort-space path bit for bit, including deferred
+    (DeviceResult) execution."""
+
+    QUERY = """
+import px
+df = px.DataFrame(table='http_events')
+df = df[df.resp_status < 400]
+df = df.groupby(['service', 'req_path']).agg(
+    n=('latency_ns', px.count),
+    lat_mean=('latency_ns', px.mean),
+    lat_max=('latency_ns', px.max),
+)
+px.display(df)
+"""
+
+    def _rows(self, out):
+        d = out["output"].to_pydict()
+        keys = sorted(
+            (d["service"][i], d["req_path"][i]) for i in range(len(d["n"]))
+        )
+        order = np.lexsort((d["req_path"], d["service"]))
+        return keys, d["n"][order], d["lat_mean"][order], d["lat_max"][order]
+
+    def test_matches_sort_path(self, engine):
+        from pixie_tpu import config
+        from pixie_tpu.exec.fragment import _FRAGMENT_CACHE
+
+        dense = self._rows(engine.execute_query(self.QUERY))
+        config.set_flag("dense_domain_limit", 0)  # force generic path
+        _FRAGMENT_CACHE.clear()
+        try:
+            generic = self._rows(engine.execute_query(self.QUERY))
+        finally:
+            config.clear_flag("dense_domain_limit")
+            _FRAGMENT_CACHE.clear()
+        assert dense[0] == generic[0]
+        np.testing.assert_array_equal(dense[1], generic[1])
+        np.testing.assert_allclose(dense[2], generic[2], rtol=1e-6)
+        np.testing.assert_array_equal(dense[3], generic[3])
+
+    def test_dense_fragment_selected(self, engine):
+        from pixie_tpu.exec.fragment import _FRAGMENT_CACHE
+
+        engine.execute_query(self.QUERY)
+        frags = [hit[0] for hit in _FRAGMENT_CACHE.values()]
+        dense = [fr for fr in frags if fr.is_agg and fr.dense_domains]
+        assert dense, "expected the agg fragment to compile dense"
+        assert dense[0].dense_domains == (8, 4)  # 7 svcs, 3 paths (+NULL)
+
+    def test_deferred_device_result(self, engine):
+        from pixie_tpu.exec.engine import DeviceResult
+
+        out = engine.execute_query(self.QUERY, materialize=False)
+        r = out["output"]
+        assert isinstance(r, DeviceResult)
+        r.block_until_ready()
+        d = r.to_host().to_pydict()
+        assert len(d["n"]) == 21  # 7 services x 3 paths
+        # Second to_host returns the cached batch.
+        assert r.to_host() is r.to_host()
