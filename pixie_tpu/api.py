@@ -1,0 +1,114 @@
+"""Python API client (the pxapi analog).
+
+Reference parity: ``/root/reference/src/api/go/pxapi/client.go:41-54``
+(``Client.ExecuteScript`` streaming results into per-table record
+handlers) and the Python client under ``src/api/python``. The transport
+is the framed-TCP netbus to a served broker; results arrive as
+HostBatches and are surfaced row-wise through handlers or as pydicts.
+
+    import pixie_tpu.api as pxapi
+
+    client = pxapi.Client("127.0.0.1", 6100)
+    for table, rows in client.execute_script(pxl).items():
+        ...
+
+    # or streaming-handler style:
+    class Printer(pxapi.TableRecordHandler):
+        def handle_record(self, record): print(record)
+    client.execute_script(pxl, handler_factory=lambda t: Printer())
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class ScriptExecutionError(RuntimeError):
+    pass
+
+
+class TableRecordHandler:
+    """Row-wise consumer of one output table (pxapi TableRecordHandler)."""
+
+    def handle_init(self, table_name: str, relation) -> None:  # noqa: B027
+        pass
+
+    def handle_record(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def handle_done(self, table_name: str) -> None:  # noqa: B027
+        pass
+
+
+class Client:
+    """Executes PxL scripts against a served broker."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6100,
+                 connect_timeout_s: float = 10.0):
+        from .services.netbus import RemoteBus
+
+        self._bus = RemoteBus(host, port, connect_timeout_s=connect_timeout_s)
+
+    def close(self) -> None:
+        self._bus.close()
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- introspection -------------------------------------------------------
+    def list_scripts(self) -> list[str]:
+        return self._request("broker.scripts", {})["scripts"]
+
+    def schemas(self) -> dict:
+        return self._request("broker.schemas", {})["schemas"]
+
+    def agents(self) -> list[dict]:
+        return self._request("broker.agents", {})["agents"]
+
+    # -- execution -----------------------------------------------------------
+    def execute_script(
+        self,
+        pxl: str,
+        timeout_s: float = 30.0,
+        max_output_rows: int = 10_000,
+        handler_factory: Optional[Callable[[str], TableRecordHandler]] = None,
+    ):
+        """Run a script; returns {table: pydict-of-columns}.
+
+        With ``handler_factory``, each output table's rows additionally
+        stream through a ``TableRecordHandler`` (the pxapi consumption
+        model); the return value is unchanged.
+        """
+        res = self._request(
+            "broker.execute",
+            {"query": pxl, "timeout_s": timeout_s,
+             "max_output_rows": max_output_rows},
+            timeout_s=timeout_s + 5,
+        )
+        out = {}
+        for name, hb in sorted(res["tables"].items()):
+            d = hb.to_pydict()
+            out[name] = d
+            if handler_factory is not None:
+                h = handler_factory(name)
+                h.handle_init(name, hb.relation)
+                cols = list(d)
+                for i in range(hb.length):
+                    h.handle_record(
+                        {c: _py(d[c][i]) for c in cols}
+                    )
+                h.handle_done(name)
+        return out
+
+    def _request(self, topic: str, msg: dict, timeout_s: float = 10.0) -> dict:
+        res = self._bus.request(topic, msg, timeout_s=timeout_s)
+        if not res.get("ok"):
+            raise ScriptExecutionError(res.get("error", "unknown error"))
+        return res
+
+
+def _py(v):
+    return v.item() if hasattr(v, "item") else v

@@ -93,6 +93,13 @@ def cmd_run(args) -> int:
                 worst = max(s["exec_time_s"] for s in stats.values())
                 print(f"[{len(stats)} agents, slowest {worst * 1e3:.1f}ms]")
         return 0
+    if not args.local:
+        print(
+            "error: no target — pass --broker HOST:PORT for a cluster "
+            "or --local for an in-process engine",
+            file=sys.stderr,
+        )
+        return 2
     # Local mode: one in-process engine over replays.
     from .exec.engine import Engine
     from .ingest.schemas import init_schemas
@@ -122,6 +129,9 @@ def cmd_script(args) -> int:
             s = load_script(n)
             print(f"{n:28s} {s.manifest.get('short', '')}")
         return 0
+    if not args.name:
+        print("usage: px script show <name>", file=sys.stderr)
+        return 2
     s = load_script(args.name)
     print(s.pxl)
     return 0

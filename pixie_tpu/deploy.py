@@ -1,0 +1,119 @@
+"""Process entrypoints for deployed roles (the agent/service mains).
+
+Reference parity: the per-process mains — PEM (``src/vizier/services/
+agent/pem/pem_main.cc``), Kelvin (``kelvin/kelvin_main.go``), and the
+query-broker service (``src/vizier/services/query_broker``). One image,
+one module, three roles:
+
+  python -m pixie_tpu.deploy broker   # tracker + broker + netbus + obs
+  python -m pixie_tpu.deploy pem      # data agent + source collectors
+  python -m pixie_tpu.deploy kelvin   # merge agent
+
+PEM/Kelvin dial the broker's netbus (PIXIE_TPU_BROKER host:port); the
+broker serves the bus (NATS analog), the script APIs, and healthz/
+statusz/metrics.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+
+def _agent_id(default: str) -> str:
+    return os.environ.get("PIXIE_TPU_AGENT_ID", default)
+
+
+def _broker_addr() -> tuple[str, int]:
+    addr = os.environ.get("PIXIE_TPU_BROKER", "127.0.0.1:6100")
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def run_broker() -> int:
+    from .services.msgbus import MessageBus
+    from .services.netbus import BusServer
+    from .services.observability import ObservabilityServer
+    from .services.query_broker import QueryBroker
+    from .services.script_runner import ScriptRunner
+    from .services.tracker import AgentTracker
+    from .services.tracepoints import TracepointRegistry
+
+    bus = MessageBus()
+    tracker = AgentTracker(bus)
+    broker = QueryBroker(bus, tracker)
+    broker.tracepoints = TracepointRegistry(bus, tracker)
+    broker.serve()
+    runner = ScriptRunner(broker)
+    runner.run_forever()
+    netbus_port = int(os.environ.get("PIXIE_TPU_NETBUS_PORT", "6100"))
+    server = BusServer(bus, host="0.0.0.0", port=netbus_port)
+    obs = ObservabilityServer(
+        statusz_fn=lambda: {
+            "agents": tracker.agents_info(),
+            "tables": sorted(tracker.schemas()),
+        }
+    )
+    obs_port = obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "6101")))
+    print(
+        f"[broker] netbus :{server.port} obs :{obs_port}", flush=True
+    )
+    _wait_forever()
+    return 0
+
+
+def run_pem() -> int:
+    from .ingest.collector import Collector
+    from .ingest.connectors import ProcessStatsConnector, SeqGenConnector
+    from .ingest.profiler import PerfProfilerConnector
+    from .services.agent import PEMAgent
+    from .services.netbus import RemoteBus
+
+    host, port = _broker_addr()
+    bus = RemoteBus(host, port)
+    agent = PEMAgent(bus, _agent_id("pem")).start()
+    coll = Collector()
+    coll.wire_to(agent)
+    coll.register_source(ProcessStatsConnector())
+    coll.register_source(PerfProfilerConnector(pod=_agent_id("pem")))
+    if os.environ.get("PIXIE_TPU_SEQGEN"):
+        coll.register_source(SeqGenConnector())
+    coll.run_as_thread()
+    print(f"[pem] {agent.agent_id} -> {host}:{port}", flush=True)
+    _wait_forever()
+    return 0
+
+
+def run_kelvin() -> int:
+    from .services.agent import KelvinAgent
+    from .services.netbus import RemoteBus
+
+    host, port = _broker_addr()
+    bus = RemoteBus(host, port)
+    agent = KelvinAgent(bus, _agent_id("kelvin")).start()
+    print(f"[kelvin] {agent.agent_id} -> {host}:{port}", flush=True)
+    _wait_forever()
+    return 0
+
+
+def _wait_forever() -> None:
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+
+
+def main(argv=None) -> int:
+    roles = {"broker": run_broker, "pem": run_pem, "kelvin": run_kelvin}
+    args = argv if argv is not None else sys.argv[1:]
+    if len(args) != 1 or args[0] not in roles:
+        print(f"usage: python -m pixie_tpu.deploy {{{'|'.join(roles)}}}",
+              file=sys.stderr)
+        return 2
+    return roles[args[0]]()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,7 @@ from ..udf.registry import Registry, default_registry
 from .fragment import ColumnMeta, compile_fragment_cached as compile_fragment
 from .plan import (
     AggOp,
+    TableSinkOp,
     BridgeSinkOp,
     BridgeSourceOp,
     EmptySourceOp,
@@ -152,7 +153,8 @@ class DeviceResult:
     home is HBM until a client asks for bytes.
     """
 
-    def __init__(self, engine, stream, frag, cols, valid, overflow, stats=None):
+    def __init__(self, engine, stream, frag, cols, valid, overflow,
+                 stats=None, qstats=None):
         self._engine = engine
         self._stream = stream
         self._frag = frag
@@ -160,6 +162,7 @@ class DeviceResult:
         self._valid = valid
         self._overflow = overflow
         self._stats = stats
+        self._qstats = qstats  # the CREATING query's stats (analyze mode)
         self._host: Optional[HostBatch] = None
 
     @property
@@ -191,6 +194,11 @@ class DeviceResult:
             frag = compile_fragment(
                 stream.chain, stream.relation, stream.dicts, eng.registry
             )
+            if self._qstats is not None:
+                # Fresh per-attempt stats: rows/windows stay per-attempt
+                # and the attempt is marked (analyze fidelity).
+                stats = self._qstats.new_fragment(stream.chain)
+                stats.ops = stats.ops + ("rebucket",)
             state = eng._fold_agg_state(stream, frag, stats)
             with _timed(stats, "finalize"):
                 cols, valid, overflow = frag.finalize(state)
@@ -222,6 +230,7 @@ class Engine:
         self.last_stats = None
         self._query_stats = None
         self._cancel = None  # per-query cancel event (execute_plan arg)
+        self.last_table_sinks: dict = {}  # {table: rows} from TableSinkOps
 
     @property
     def tables(self) -> dict:
@@ -326,6 +335,7 @@ class Engine:
         self, plan: Plan, bridge_inputs: dict | None = None,
         materialize: bool = True,
     ) -> dict:
+        self.last_table_sinks = {}
         results: dict[int, object] = {}
         outputs: dict = {}
         consumers: dict[int, int] = {}
@@ -411,6 +421,12 @@ class Engine:
                     outputs[op.name] = self._run_fragment(r)
                 else:
                     outputs[op.name] = mat_input(src_id)
+            elif isinstance(op, TableSinkOp):
+                hb = mat_input(node.inputs[0])
+                self.append_data(op.table, hb)
+                # Not a client output (clients iterate result tables);
+                # recorded on the engine for callers/tests.
+                self.last_table_sinks[op.table] = hb.length
             elif isinstance(op, OTelExportSinkOp):
                 from .otel import batch_to_otlp
 
@@ -812,7 +828,10 @@ class Engine:
             with _timed(stats, "finalize"):
                 cols, valid, overflow = frag.finalize(state)
                 _block_if(stats, (cols, valid, overflow))
-            return DeviceResult(self, stream, frag, cols, valid, overflow, stats)
+            return DeviceResult(
+                self, stream, frag, cols, valid, overflow, stats,
+                qstats=getattr(self, "_query_stats", None),
+            )
 
         # Non-agg: stream windows, stop early once a limit is satisfied.
         _, _, rows_step = self._compile_steps(frag)

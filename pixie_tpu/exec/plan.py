@@ -191,6 +191,18 @@ class OTelExportSinkOp(Op):
 
 
 @dataclass(frozen=True)
+class TableSinkOp(Op):
+    """Write result rows back into a named table-store table.
+
+    Reference: MemorySinkNode (``src/carnot/exec/memory_sink_node.h``) —
+    query outputs land in the table store so later queries (or a cron
+    ScriptRunner stage) can read them.
+    """
+
+    table: str = "output"
+
+
+@dataclass(frozen=True)
 class ResultSinkOp(Op):
     """Terminal sink: materialize to the client result stream.
 

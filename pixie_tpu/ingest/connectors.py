@@ -6,6 +6,8 @@ Reference parity:
   eBPF sources (SURVEY.md §4).
 - ``ProcessStatsConnector`` (``source_connectors/process_stats``):
   per-process CPU/memory counters scraped from procfs.
+- ``NetworkStatsConnector`` (``source_connectors/network_stats``):
+  per-interface rx/tx counters from /proc/net/dev.
 """
 
 from __future__ import annotations
@@ -18,6 +20,7 @@ import numpy as np
 from ..types.dtypes import DataType
 from ..types.relation import Relation
 from .core import SourceConnector
+from .schemas import NETWORK_STATS_RELATION
 
 I, F, S, T = DataType.INT64, DataType.FLOAT64, DataType.STRING, DataType.TIME64NS
 
@@ -129,3 +132,49 @@ class ProcessStatsConnector(SourceConnector):
             rows["rss_bytes"].append(int(fields[21]) * self._page)
             count += 1
         data_tables["process_stats"].append(rows)
+
+
+class NetworkStatsConnector(SourceConnector):
+    """Per-interface network counters from /proc/net/dev.
+
+    Reference parity: the network_stats source
+    (``src/stirling/source_connectors/network_stats/
+    network_stats_connector.h`` — per-pod rx/tx byte/packet/error/drop
+    counters from the netns). Without k8s netns access, interfaces stand
+    in for pods; the schema is the canonical ``network_stats`` table.
+    """
+
+    name = "network_stats"
+    tables = [("network_stats", NETWORK_STATS_RELATION)]
+
+    def __init__(self, pod: str = "default/self", **kw):
+        super().__init__(**kw)
+        self.pod = pod
+
+    def transfer_data(self, ctx, data_tables) -> None:
+        try:
+            with open("/proc/net/dev") as f:
+                lines = f.readlines()[2:]  # skip the two header lines
+        except OSError:
+            return
+        rows = {k: [] for k, _ in self.tables[0][1].items()}
+        now = time.time_ns()
+        for line in lines:
+            if ":" not in line:
+                continue
+            iface, rest = line.split(":", 1)
+            fields = rest.split()
+            if len(fields) < 12:
+                continue
+            rows["time_"].append(now)
+            rows["pod_id"].append(iface.strip())
+            rows["rx_bytes"].append(int(fields[0]))
+            rows["rx_packets"].append(int(fields[1]))
+            rows["rx_errors"].append(int(fields[2]))
+            rows["rx_drops"].append(int(fields[3]))
+            rows["tx_bytes"].append(int(fields[8]))
+            rows["tx_packets"].append(int(fields[9]))
+            rows["tx_errors"].append(int(fields[10]))
+            rows["tx_drops"].append(int(fields[11]))
+            rows["pod"].append(self.pod)
+        data_tables["network_stats"].append(rows)

@@ -46,7 +46,6 @@ class PerfProfilerConnector(SourceConnector):
         self.pod = pod
         self.upid = UPID(asid=asid, pid=os.getpid(), start_ts=0)
         self._counts: dict[str, int] = {}
-        self._ids: dict[str, int] = {}
         self._lock = threading.Lock()
 
     def sample(self) -> None:
@@ -60,7 +59,6 @@ class PerfProfilerConnector(SourceConnector):
                 continue
             with self._lock:
                 self._counts[folded] = self._counts.get(folded, 0) + 1
-                self._ids.setdefault(folded, len(self._ids))
 
     def transfer_data(self, ctx, data_tables) -> None:
         # The collector calls transfer_data on the sampling cadence; fold
@@ -73,8 +71,17 @@ class PerfProfilerConnector(SourceConnector):
                 return
             stacks = list(self._counts)
             counts = [self._counts[s] for s in stacks]
-            ids = [self._ids[s] for s in stacks]
             self._counts.clear()
+        # Stable 63-bit content hash: bounded memory on long-lived PEMs
+        # (no per-stack id table), stable across agents and restarts.
+        import hashlib
+
+        ids = [
+            int.from_bytes(
+                hashlib.blake2b(s.encode(), digest_size=8).digest(), "big"
+            ) >> 1
+            for s in stacks
+        ]
         now = time.time_ns()
         n = len(stacks)
         data_tables["stack_traces.beta"].append({

@@ -99,9 +99,11 @@ def compile_pxl(query: str, state: CompilerState) -> CompiledScript:
     visitor = ASTVisitor(px)
     visitor.run(tree)
     mutations = list(visitor._pxtrace.mutations) if visitor._pxtrace else []
-    if not builder.sinks and not builder.n_exports and not mutations:
+    if (not builder.sinks and not builder.n_exports
+            and not builder.n_table_sinks and not mutations):
         raise PxLError(
-            "script produced no output tables; call px.display(df) or "
+            "script produced no output tables; call px.display(df), "
+            "px.to_table(df, name), or "
             "px.export(df, ...) (or the script only defines functions — "
             "call one and display its result)"
         )

@@ -104,12 +104,11 @@ def explain_plan(plan: Plan, stats=None) -> str:
     for nid in plan.topo_order():
         node = plan.nodes[nid]
         fused = nid not in breaks and node.inputs
-        prefix = "  | " if fused else "  "
-        mark = "" if not fused else ""
+        prefix = "  | " if fused else "  "  # "| " = fused into the chain above
         rel = ""
         if node.relation is not None:
             rel = f"  :: {node.relation}"
-        lines.append(f"{prefix}[{nid}] {_op_label(node.op)}{mark}{rel}")
+        lines.append(f"{prefix}[{nid}] {_op_label(node.op)}{rel}")
         if stats is not None and isinstance(node.op, AggOp) and fi < len(frag_stats):
             fs = frag_stats[fi]
             fi += 1

@@ -470,6 +470,7 @@ class PlanBuilder:
     max_groups: int = 4096
     sinks: list = field(default_factory=list)  # output names in display order
     n_exports: int = 0  # OTel export sinks (outputs without a name)
+    n_table_sinks: int = 0  # table write-backs (px.to_table)
 
     def source(self, table: str, select=None, start_time=None, stop_time=None,
                lineno=None) -> DataFrameObj:
@@ -536,6 +537,17 @@ class PlanBuilder:
             raise PxLError(f"duplicate output table name {name!r}", lineno)
         self.plan.add(ResultSinkOp(name), [df.node_id])
         self.sinks.append(name)
+
+    def to_table(self, df: DataFrameObj, name: str, lineno=None):
+        """Write df back into the table store (MemorySink write-back)."""
+        from ..exec.plan import TableSinkOp
+
+        if not isinstance(df, DataFrameObj):
+            raise PxLError("px.to_table() expects a DataFrame", lineno)
+        if not isinstance(name, str) or not name:
+            raise PxLError("px.to_table() needs a table name", lineno)
+        self.plan.add(TableSinkOp(name), [df.node_id])
+        self.n_table_sinks += 1
 
     def export_otel(self, df: DataFrameObj, spec, lineno=None):
         from ..exec.plan import OTelExportSinkOp

@@ -121,6 +121,11 @@ class PxModule:
     def display(self, df, name: str = "output"):
         self._builder.display(df, name)
 
+    def to_table(self, df, name: str):
+        """Persist a DataFrame's rows into the table store under ``name``
+        (the MemorySink write-back; later queries can read the table)."""
+        self._builder.to_table(df, name)
+
     def export(self, df, spec):
         """px.export(df, px.otel.Data(...)) — OTel exporter surface
         (``planner/objects/exporter.h``)."""

@@ -285,12 +285,12 @@ def add_limit_to_result_sinks(plan: Plan, max_rows: int) -> None:
 
 # -- reachability -------------------------------------------------------------
 def prune_unreachable(plan: Plan) -> None:
-    from ..exec.plan import OTelExportSinkOp
+    from ..exec.plan import OTelExportSinkOp, TableSinkOp
 
     sink_ids = [
         nid
         for nid, n in plan.nodes.items()
-        if isinstance(n.op, (ResultSinkOp, OTelExportSinkOp))
+        if isinstance(n.op, (ResultSinkOp, OTelExportSinkOp, TableSinkOp))
     ]
     if not sink_ids:
         return

@@ -65,6 +65,7 @@ def _registered_types():
         _plan.BridgeSourceOp,
         _plan.OTelExportSinkOp,
         _plan.ResultSinkOp,
+        _plan.TableSinkOp,
         _plan.ColumnRef,
         _plan.Literal,
         _plan.FuncCall,

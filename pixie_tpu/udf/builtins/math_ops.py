@@ -194,6 +194,43 @@ def register(reg):
         finalize=lambda c: jnp.where(c[1] > 0, c[0] / jnp.maximum(c[1], 1.0), jnp.nan),
         doc="Arithmetic mean of the group (sum/count carry; merges exactly).",
     )
+    # Direct integer/bool overloads: EXACT i64 sums (the FLOAT64 path
+    # rides f32 device planes) via the shared sort-based reduction — no
+    # 64-bit-float scatter (~125ms per 2M-row window on the chip).
+    reg.uda(
+        "mean",
+        (INT64,),
+        FLOAT64,
+        init=lambda g: (jnp.zeros(g, dtype=jnp.int64), jnp.zeros(g, dtype=jnp.int64)),
+        update=lambda c, gids, mask, v: (
+            _seg_sum(c[0], gids, mask, v),
+            _seg_count(c[1], gids, mask),
+        ),
+        merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        finalize=lambda c: jnp.where(
+            c[1] > 0,
+            c[0].astype(jnp.float64) / jnp.maximum(c[1], 1).astype(jnp.float64),
+            jnp.nan,
+        ),
+        doc="Arithmetic mean (exact int64 sum/count carry).",
+    )
+    reg.uda(
+        "mean",
+        (BOOLEAN,),
+        FLOAT64,
+        init=lambda g: (jnp.zeros(g, dtype=jnp.int64), jnp.zeros(g, dtype=jnp.int64)),
+        update=lambda c, gids, mask, v: (
+            _seg_sum(c[0], gids, mask, v.astype(jnp.int64)),
+            _seg_count(c[1], gids, mask),
+        ),
+        merge=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        finalize=lambda c: jnp.where(
+            c[1] > 0,
+            c[0].astype(jnp.float64) / jnp.maximum(c[1], 1).astype(jnp.float64),
+            jnp.nan,
+        ),
+        doc="Fraction of true rows (exact integer carry).",
+    )
 
     def _seg_extreme64(carry, gids, mask, v, neutral, is_max):
         """64-bit int min/max without a 64-bit scatter: two-key sort

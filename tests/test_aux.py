@@ -97,3 +97,89 @@ class TestVersion:
         assert code == 200 and "version" in body
         code, _ct, body = srv.handle("/statusz")
         assert code == 200 and "git_commit" in body
+
+
+class TestTableSink:
+    def test_px_to_table_write_back(self):
+        eng = Engine()
+        n = 5000
+        eng.append_data("t", {
+            "time_": np.arange(n, dtype=np.int64),
+            "v": np.arange(n, dtype=np.int64) % 10,
+        })
+        out = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "agg = df.groupby('v').agg(n=('v', px.count))\n"
+            "px.to_table(agg, 'rollup')\npx.display(agg)"
+        )
+        assert list(out) == ["output"]  # sinks never pollute client tables
+        assert eng.last_table_sinks == {"rollup": 10}
+        # The written table is queryable by a later script.
+        out2 = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='rollup')\n"
+            "s = df.groupby('v').agg(total=('n', px.sum))\npx.display(s)"
+        )["output"].to_pydict()
+        assert int(out2["total"].sum()) == n
+
+    def test_to_table_only_script_is_valid(self):
+        eng = Engine()
+        eng.append_data("t", {
+            "time_": np.arange(10, dtype=np.int64),
+            "v": np.arange(10, dtype=np.int64),
+        })
+        out = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "px.to_table(df, 'copy')"
+        )
+        assert out == {}
+        assert eng.last_table_sinks == {"copy": 10}
+
+
+class TestMetadataWatcher:
+    def test_versioned_updates_and_replay(self, tmp_path):
+        import json as _json
+
+        from pixie_tpu.metadata.watcher import MetadataWatcher
+
+        w = MetadataWatcher()
+        seen = []
+        w.subscribe(seen.append)
+        updates = [
+            {"rv": 1, "kind": "pod", "uid": "p1", "name": "web",
+             "namespace": "default"},
+            {"rv": 2, "kind": "service", "uid": "s1", "name": "websvc",
+             "namespace": "default"},
+            {"rv": 2, "kind": "pod", "uid": "stale", "name": "x",
+             "namespace": "default"},  # stale rv: skipped
+            {"rv": 3, "kind": "process", "upid": "1:42:100",
+             "pod_uid": "p1"},
+        ]
+        assert w.apply_all(updates) == 3
+        assert w.resource_version == 3
+        assert w.updates_skipped == 1
+        assert "p1" in w.state.pods and "stale" not in w.state.pods
+        assert len(seen) == 3
+
+        # Replay from a recorded log is idempotent (all stale).
+        log = tmp_path / "updates.jsonl"
+        log.write_text("\n".join(_json.dumps(u) for u in updates))
+        assert w.load_jsonl(str(log)) == 0
+
+
+class TestNetworkStats:
+    def test_proc_net_dev_scrape(self):
+        from pixie_tpu.ingest.connectors import NetworkStatsConnector
+
+        eng = Engine()
+        conn = NetworkStatsConnector(pod="ns/p")
+        coll = Collector()
+        coll.wire_to(eng)
+        coll.register_source(conn)
+        conn.transfer_data(coll, coll._data_tables)
+        coll.flush()
+        out = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='network_stats')\n"
+            "s = df.groupby('pod_id').agg(rx=('rx_bytes', px.max))\n"
+            "px.display(s)"
+        )["output"].to_pydict()
+        assert "lo" in list(out["pod_id"])  # loopback always present

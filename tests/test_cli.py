@@ -191,3 +191,39 @@ class TestPlanDebug:
         txt = explain_plan(plan, stats=eng.last_stats)
         assert "Agg by=[v]" in txt
         assert "stats: windows=" in txt
+
+
+class TestPythonAPI:
+    def test_client_execute_and_handlers(self, served_cluster):
+        from pixie_tpu.api import Client, ScriptExecutionError, TableRecordHandler
+        from pixie_tpu.services.netbus import BusServer
+
+        bus, _t, _b = served_cluster
+        server = BusServer(bus)
+        rows_seen = []
+
+        class Recorder(TableRecordHandler):
+            def handle_record(self, record):
+                rows_seen.append(record)
+
+        try:
+            with Client("127.0.0.1", server.port) as client:
+                assert "px/http_stats" in client.list_scripts()
+                assert "http_events" in client.schemas()
+                assert len(client.agents()) == 3
+                out = client.execute_script(
+                    QUERY, handler_factory=lambda t: Recorder()
+                )
+                assert sorted(out["output"]["service"]) == [
+                    "svc-0", "svc-1", "svc-2"
+                ]
+                assert len(rows_seen) == 3
+                assert {"service", "n"} <= set(rows_seen[0])
+                import pytest as _pytest
+
+                with _pytest.raises(ScriptExecutionError, match="nope"):
+                    client.execute_script(
+                        "import px\npx.display(px.DataFrame(table='nope'))"
+                    )
+        finally:
+            server.close()
