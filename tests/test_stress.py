@@ -1,0 +1,241 @@
+"""Concurrency stress suite — the sanitizer/race-detection story.
+
+Reference parity: the reference runs its C++ services under TSAN/ASAN in
+CI (SURVEY.md §5 sanitizers). The Python analog cannot instrument data
+races directly, so this suite hammers every shared-state surface from
+many threads and asserts invariants that races would break: no lost
+messages, no double-applied state, consistent counters, and no
+exceptions leaking from daemon threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu.services.msgbus import MessageBus
+
+N_THREADS = 8
+N_MSGS = 200
+
+
+class TestMsgBusRaces:
+    def test_concurrent_publish_fanout_no_loss(self):
+        bus = MessageBus()
+        got = []
+        lock = threading.Lock()
+
+        def on_msg(m):
+            with lock:
+                got.append(m["i"])
+
+        subs = [bus.subscribe("t", on_msg) for _ in range(3)]
+
+        def pub(base):
+            for i in range(N_MSGS):
+                bus.publish("t", {"i": base + i})
+
+        threads = [
+            threading.Thread(target=pub, args=(k * N_MSGS,))
+            for k in range(N_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        deadline = time.time() + 10
+        want = 3 * N_THREADS * N_MSGS
+        while time.time() < deadline and len(got) < want:
+            time.sleep(0.01)
+        assert len(got) == want  # every message reaches every subscriber
+        for s in subs:
+            s.unsubscribe()
+
+    def test_subscribe_unsubscribe_churn_under_publish(self):
+        bus = MessageBus()
+        stop = threading.Event()
+        errors = []
+
+        def churn():
+            try:
+                while not stop.is_set():
+                    s = bus.subscribe("c", lambda m: None)
+                    s.unsubscribe()
+            except Exception as e:  # pragma: no cover - the failure signal
+                errors.append(e)
+
+        def pub():
+            try:
+                while not stop.is_set():
+                    bus.publish("c", {})
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn) for _ in range(4)] + [
+            threading.Thread(target=pub) for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+class TestTrackerRaces:
+    def test_concurrent_register_heartbeat_expire(self):
+        from pixie_tpu.services.tracker import AgentTracker
+
+        bus = MessageBus()
+        tracker = AgentTracker(bus, expiry_s=60.0, check_interval_s=60.0)
+        try:
+
+            def agent_life(k):
+                for i in range(50):
+                    bus.publish("agent.register", {
+                        "agent_id": f"a-{k}",
+                        "accepts_remote_sources": False,
+                        "schemas": {},
+                    })
+                    bus.publish("agent.heartbeat", {"agent_id": f"a-{k}"})
+
+            threads = [
+                threading.Thread(target=agent_life, args=(k,))
+                for k in range(N_THREADS)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            deadline = time.time() + 5
+            while time.time() < deadline and len(tracker.agent_ids()) < N_THREADS:
+                time.sleep(0.01)
+            assert len(tracker.agent_ids()) == N_THREADS
+            # asids unique even under concurrent registration
+            asids = [a["asid"] for a in tracker.agents_info()]
+            assert len(set(asids)) == len(asids)
+        finally:
+            tracker.close()
+
+
+class TestEngineConcurrentQueries:
+    def test_parallel_queries_one_engine(self):
+        """Engines serve concurrent read queries over a static table."""
+        from pixie_tpu.exec.engine import Engine
+
+        eng = Engine(window_rows=1 << 12)
+        n = 20_000
+        rng = np.random.default_rng(0)
+        eng.append_data("t", {
+            "time_": np.arange(n, dtype=np.int64),
+            "v": rng.integers(0, 50, n),
+        })
+        q = (
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df = df.groupby('v').agg(n=('v', px.count))\npx.display(df)"
+        )
+        eng.execute_query(q)  # compile once
+        results, errors = [], []
+
+        def run():
+            try:
+                out = eng.execute_query(q)["output"].to_pydict()
+                results.append(int(out["n"].sum()))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=run) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert results == [n] * 6
+
+    def test_ingest_during_queries_monotonic(self):
+        """Counts only grow while appends race queries (no torn windows)."""
+        from pixie_tpu.exec.engine import Engine
+
+        eng = Engine(window_rows=1 << 10)
+        eng.append_data("t", {
+            "time_": np.arange(100, dtype=np.int64),
+            "v": np.zeros(100, dtype=np.int64),
+        })
+        q = (
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df = df.groupby('v').agg(n=('v', px.count))\npx.display(df)"
+        )
+        eng.execute_query(q)
+        stop = threading.Event()
+        errors = []
+
+        def ingest():
+            i = 100
+            while not stop.is_set():
+                eng.append_data("t", {
+                    "time_": np.arange(i, i + 100, dtype=np.int64),
+                    "v": np.zeros(100, dtype=np.int64),
+                })
+                i += 100
+                time.sleep(0.005)
+
+        t = threading.Thread(target=ingest)
+        t.start()
+        try:
+            seen = 0
+            for _ in range(10):
+                try:
+                    out = eng.execute_query(q)["output"].to_pydict()
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+                    break
+                total = int(out["n"].sum())
+                assert total >= seen, f"count went backwards: {total} < {seen}"
+                seen = total
+        finally:
+            stop.set()
+            t.join()
+        assert not errors
+        assert seen >= 100
+
+
+class TestFragmentCacheRaces:
+    def test_concurrent_compile_same_query(self):
+        """Parallel first-compiles of one fragment never produce torn
+        cache entries (worst case is duplicate compilation)."""
+        from pixie_tpu import config
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.exec.fragment import _FRAGMENT_CACHE
+
+        _FRAGMENT_CACHE.clear()
+        engines = []
+        for _ in range(4):
+            e = Engine(window_rows=1 << 10)
+            e.append_data("t", {
+                "time_": np.arange(500, dtype=np.int64),
+                "v": np.arange(500, dtype=np.int64) % 7,
+            })
+            engines.append(e)
+        q = (
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df = df.groupby('v').agg(n=('v', px.count))\npx.display(df)"
+        )
+        errors = []
+
+        def run(e):
+            try:
+                out = e.execute_query(q)["output"].to_pydict()
+                assert int(out["n"].sum()) == 500
+            except Exception as ex:  # pragma: no cover
+                errors.append(ex)
+
+        threads = [threading.Thread(target=run, args=(e,)) for e in engines]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
