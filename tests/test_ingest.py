@@ -241,3 +241,43 @@ class TestHTTPParser:
         assert sorted(out["req_path"]) == ["/e0", "/e1", "/e2", "/e3"]
         assert int(out["n"].sum()) == 50
         np.testing.assert_allclose(out["lat"], [77.0] * 4)
+
+
+class TestDNSParser:
+    def _query(self, txid, name=b"\x03foo\x07example\x03com\x00"):
+        import struct
+
+        return struct.pack(">HHHHHH", txid, 0x0100, 1, 0, 0, 0) + name + b"\x00\x01\x00\x01"
+
+    def _response(self, txid):
+        import struct
+
+        q = b"\x03foo\x07example\x03com\x00\x00\x01\x00\x01"
+        # one A answer with a compression pointer back to offset 12
+        ans = b"\xc0\x0c" + struct.pack(">HHIH", 1, 1, 60, 4) + bytes([10, 1, 2, 3])
+        return struct.pack(">HHHHHH", txid, 0x8180, 1, 1, 0, 0) + q + ans
+
+    def test_parse_and_stitch(self):
+        from pixie_tpu.ingest.dns_parser import DNSStitcher, parse_dns
+
+        msg = parse_dns(self._response(7))
+        assert msg["is_response"] and msg["answers"][0]["addr"] == "10.1.2.3"
+        assert msg["queries"][0]["name"] == "foo.example.com"
+
+        st = DNSStitcher(pod="ns/p")
+        st.feed(self._query(7), ts_ns=100)
+        n = st.feed(self._response(7), ts_ns=400)
+        assert n == 1
+        (r,) = st.drain()
+        assert r["latency_ns"] == 300
+        import json as _json
+
+        assert _json.loads(r["resp_body"])["answers"][0]["addr"] == "10.1.2.3"
+
+    def test_garbage_and_orphans_counted(self):
+        from pixie_tpu.ingest.dns_parser import DNSStitcher
+
+        st = DNSStitcher()
+        assert st.feed(b"\x00\x01") == 0  # short header
+        assert st.feed(self._response(9)) == 0  # orphan response
+        assert st.parse_errors == 2
