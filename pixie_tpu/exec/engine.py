@@ -768,8 +768,6 @@ class Engine:
                 if isinstance(stream.source, list)
                 else [stream.source]
             )
-            cap = bucket_capacity(self.window_rows)
-            mask_fn = _range_mask_fn(cap)
             for t in tables:
                 if getattr(t, "_backend", None) is None:
                     continue
@@ -777,14 +775,16 @@ class Engine:
                     start, stop, window_rows=self.window_rows
                 ):
                     self._check_cancel()
-                    with _timed(stats, "stage", rows=hi - lo):
-                        valid = mask_fn(
-                            np.int32(lo - win.row0), np.int32(hi - win.row0)
-                        )
-                        _block_if(stats, valid)
                     if stats is not None:
                         stats.rows_in += hi - lo
-                    yield win.cols, valid
+                    # (lo, hi) scalar pair, not a mask: the fragment
+                    # builds the iota mask INSIDE its program — a
+                    # separate mask dispatch costs a tunnel round trip
+                    # per window. np scalars stay dynamic (no retrace
+                    # per offset).
+                    yield win.cols, (
+                        np.int32(lo - win.row0), np.int32(hi - win.row0)
+                    )
             return
         for hb in self._windows(stream):
             self._check_cancel()
@@ -872,23 +872,6 @@ def _block_if(stats, x) -> None:
         import jax
 
         jax.block_until_ready(x)
-
-
-@functools.lru_cache(maxsize=16)
-def _range_mask_fn(capacity: int):
-    """Jitted (lo, hi) -> bool[capacity] row-range mask (device-resident
-    windows carry no per-query mask; this computes it on device)."""
-    import jax
-    import jax.numpy as jnp
-
-    # The iota must be created INSIDE the traced function: a concrete jax
-    # Array captured as a jit-closure constant permanently degrades every
-    # later dispatch on the axon TPU tunnel.
-    return jax.jit(
-        lambda lo, hi: (
-            (i := jnp.arange(capacity, dtype=jnp.int32)) >= lo
-        ) & (i < hi)
-    )
 
 
 def _col(name):

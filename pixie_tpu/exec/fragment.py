@@ -146,6 +146,19 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry,
     return frag
 
 
+def _range_valid(cols, valid):
+    """Materialize ``valid`` when it arrives as a (lo, hi) row-range pair
+    (device-resident windows carry no mask; computing it in a separate
+    dispatch costs a full tunnel round trip per window, so the mask is
+    built INSIDE the fragment program from two scalars)."""
+    if isinstance(valid, tuple):
+        lo, hi = valid
+        n = next(iter(cols.values()))[0].shape[0]
+        iota = jnp.arange(n, dtype=jnp.int32)
+        return (iota >= lo) & (iota < hi)
+    return valid
+
+
 def _bind_pre_stage(ops, relation, dicts, registry):
     """Bind leading Map/Filter ops; returns (apply_fn, relation, dicts)."""
     steps = []  # ("map", [(name, BoundExpr)]) | ("filter", BoundExpr)
@@ -223,7 +236,7 @@ def compile_fragment(ops, input_relation, input_dicts, registry: Registry,
 
         @jax.jit
         def update(cols, valid):
-            return apply_pre(cols, valid)
+            return apply_pre(cols, _range_valid(cols, valid))
 
         return CompiledFragment(
             relation=rel1, out_meta=out_meta, is_agg=False, update=update,
@@ -376,7 +389,11 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
     )
 
     def window_state(cols, valid):
-        """Fold one window of rows into a fresh [G]-slot group state."""
+        """Fold one window of rows into a fresh [G]-slot group state.
+
+        ``valid`` is a bool[n] mask or a (lo, hi) row-range scalar pair
+        (the device-resident-window form)."""
+        valid = _range_valid(cols, valid)
         cols, valid = apply_pre(cols, valid)
         if dense_domains is not None:
             gids = dense_slot_ids(cols, valid)
