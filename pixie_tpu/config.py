@@ -100,6 +100,11 @@ define_flag("dense_domain_limit", 1 << 20,
             "(dictionary-encoded strings, booleans) with product <= this "
             "use the packed key AS the group id: no sort, no hash, and "
             "slot-aligned (regroup-free) state merges.")
+define_flag("fold_scan_windows", 16,
+            "Fold up to this many equal-shape device-resident windows per "
+            "aggregate dispatch via one lax.scan program (1 disables); "
+            "each dispatch costs a tunnel round trip in the synchronous "
+            "regime, so batching windows amortizes it.")
 define_flag("device_residency", True,
             "Stage full table windows into device memory (HBM) at append "
             "time so steady-state queries run without host transfers.")

@@ -472,15 +472,59 @@ class Engine:
     # -- bridge (agent-mode) machinery ----------------------------------------
     def _fold_agg_state(self, stream: "_Stream", frag, stats=None):
         """Stream the source through the fragment's window fold, returning
-        the accumulated (unfinalized) group state."""
+        the accumulated (unfinalized) group state.
+
+        Equal-capacity device-resident window runs fold through
+        ``update_all`` — ONE scan program per chunk of windows instead of
+        one dispatch (one tunnel round trip) per window."""
+        from ..config import get_flag
+
         init_state, agg_step, _ = self._compile_steps(frag)
         state = init_state()
+        chunk_w = get_flag("fold_scan_windows") if frag.update_all else 0
+        pend_cols, pend_lo, pend_hi = [], [], []
+
+        def flush_pending(state):
+            if not pend_cols:
+                return state
+            if len(pend_cols) == 1:
+                state = agg_step(state, pend_cols[0], (pend_lo[0], pend_hi[0]))
+            else:
+                state = frag.update_all(
+                    state, tuple(pend_cols),
+                    np.asarray(pend_lo, dtype=np.int32),
+                    np.asarray(pend_hi, dtype=np.int32),
+                )
+            pend_cols.clear()
+            pend_lo.clear()
+            pend_hi.clear()
+            return state
+
         for cols, valid in self._staged_windows(stream, stats):
+            batchable = (
+                chunk_w > 1
+                and isinstance(valid, tuple)
+                and (
+                    not pend_cols
+                    or _window_shapes(cols) == _window_shapes(pend_cols[0])
+                )
+            )
             with _timed(stats, "compute"):
-                state = agg_step(state, cols, valid)
+                if batchable:
+                    pend_cols.append(cols)
+                    pend_lo.append(valid[0])
+                    pend_hi.append(valid[1])
+                    if len(pend_cols) >= chunk_w:
+                        state = flush_pending(state)
+                else:
+                    state = flush_pending(state)
+                    state = agg_step(state, cols, valid)
                 _block_if(stats, state)
             if stats is not None:
                 stats.windows += 1
+        with _timed(stats, "compute"):
+            state = flush_pending(state)
+            _block_if(stats, state)
         return state
 
     def _bridge_payload(self, res):
@@ -854,6 +898,15 @@ class Engine:
         if stats is not None:
             stats.rows_out = out.length
         return _apply_limit(out, frag.limit)
+
+
+def _window_shapes(cols) -> tuple:
+    """Shape/dtype signature of a staged window (scan batching requires
+    identical signatures so the stacked treedef stays one program)."""
+    return tuple(
+        (c, tuple((p.shape, str(p.dtype)) for p in planes))
+        for c, planes in sorted(cols.items())
+    )
 
 
 def _timed(stats, stage: str, rows: int = 0):

@@ -56,6 +56,7 @@ class CompiledFragment:
     out_meta: list  # list[ColumnMeta] incl. struct columns
     is_agg: bool
     update: object = None  # jitted
+    update_all: object = None  # jitted scan-fold over stacked windows (agg)
     finalize: object = None  # jitted (agg only)
     init_state: object = None  # callable -> state pytree (agg only)
     limit: Optional[int] = None  # host-enforced row cap (non-agg chains)
@@ -468,6 +469,27 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
     def update(state, cols, valid):
         return merge_states(state, window_state(cols, valid))
 
+    @jax.jit
+    def update_all(state, cols_list, los, his):
+        """Fold MANY equal-capacity windows in ONE program: stack the
+        per-window planes on device and lax.scan the window fold. One
+        dispatch (one tunnel round trip) replaces W of them; XLA overlaps
+        the scan iterations' memory traffic.
+
+        ``cols_list`` is a tuple of per-window cols dicts; ``los``/``his``
+        are i32[W] row-range bounds (the mask builds in-program).
+        """
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *cols_list
+        )
+
+        def body(st, xs):
+            c, lo, hi = xs
+            return merge_states(st, window_state(c, (lo, hi))), None
+
+        out, _ = jax.lax.scan(body, state, (stacked, los, his))
+        return out
+
     # Output relation: group cols then agg outputs (struct sketches keep a
     # [G, k] plane; they are host-materialized and opaque to post ops).
     out_items = [(c, rel1.col_type(c)) for c in group_cols]
@@ -556,6 +578,7 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
         out_meta=final_meta,
         is_agg=True,
         update=update,
+        update_all=update_all,
         finalize=finalize,
         init_state=init_state,
         limit=limit,
