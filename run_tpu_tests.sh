@@ -1,0 +1,7 @@
+#!/bin/bash
+# Run the hardware-gated TPU suite on the real chip and record evidence.
+# Keep the ambient env (the axon plugin IS the TPU backend); one jax
+# process at a time — never run this while any other jax process lives.
+set -o pipefail
+out="${1:-TPU_TESTS_$(date +%Y%m%d).txt}"
+PIXIE_TPU_RUN_TPU_TESTS=1 python -m pytest tests/test_tpu.py -v -s 2>&1 | tee "$out"
