@@ -281,3 +281,52 @@ class TestDNSParser:
         assert st.feed(b"\x00\x01") == 0  # short header
         assert st.feed(self._response(9)) == 0  # orphan response
         assert st.parse_errors == 2
+
+
+class TestCaptureTap:
+    def test_jsonl_tap_to_queryable_tables(self, tmp_path):
+        import base64
+        import json as _json
+        import struct
+
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.ingest.collector import Collector
+        from pixie_tpu.ingest.tap import CaptureTapConnector
+
+        def b64(b):
+            return base64.b64encode(b).decode()
+
+        events = []
+        for i in range(20):
+            events.append({"conn": 1, "dir": "req", "ts": i * 1000,
+                           "data_b64": b64(f"GET /t{i % 2} HTTP/1.1\r\n\r\n".encode())})
+            events.append({"conn": 1, "dir": "resp", "ts": i * 1000 + 50,
+                           "data_b64": b64(b"HTTP/1.1 200 OK\r\nContent-Length: 0\r\n\r\n")})
+        q = struct.pack(">HHHHHH", 3, 0x0100, 1, 0, 0, 0) + b"\x01a\x02io\x00\x00\x01\x00\x01"
+        r = struct.pack(">HHHHHH", 3, 0x8180, 1, 0, 0, 0) + b"\x01a\x02io\x00\x00\x01\x00\x01"
+        events.append({"proto": "dns", "ts": 5, "data_b64": b64(q)})
+        events.append({"proto": "dns", "ts": 95, "data_b64": b64(r)})
+        path = tmp_path / "tap.jsonl"
+        path.write_text("\n".join(_json.dumps(e) for e in events))
+
+        eng = Engine()
+        conn = CaptureTapConnector(path=str(path), service="svc-t", pod="ns/p")
+        coll = Collector()
+        coll.wire_to(eng)
+        coll.register_source(conn)
+        conn.transfer_data(coll, coll._data_tables)
+        coll.flush()
+
+        out = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='http_events')\n"
+            "s = df.groupby('req_path').agg(n=('latency_ns', px.count),"
+            " lat=('latency_ns', px.mean))\npx.display(s)"
+        )["output"].to_pydict()
+        assert sorted(out["req_path"]) == ["/t0", "/t1"]
+        np.testing.assert_allclose(out["lat"], [50.0, 50.0])
+        dns = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='dns_events')\n"
+            "s = df.groupby('pod').agg(n=('latency_ns', px.count),"
+            " lat=('latency_ns', px.max))\npx.display(s)"
+        )["output"].to_pydict()
+        assert list(dns["n"]) == [1] and list(dns["lat"]) == [90]
