@@ -89,12 +89,13 @@ define_flag("max_groups", 4096,
             "Initial group-by capacity; overflow doubles it and re-runs.")
 define_flag("max_groups_limit", 1 << 22,
             "Hard cap for group-by rebucketing growth.")
-define_flag("groupby_impl", "sort",
+define_flag("groupby_impl", "auto",
             "Per-window group-id algorithm for keys WITHOUT a static dense "
-            "domain: 'sort' (multi-key stable sort; data-independent "
-            "runtime, the TPU-friendly default) or 'hash' (bounded-probe "
-            "device table; its data-dependent while-loop executes poorly "
-            "on the tunnel's synchronous dispatch mode).")
+            "domain: 'auto' picks per backend (sort on TPU, hash on CPU), "
+            "'sort' forces the multi-key stable sort (data-independent "
+            "runtime; XLA TPU sorts are fast), 'hash' forces the bounded-"
+            "probe device table (scatter-heavy; fast on CPU, poor on the "
+            "tunnel's synchronous dispatch mode).")
 define_flag("dense_domain_limit", 1 << 20,
             "Group-bys whose key columns all have statically-known domains "
             "(dictionary-encoded strings, booleans) with product <= this "

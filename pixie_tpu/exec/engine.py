@@ -1078,6 +1078,12 @@ def _join_dispatch(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
             pass  # N:M fan-out -> device kernel
     if left.length == 0 or right.length == 0:
         return _join_degenerate(left, right, op)
+    import jax
+
+    if op.how in ("inner", "left") and jax.default_backend() != "tpu":
+        # XLA CPU sorts make the device kernel a regression there; the
+        # vectorized numpy N:M join is the CPU-backend fast path.
+        return _join_host_nm(left, right, op)
     return _join_device(left, right, op)
 
 
@@ -1290,7 +1296,69 @@ def _join_host(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
     else:
         raise QueryError(f"unsupported join how={op.how!r}")
     r_idx = match[l_idx]
+    return _assemble_join_host(left, right, op, l_idx, r_idx)
 
+
+def _join_host_nm(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
+    """Vectorized N:M inner/left equijoin on host (numpy sort+searchsorted)
+    — the CPU-backend analog of the device kernel (XLA CPU sorts are too
+    slow to route big joins through the device path there)."""
+    l_remap, r_remap, _ = _align_join_dicts(left, right, op)
+    lk = _packed_key_ids(left, op.left_on, l_remap,
+                         right, op.right_on, r_remap)
+    lkeys, rkeys = lk
+    order = np.argsort(rkeys, kind="stable")
+    srk = rkeys[order]
+    lo = np.searchsorted(srk, lkeys, side="left")
+    hi = np.searchsorted(srk, lkeys, side="right")
+    counts = hi - lo
+    if op.how == "left":
+        counts = np.maximum(counts, 1)  # unmatched keep one null row
+        unmatched = (hi - lo) == 0
+    total = int(counts.sum())
+    starts = np.zeros(len(counts) + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    l_idx = np.repeat(np.arange(left.length, dtype=np.int64), counts)
+    within = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1], counts)
+    r_idx = order[np.clip(np.repeat(lo, counts) + within, 0, max(len(srk) - 1, 0))] \
+        if len(srk) else np.full(total, -1, dtype=np.int64)
+    if op.how == "left" and len(srk):
+        r_idx = np.where(np.repeat(unmatched, counts), -1, r_idx)
+    return _assemble_join_host(left, right, op, l_idx, r_idx)
+
+
+def _packed_key_ids(left, left_on, l_remap, right, right_on, r_remap):
+    """Dense i64 key ids comparable across both sides (np.unique over the
+    stacked key planes of the concatenated inputs)."""
+    def planes(b, cols, remap):
+        out = []
+        for c in cols:
+            for i, p in enumerate(b.cols[c]):
+                q = p
+                if i == 0 and c in remap:
+                    q = remap[c][np.clip(p, 0, None)]
+                    q = np.where(p >= 0, q, NULL_ID)
+                out.append(np.asarray(q))
+        return out
+    lp = planes(left, left_on, l_remap)
+    rp = planes(right, right_on, r_remap)
+    if len(lp) == 1:
+        # Single-plane keys compare directly — no densification pass.
+        return (lp[0].astype(np.int64, copy=False),
+                rp[0].astype(np.int64, copy=False))
+    stacked = np.stack(
+        [np.concatenate([a.astype(np.int64, copy=False),
+                         b.astype(np.int64, copy=False)])
+         for a, b in zip(lp, rp)],
+        axis=1,
+    )
+    _, inv = np.unique(stacked, axis=0, return_inverse=True)
+    inv = inv.astype(np.int64).reshape(-1)
+    return inv[: left.length], inv[left.length:]
+
+
+def _assemble_join_host(left, right, op, l_idx, r_idx) -> HostBatch:
+    """Row assembly for the host N:1 / N:M paths (r_idx=-1 -> null)."""
     out_rel = left.relation.merge(
         right.relation.select(
             [c for c in right.relation.column_names if c not in op.right_on]

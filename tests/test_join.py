@@ -166,20 +166,27 @@ class TestDeviceJoinKernel:
 
 
 class TestJoinRouting:
-    def test_large_inputs_route_to_device(self, monkeypatch):
-        """Above the threshold the device path runs (host path would
-        raise on the duplicate build keys)."""
+    def test_large_inputs_route_off_n1_host_path(self, monkeypatch):
+        """Above the threshold the N:1 host path is skipped: the device
+        kernel on TPU, the vectorized numpy N:M join on CPU (XLA CPU
+        sorts make the device kernel a regression there)."""
+        import jax
+
         import pixie_tpu.exec.engine as eng_mod
 
         monkeypatch.setattr(eng_mod, "DEVICE_JOIN_MIN_ROWS", 4)
+        expected = (
+            "_join_device" if jax.default_backend() == "tpu"
+            else "_join_host_nm"
+        )
         calls = []
-        orig = eng_mod._join_device
+        orig = getattr(eng_mod, expected)
 
         def spy(left, right, op):
             calls.append(op.how)
             return orig(left, right, op)
 
-        monkeypatch.setattr(eng_mod, "_join_device", spy)
+        monkeypatch.setattr(eng_mod, expected, spy)
         _check([1, 2, 3], [2, 3, 4], "inner")
         assert calls == ["inner"]
 
