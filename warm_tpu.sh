@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-shot TPU recovery: probe, warm every bench shape's compile cache,
+# record the hardware test evidence, then run the full bench.
+# Run STRICTLY solo (no other jax process, even CPU).
+set -o pipefail
+cd "$(dirname "$0")"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$(python - <<'PY'
+import sys; sys.path.insert(0, '.')
+from pixie_tpu.utils.cache import jax_cache_dir
+print(jax_cache_dir())
+PY
+)}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+
+echo "== probe =="
+timeout 300 python -c "import jax, jax.numpy as jnp; print(jax.devices(), float(jnp.arange(4).sum()))" || exit 1
+
+for s in http_stats service_stats net_flow_graph sql_stats perf_flamegraph device_join; do
+  echo "== warm $s =="
+  PIXIE_TPU_BENCH_INNER=1 PIXIE_TPU_BENCH_SHAPES=$s timeout "${PER_SHAPE_TIMEOUT:-900}" python bench.py 2>&1 | grep -a "\[bench\] $s"
+done
+
+echo "== requires_tpu suite =="
+PIXIE_TPU_RUN_TPU_TESTS=1 timeout 1200 python -m pytest tests/test_tpu.py -v -s 2>&1 | tee TPU_TESTS_r03.txt | tail -5
+
+echo "== full bench =="
+PIXIE_TPU_BENCH_BUDGET="${BENCH_BUDGET:-900}" timeout 1000 python bench.py
