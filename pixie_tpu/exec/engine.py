@@ -1308,10 +1308,26 @@ def _join_host_nm(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
                          right, op.right_on, r_remap)
     lkeys, rkeys = lk
     order = np.argsort(rkeys, kind="stable")
-    srk = rkeys[order]
-    lo = np.searchsorted(srk, lkeys, side="left")
-    hi = np.searchsorted(srk, lkeys, side="right")
-    counts = hi - lo
+    span = 0
+    if len(rkeys) and len(lkeys):
+        kmin = min(int(rkeys.min()), int(lkeys.min()))
+        kmax = max(int(rkeys.max()), int(lkeys.max()))
+        span = kmax - kmin + 1
+    if 0 < span <= 4 * (len(lkeys) + len(rkeys)):
+        # Dense key range: bincount + cumsum offsets replace the two
+        # binary searches (random-access searchsorted over millions of
+        # probes is the profile's hot spot).
+        kcounts = np.bincount(rkeys - kmin, minlength=span)
+        key_starts = np.zeros(span + 1, dtype=np.int64)
+        np.cumsum(kcounts, out=key_starts[1:])
+        lo = key_starts[lkeys - kmin]
+        counts = kcounts[lkeys - kmin]
+        hi = lo + counts
+    else:
+        srk = rkeys[order]
+        lo = np.searchsorted(srk, lkeys, side="left")
+        hi = np.searchsorted(srk, lkeys, side="right")
+        counts = hi - lo
     if op.how == "left":
         counts = np.maximum(counts, 1)  # unmatched keep one null row
         unmatched = (hi - lo) == 0
@@ -1320,9 +1336,13 @@ def _join_host_nm(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
     np.cumsum(counts, out=starts[1:])
     l_idx = np.repeat(np.arange(left.length, dtype=np.int64), counts)
     within = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1], counts)
-    r_idx = order[np.clip(np.repeat(lo, counts) + within, 0, max(len(srk) - 1, 0))] \
-        if len(srk) else np.full(total, -1, dtype=np.int64)
-    if op.how == "left" and len(srk):
+    if len(rkeys):
+        r_idx = order[
+            np.clip(np.repeat(lo, counts) + within, 0, len(rkeys) - 1)
+        ]
+    else:
+        r_idx = np.full(total, -1, dtype=np.int64)
+    if op.how == "left" and len(rkeys):
         r_idx = np.where(np.repeat(unmatched, counts), -1, r_idx)
     return _assemble_join_host(left, right, op, l_idx, r_idx)
 
