@@ -81,7 +81,10 @@ def run_pem() -> int:
     if os.environ.get("PIXIE_TPU_SEQGEN"):
         coll.register_source(SeqGenConnector())
     coll.run_as_thread()
-    print(f"[pem] {agent.agent_id} -> {host}:{port}", flush=True)
+    obs = _agent_obs(agent, extra=lambda: {"collector": dict(coll.stats)})
+    print(
+        f"[pem] {agent.agent_id} -> {host}:{port} obs :{obs}", flush=True
+    )
     _wait_forever()
     return 0
 
@@ -93,9 +96,29 @@ def run_kelvin() -> int:
     host, port = _broker_addr()
     bus = RemoteBus(host, port)
     agent = KelvinAgent(bus, _agent_id("kelvin")).start()
-    print(f"[kelvin] {agent.agent_id} -> {host}:{port}", flush=True)
+    obs = _agent_obs(agent)
+    print(
+        f"[kelvin] {agent.agent_id} -> {host}:{port} obs :{obs}", flush=True
+    )
     _wait_forever()
     return 0
+
+
+def _agent_obs(agent, extra=None) -> int:
+    """healthz/statusz/metrics for an agent process; returns the port."""
+    from .services.observability import ObservabilityServer
+
+    def statusz():
+        out = {
+            "agent_id": agent.agent_id,
+            "tables": sorted(agent.engine.table_store.table_names()),
+        }
+        if extra is not None:
+            out.update(extra())
+        return out
+
+    obs = ObservabilityServer(statusz_fn=statusz)
+    return obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "0")))
 
 
 def _wait_forever() -> None:

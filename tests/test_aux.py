@@ -183,3 +183,28 @@ class TestNetworkStats:
             "px.display(s)"
         )["output"].to_pydict()
         assert "lo" in list(out["pod_id"])  # loopback always present
+
+
+class TestDeployRoles:
+    def test_agent_obs_server(self):
+        from pixie_tpu import deploy
+        from pixie_tpu.services.agent import PEMAgent
+        from pixie_tpu.services.msgbus import MessageBus
+        import json as _json
+        import urllib.request
+
+        bus = MessageBus()
+        agent = PEMAgent(bus, "pem-obs", heartbeat_interval_s=60.0).start()
+        try:
+            port = deploy._agent_obs(agent, extra=lambda: {"k": 1})
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz", timeout=5
+            ) as r:
+                st = _json.loads(r.read())
+            assert st["agent_id"] == "pem-obs" and st["k"] == 1
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ) as r:
+                assert r.status == 200
+        finally:
+            agent.stop()
