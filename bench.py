@@ -123,6 +123,26 @@ def _run_shape_proc(platform: str, shape: str, rows: int | None,
     return None
 
 
+def _tpu_alive(timeout_s: float = 90.0) -> bool:
+    """Pre-flight: can a fresh process even initialize the TPU backend?
+    The tunnel relay can enter a stuck-claim state where jax.devices()
+    hangs forever — burning every shape's timeout on a dead backend
+    would leave no budget for the CPU fallbacks."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform == 'tpu'"],
+            env={**os.environ, "JAX_COMPILATION_CACHE_DIR": CACHE_DIR},
+            cwd=REPO, timeout=timeout_s,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def launcher() -> int:
     budget = float(os.environ.get("PIXIE_TPU_BENCH_BUDGET", 540))
     t0 = time.monotonic()
@@ -137,6 +157,9 @@ def launcher() -> int:
     head_shape = next((s for s in want if s in ALL_SHAPES), "http_stats")
     shapes: dict = {}
     device = None
+    tpu_ok = _tpu_alive()
+    if not tpu_ok:
+        log("[bench] TPU backend unreachable (pre-flight); CPU-only run")
 
     def left():
         return budget - (time.monotonic() - t0)
@@ -154,17 +177,23 @@ def launcher() -> int:
         cap = 240.0 if is_head else 150.0
         timeout = min(cap, left() - (30 if is_head else 10))
         rows = int(rows_env) if rows_env else None
-        res = _run_shape_proc("tpu", shape, rows, timeout)
-        if res is None and is_head and left() > 120:
-            log("[bench] headline retry")
-            time.sleep(5)
-            res = _run_shape_proc("tpu", shape, rows, min(cap, left() - 60))
+        res = None
+        if tpu_ok:
+            res = _run_shape_proc("tpu", shape, rows, timeout)
+            if res is None and is_head and left() > 120:
+                log("[bench] headline retry")
+                time.sleep(5)
+                res = _run_shape_proc("tpu", shape, rows, min(cap, left() - 60))
         if res is None and left() > 60:
-            # CPU fallback (small rows) so every shape reports a number
-            # even with the tunnel down.
+            # CPU fallback so every shape reports a number even with the
+            # tunnel down; with no TPU attempts burning budget, the
+            # fallback gets bigger replays (throughput amortizes).
+            fb_rows = rows or (
+                4 * 1024 * 1024 if not tpu_ok else 1024 * 1024
+            )
             res = _run_shape_proc(
-                "cpu", shape, rows or 1024 * 1024,
-                max(60.0, min(150.0, left() - 5)),
+                "cpu", shape, fb_rows,
+                max(60.0, min(200.0 if not tpu_ok else 150.0, left() - 5)),
             )
         if res is None:
             shapes[shape] = {"error": "subprocess failed or timed out"}
