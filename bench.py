@@ -189,7 +189,7 @@ def launcher() -> int:
             # tunnel down; with no TPU attempts burning budget, the
             # fallback gets bigger replays (throughput amortizes).
             fb_rows = rows or (
-                4 * 1024 * 1024 if not tpu_ok else 1024 * 1024
+                16 * 1024 * 1024 if not tpu_ok else 1024 * 1024
             )
             res = _run_shape_proc(
                 "cpu", shape, fb_rows,
