@@ -59,32 +59,34 @@ def _print_batch(name: str, hb, fmt: str) -> None:
 
 
 def _py(v):
-    return v.item() if hasattr(v, "item") else v
+    from .api import _py as api_py
+
+    return api_py(v)
 
 
-def _broker_request(addr: str, topic: str, msg: dict, timeout_s: float):
-    from .services.netbus import RemoteBus
+def _client(addr: str):
+    from .api import Client
 
     host, _, port = addr.rpartition(":")
-    bus = RemoteBus(host or "127.0.0.1", int(port))
-    try:
-        return bus.request(topic, msg, timeout_s=timeout_s)
-    finally:
-        bus.close()
+    return Client(host or "127.0.0.1", int(port))
 
 
 def cmd_run(args) -> int:
     query = _load_query(args.script)
     if args.broker:
-        res = _broker_request(
-            args.broker, "broker.execute",
-            {"query": query, "timeout_s": args.timeout,
-             "max_output_rows": args.max_rows},
-            timeout_s=args.timeout + 5,
-        )
-        if not res.get("ok"):
-            print(f"error: {res.get('error')}", file=sys.stderr)
-            return 1
+        from .api import ScriptExecutionError
+
+        with _client(args.broker) as client:
+            try:
+                res = client._request(
+                    "broker.execute",
+                    {"query": query, "timeout_s": args.timeout,
+                     "max_output_rows": args.max_rows},
+                    timeout_s=args.timeout + 5,
+                )
+            except ScriptExecutionError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
         for name, hb in sorted(res["tables"].items()):
             _print_batch(name, hb, args.output)
         if args.output != "json":
@@ -144,8 +146,8 @@ def cmd_explain(args) -> int:
 
     query = _load_query(args.script)
     if args.broker:
-        res = _broker_request(args.broker, "broker.schemas", {}, 10.0)
-        schemas = res.get("schemas", {})
+        with _client(args.broker) as client:
+            schemas = client.schemas()
     else:
         # Offline explain: synthesize schemas for the canonical tables the
         # script references (shipped output-table relations).
@@ -160,15 +162,17 @@ def cmd_explain(args) -> int:
 
 
 def cmd_tables(args) -> int:
-    res = _broker_request(args.broker, "broker.schemas", {}, 10.0)
-    for name, rel in sorted(res.get("schemas", {}).items()):
+    with _client(args.broker) as client:
+        schemas = client.schemas()
+    for name, rel in sorted(schemas.items()):
         print(f"{name}: {rel}")
     return 0
 
 
 def cmd_agents(args) -> int:
-    res = _broker_request(args.broker, "broker.agents", {}, 10.0)
-    for a in res.get("agents", []):
+    with _client(args.broker) as client:
+        agents = client.agents()
+    for a in agents:
         print(
             f"{a['agent_id']:14s} asid={a['asid']:<4d} {a['kind']:6s} "
             f"hb={a['last_heartbeat_s']:.1f}s tables={a['num_tables']}"

@@ -71,9 +71,11 @@ class _StreamParser:
                     raise ValueError(start)
                 msg.status = int(start[1])
         except ValueError:
-            # Resync: skip to the next CRLFCRLF boundary (parse.cc's
-            # recovery on garbage bytes).
-            return None, 0 if head_end < 0 else self._skip(head_end + 4)
+            # Resync: drop through the CRLFCRLF boundary and keep parsing
+            # — valid messages behind the garbage must still emit this
+            # call (parse.cc's recovery on garbage bytes).
+            self._parse_errors = getattr(self, "_parse_errors", 0) + 1
+            return self._parse_one_after_skip(head_end + 4, ts_ns)
         for ln in lines[1:]:
             k, _, v = ln.partition(":")
             msg.headers[k.strip().lower()] = v.strip()
@@ -94,9 +96,9 @@ class _StreamParser:
             return msg, end + 5
         return msg, body_start  # no body (the telemetry common case)
 
-    def _skip(self, n: int):
+    def _parse_one_after_skip(self, n: int, ts_ns: int):
         self._buf = self._buf[n:]
-        return None, 0
+        return self._parse_one(ts_ns)
 
 
 class HTTPStitcher:

@@ -131,7 +131,7 @@ class DistributedEngine(Engine):
         if self.distributed_state is None:
             return super().execute_plan(
                 plan, bridge_inputs=bridge_inputs, analyze=analyze,
-                materialize=materialize,
+                materialize=materialize, cancel=cancel,
             )
 
         from ..exec.engine import QueryError

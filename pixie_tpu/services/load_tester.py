@@ -22,11 +22,14 @@ class LoadReport:
     wall_s: float = 0.0
 
     def percentile(self, p: float) -> float:
+        """Nearest-rank percentile: ceil(p/100 * N)-th smallest."""
         if not self.latencies_s:
             return float("nan")
+        import math
+
         xs = sorted(self.latencies_s)
-        i = min(len(xs) - 1, int(p / 100.0 * len(xs)))
-        return xs[i]
+        i = max(math.ceil(p / 100.0 * len(xs)) - 1, 0)
+        return xs[min(i, len(xs) - 1)]
 
     def to_dict(self) -> dict:
         return {
