@@ -252,3 +252,44 @@ class TestJoinScale:
         # Per-probe-row emission count matches numpy fan-out.
         emitted = np.bincount(p_idx[sel], minlength=nb)
         np.testing.assert_array_equal(emitted[:n], cnt[pk[:n]])
+
+
+class TestHostNMJoinMultiKey:
+    def test_two_key_nm_join_above_threshold(self, monkeypatch):
+        """Multi-plane keys route through the dense-id (np.unique) path of
+        the host N:M join on the CPU backend."""
+        import jax
+        import numpy as np
+        import pixie_tpu.exec.engine as eng_mod
+        from pixie_tpu.exec.engine import Engine
+
+        if jax.default_backend() == "tpu":  # host path is CPU-only
+            return
+        monkeypatch.setattr(eng_mod, "DEVICE_JOIN_MIN_ROWS", 4)
+        eng = Engine(window_rows=1 << 12)
+        n = 3000
+        rng = np.random.default_rng(4)
+        a = rng.integers(0, 8, n)
+        b = rng.integers(0, 5, n)
+        v = rng.integers(0, 100, n)
+        eng.append_data("l", {"time_": np.arange(n, dtype=np.int64),
+                              "a": a, "b": b})
+        eng.append_data("r", {"time_": np.arange(n, dtype=np.int64),
+                              "a": a, "b": b, "v": v})
+        out = eng.execute_query(
+            "import px\n"
+            "l = px.DataFrame(table='l')\n"
+            "r = px.DataFrame(table='r')\n"
+            "g = l.merge(r, how='inner', left_on=['a', 'b'],"
+            " right_on=['a', 'b'], suffixes=['', '_r'])\n"
+            "s = g.groupby('a').agg(n=('v', px.count))\npx.display(s)"
+        )["output"].to_pydict()
+        # numpy truth: inner join on (a, b) pair counts.
+        import collections
+
+        cnt = collections.Counter(zip(a, b))
+        expect = collections.Counter()
+        for (ka, kb), c in cnt.items():
+            expect[ka] += c * c
+        got = dict(zip(out["a"].tolist(), out["n"].tolist()))
+        assert got == dict(expect)
