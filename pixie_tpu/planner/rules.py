@@ -42,7 +42,9 @@ ALL = None  # "requires every column" marker
 
 def run_rules(plan: Plan, max_output_rows: int = 10_000) -> Plan:
     prune_unreachable(plan)
+    fold_constants(plan)
     fuse_quantile_plucks(plan)
+    push_filters_below_maps(plan)
     prune_unused_columns(plan)
     add_limit_to_result_sinks(plan, max_output_rows)
     return plan
@@ -284,6 +286,104 @@ def add_limit_to_result_sinks(plan: Plan, max_rows: int) -> None:
 
 
 # -- reachability -------------------------------------------------------------
+_FOLDABLE = {
+    "add": lambda a, b: a + b,
+    "subtract": lambda a, b: a - b,
+    "multiply": lambda a, b: a * b,
+    "lessThan": lambda a, b: a < b,
+    "lessThanEqual": lambda a, b: a <= b,
+    "greaterThan": lambda a, b: a > b,
+    "greaterThanEqual": lambda a, b: a >= b,
+    "equal": lambda a, b: a == b,
+    "notEqual": lambda a, b: a != b,
+    "logicalAnd": lambda a, b: a and b,
+    "logicalOr": lambda a, b: a or b,
+}
+
+
+def fold_constants(plan: Plan) -> None:
+    """Evaluate literal-only scalar subtrees at compile time (the
+    reference's constant-folding analyzer pass). Only pure arithmetic /
+    comparison / boolean ops fold — everything else keeps its runtime
+    semantics (e.g. divide's inf-on-zero stays on device)."""
+    from ..types.dtypes import DataType
+
+    def fold(e):
+        if not (isinstance(e, FuncCall) and e.name in _FOLDABLE):
+            return e
+        if not all(isinstance(a, Literal) for a in e.args) or len(e.args) != 2:
+            return e
+        a, b = e.args
+        if a.dtype != b.dtype or a.dtype not in (
+            DataType.INT64, DataType.FLOAT64, DataType.BOOLEAN,
+            DataType.TIME64NS,
+        ):
+            return e
+        try:
+            v = _FOLDABLE[e.name](a.value, b.value)
+        except Exception:
+            return e
+        if isinstance(v, bool):
+            return Literal(v, DataType.BOOLEAN)
+        return Literal(v, a.dtype)
+
+    for node in plan.nodes.values():
+        op = node.op
+        if isinstance(op, MapOp):
+            node.op = MapOp(
+                exprs=tuple((n, _rewrite_expr(e, fold)) for n, e in op.exprs)
+            )
+        elif isinstance(op, FilterOp):
+            node.op = FilterOp(predicate=_rewrite_expr(op.predicate, fold))
+
+
+def push_filters_below_maps(plan: Plan) -> None:
+    """Swap Filter(Map(x)) -> Map(Filter'(x)) when every column the
+    predicate references is a pure pass-through of the map (the
+    reference's filter-pushdown pass). Within one fused fragment the win
+    is evaluation-order freedom for XLA; across a materialization
+    boundary it prunes rows before the map computes."""
+    consumers = _consumers(plan)
+    for nid in list(plan.topo_order()):
+        node = plan.nodes[nid]
+        if not isinstance(node.op, FilterOp) or not node.inputs:
+            continue
+        up_id = node.inputs[0]
+        up = plan.nodes[up_id]
+        if not isinstance(up.op, MapOp) or len(consumers.get(up_id, [])) != 1:
+            continue
+        # Predicate columns must map 1:1 onto upstream columns.
+        pred_cols = _expr_columns(node.op.predicate, set())
+        renames = {
+            n: e.name
+            for n, e in up.op.exprs
+            if isinstance(e, ColumnRef)
+        }
+        if not pred_cols <= set(renames):
+            continue
+
+        def rename(e):
+            if isinstance(e, ColumnRef):
+                return ColumnRef(renames[e.name])
+            return e
+
+        new_pred = _rewrite_expr(node.op.predicate, rename)
+        # Rewire in place, keeping ids stable for downstream consumers:
+        # nid (what consumers point at) becomes the Map; up_id becomes
+        # the renamed Filter over the map's old input.
+        x_inputs = list(up.inputs)
+        map_op, map_rel = up.op, up.relation
+        up.op = FilterOp(predicate=new_pred)
+        up.inputs = x_inputs
+        up.relation = (
+            plan.nodes[x_inputs[0]].relation if x_inputs else None
+        )
+        node.op = map_op
+        node.inputs = [up_id]
+        node.relation = map_rel
+
+
+
 def prune_unreachable(plan: Plan) -> None:
     from ..exec.plan import OTelExportSinkOp, TableSinkOp
 

@@ -309,3 +309,72 @@ px.display(latency_by_path('-300s'), 'by_path')
     assert compiled.funcs["latency_by_path"].doc == "Per-path latency stats."
     out = run(engine, q)["by_path"].to_pydict()
     assert len(out["req_path"]) == 3
+
+
+class TestNewRules:
+    def test_constant_folding(self):
+        from pixie_tpu.exec.plan import FilterOp, FuncCall, Literal, MapOp
+        from pixie_tpu.planner import CompilerState, compile_pxl
+        from pixie_tpu.types import DataType
+        from pixie_tpu.types.relation import Relation
+
+        from pixie_tpu.udf.registry import default_registry
+
+        state = CompilerState(
+            schemas={"t": Relation([("time_", DataType.TIME64NS),
+                                    ("v", DataType.INT64)])},
+            registry=default_registry(),
+        )
+        plan = compile_pxl(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df = df[df.v > 2 + 3]\npx.display(df)",
+            state,
+        ).plan
+        flt = next(n.op for n in plan.nodes.values()
+                   if isinstance(n.op, FilterOp))
+        # 2 + 3 folded into lit(5) at compile time.
+        assert "lit(5)" in repr(flt.predicate)
+        assert "add" not in repr(flt.predicate)
+
+    def test_filter_pushdown_below_map(self):
+        from pixie_tpu.exec.plan import FilterOp, MapOp
+        from pixie_tpu.planner import CompilerState, compile_pxl
+        from pixie_tpu.types import DataType
+        from pixie_tpu.types.relation import Relation
+
+        from pixie_tpu.udf.registry import default_registry
+
+        state = CompilerState(
+            schemas={"t": Relation([("time_", DataType.TIME64NS),
+                                    ("v", DataType.INT64)])},
+            registry=default_registry(),
+        )
+        plan = compile_pxl(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df.w = df.v * 2\n"
+            "df = df[df.v > 10]\npx.display(df)",
+            state,
+        ).plan
+        order = [type(plan.nodes[n].op).__name__ for n in plan.topo_order()]
+        fi, mi = order.index("FilterOp"), order.index("MapOp")
+        assert fi < mi, order  # filter now runs before the projection
+
+    def test_pushdown_correctness_end_to_end(self):
+        import numpy as np
+
+        from pixie_tpu.exec.engine import Engine
+
+        eng = Engine(window_rows=1 << 10)
+        n = 5000
+        eng.append_data("t", {
+            "time_": np.arange(n, dtype=np.int64),
+            "v": np.arange(n, dtype=np.int64) % 100,
+        })
+        out = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df.w = df.v * 2\n"
+            "df = df[df.v > 90]\n"
+            "s = df.groupby('v').agg(n=('w', px.count))\npx.display(s)"
+        )["output"].to_pydict()
+        assert sorted(out["v"]) == list(range(91, 100))
+        assert all(c == 50 for c in out["n"])
