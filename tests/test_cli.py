@@ -227,3 +227,84 @@ class TestPythonAPI:
                     )
         finally:
             server.close()
+
+
+@pytest.mark.slow
+class TestDeployEndToEnd:
+    def test_three_process_cluster_via_cli(self, tmp_path):
+        """broker + pem + kelvin as REAL OS processes (deploy.py mains),
+        seq-gen ingest on the pem, query + introspection via the CLI
+        over the netbus — the full product loop."""
+        import os
+        import signal
+        import socket as _socket
+        import subprocess
+        import sys
+        import time as _time
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = {
+            **os.environ,
+            "PALLAS_AXON_POOL_IPS": "",
+            "JAX_PLATFORMS": "cpu",
+            "PIXIE_TPU_NETBUS_PORT": str(port),
+            "PIXIE_TPU_BROKER": f"127.0.0.1:{port}",
+            "PIXIE_TPU_SEQGEN": "1",
+        }
+        qfile = tmp_path / "q.pxl"
+        qfile.write_text(
+            "import px\n"
+            "df = px.DataFrame(table='sequences')\n"
+            "s = df.groupby('modulo10').agg(n=('x', px.count))\n"
+            "px.display(s)\n"
+        )
+        procs = []
+        try:
+            for role, aid in (("broker", ""), ("pem", "pem-e2e"),
+                              ("kelvin", "kelvin-e2e")):
+                e = dict(env)
+                if aid:
+                    e["PIXIE_TPU_AGENT_ID"] = aid
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "pixie_tpu.deploy", role],
+                    env=e, cwd=repo,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                ))
+                _time.sleep(1.5 if role == "broker" else 0.3)
+            deadline = _time.time() + 90
+            out = ""
+            ok = False
+            while _time.time() < deadline and not ok:
+                r = subprocess.run(
+                    [sys.executable, "-m", "pixie_tpu.cli", "run",
+                     "--broker", f"127.0.0.1:{port}", "--timeout", "30",
+                     str(qfile)],
+                    env=env, cwd=repo,
+                    capture_output=True, text=True, timeout=90,
+                )
+                out = r.stdout + r.stderr
+                ok = r.returncode == 0 and "output" in r.stdout
+                if not ok:
+                    _time.sleep(3)
+            assert ok, out[-2000:]
+            r = subprocess.run(
+                [sys.executable, "-m", "pixie_tpu.cli", "agents",
+                 "--broker", f"127.0.0.1:{port}"],
+                env=env, cwd=repo, capture_output=True, text=True,
+                timeout=60,
+            )
+            assert "pem-e2e" in r.stdout and "kelvin-e2e" in r.stdout, (
+                r.stdout + r.stderr
+            )
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
