@@ -208,3 +208,34 @@ class TestDeployRoles:
                 assert r.status == 200
         finally:
             agent.stop()
+
+
+class TestWatcherQueryIntegration:
+    def test_watched_updates_resolve_in_queries(self):
+        """ResourceUpdates applied through the watcher are visible to
+        metadata UDFs in the next query (watcher -> state -> rebind)."""
+        from pixie_tpu.metadata.state import UPID
+        from pixie_tpu.metadata.watcher import MetadataWatcher
+
+        w = MetadataWatcher()
+        w.apply_all([
+            {"rv": 1, "kind": "pod", "uid": "p-1", "name": "api",
+             "namespace": "prod"},
+            {"rv": 2, "kind": "process", "upid": "1:500:7",
+             "pod_uid": "p-1"},
+        ])
+        eng = Engine()
+        eng.set_metadata_state(w.state)
+        u = UPID(asid=1, pid=500, start_ticks=7)
+        n = 100
+        eng.append_data("t", {
+            "time_": np.arange(n, dtype=np.int64),
+            "upid": [u.value] * n,
+            "v": np.arange(n, dtype=np.int64),
+        })
+        out = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df.pod = px.upid_to_pod_name(df.upid)\n"
+            "s = df.groupby('pod').agg(n=('v', px.count))\npx.display(s)"
+        )["output"].to_pydict()
+        assert list(out["pod"]) == ["prod/api"] and int(out["n"][0]) == n
