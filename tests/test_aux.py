@@ -224,8 +224,16 @@ class TestWatcherQueryIntegration:
             {"rv": 2, "kind": "process", "upid": "1:500:7",
              "pod_uid": "p-1"},
         ])
+        from pixie_tpu.types.dtypes import DataType
+        from pixie_tpu.types.relation import Relation
+
         eng = Engine()
         eng.set_metadata_state(w.state)
+        eng.create_table("t", Relation([
+            ("time_", DataType.TIME64NS),
+            ("upid", DataType.UINT128),
+            ("v", DataType.INT64),
+        ]))
         u = UPID(asid=1, pid=500, start_ticks=7)
         n = 100
         eng.append_data("t", {
