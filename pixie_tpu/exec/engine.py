@@ -20,6 +20,7 @@ from __future__ import annotations
 import functools
 import itertools
 import json
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -111,6 +112,46 @@ def _expand_dense_payload(p, group_rel, key_plane_index):
     return dataclasses.replace(
         p, state={**p.state, "keys": tuple(keys)}, dense_domains=()
     )
+
+
+def _compact_payload(p):
+    """Shrink an expanded dense-domain payload to its live slots.
+
+    A dense state is domain-sized (up to ``dense_domain_limit`` slots)
+    however few groups are live; merging every payload at that capacity
+    is a large avoidable cost for small aggregates. Live slots compact to
+    the front (padded to a power-of-two bucket with neutral invalid
+    slots, so merge-fragment compiles stay shape-bucketed).
+    """
+    import dataclasses
+
+    import jax
+
+    valid = np.asarray(p.state["valid"])
+    g = len(valid)
+    live = int(valid.sum())
+    cap = bucket_capacity(max(live, 1))
+    if cap >= g:
+        return p
+    idx = np.nonzero(valid)[0]
+    if len(idx) < cap:
+        # Invalid slots hold uda-neutral carries by construction, so any
+        # one of them is safe padding.
+        fill = int(np.nonzero(~valid)[0][0])
+        idx = np.concatenate(
+            [idx, np.full(cap - len(idx), fill, dtype=np.int64)]
+        )
+
+    def take(leaf):
+        a = np.asarray(leaf)
+        return a[idx] if a.ndim and a.shape[0] == g else a
+
+    return dataclasses.replace(p, state={
+        "keys": tuple(take(k) for k in p.state["keys"]),
+        "valid": valid[idx],
+        "carries": jax.tree_util.tree_map(take, p.state["carries"]),
+        "overflow": p.state["overflow"],
+    })
 
 
 class QueryError(Exception):
@@ -230,6 +271,9 @@ class Engine:
         self.last_stats = None
         self._query_stats = None
         self._cancel = None  # per-query cancel event (execute_plan arg)
+        # One query at a time; reentrant so subclasses can hold it across
+        # their own engine-state mutations around super().execute_plan().
+        self._exec_guard = threading.RLock()
         self.last_table_sinks: dict = {}  # {table: rows} from TableSinkOps
 
     @property
@@ -311,7 +355,21 @@ class Engine:
 
         ``analyze`` records per-fragment, per-stage execution stats
         (exec_node.h:40 ExecNodeStats analog) on ``self.last_stats``.
+
+        One query at a time per Engine: the cancel handle and stats are
+        engine-scoped, so concurrent ``execute_plan`` calls (the Agent's
+        bus dispatcher threads can overlap execute/merge/bridge work)
+        serialize on an engine lock rather than corrupting each other's
+        cancel handles.
         """
+        with self._exec_guard:
+            return self._execute_plan_guarded(
+                plan, bridge_inputs, analyze, materialize, cancel
+            )
+
+    def _execute_plan_guarded(
+        self, plan, bridge_inputs, analyze, materialize, cancel
+    ) -> dict:
         self._cancel = cancel
         if analyze:
             from .analyze import QueryStats
@@ -579,10 +637,35 @@ class Engine:
         from .fragment import _bind_pre_stage, _split_chain
 
         p0 = pending.payloads[0]
-        # Agents may have rebucketed independently; merge at the largest
-        # capacity (smaller states pad with neutral slots below). Dense-
-        # domain states may be larger than any max_groups — their slot
-        # count bounds the distinct groups, so include it.
+        # The merge fragment is compiled WITHOUT dense mode: agents encode
+        # against their own dictionaries, so dense slot spaces are not
+        # comparable across payloads — expand each dense state to explicit
+        # key planes (then compact to live slots: a dense state is
+        # domain-sized regardless of how few groups are live, and the
+        # merge must not inherit that capacity) and realign through the
+        # generic (sort-space) path. The group relation / key planes come
+        # from binding the pre-stage directly — no compile needed before
+        # the payload sizes are known.
+        from ..types.dtypes import device_dtypes
+
+        pre0, agg0, _post0, _limit0 = _split_chain(list(p0.chain))
+        _, rel1, _ = _bind_pre_stage(
+            pre0, p0.input_relation, dict(p0.input_dicts), self.registry
+        )
+        key_plane_index = tuple(
+            (c, i)
+            for c in agg0.group_cols
+            for i in range(len(device_dtypes(rel1.col_type(c))))
+        )
+        group_rel = rel1
+        pending = _PendingAggBridge(payloads=[
+            _compact_payload(_expand_dense_payload(p, rel1, key_plane_index))
+            for p in pending.payloads
+        ])
+        p0 = pending.payloads[0]
+        # Merge at the largest payload capacity (smaller states pad with
+        # neutral slots below); overflow rebucketing grows it if the
+        # union of live groups spills.
         g = max(
             op.max_groups
             for p in pending.payloads
@@ -594,21 +677,10 @@ class Engine:
             dataclasses.replace(op, max_groups=g) if isinstance(op, AggOp) else op
             for op in p0.chain
         ]
-        # The merge fragment is compiled WITHOUT dense mode: agents encode
-        # against their own dictionaries, so dense slot spaces are not
-        # comparable across payloads — expand each dense state to explicit
-        # key planes and realign through the generic (sort-space) path.
         frag = compile_fragment(
             chain, p0.input_relation, dict(p0.input_dicts), self.registry,
             allow_dense=False,
         )
-        key_plane_index = frag.key_plane_index
-        group_rel = frag.group_relation
-        pending = _PendingAggBridge(payloads=[
-            _expand_dense_payload(p, group_rel, key_plane_index)
-            for p in pending.payloads
-        ])
-        p0 = pending.payloads[0]
         if frag.string_carry_sources and len(pending.payloads) > 1:
             # String ids inside a CARRY (not a group key) cannot be
             # realigned after the fact; reject unless every agent encoded
@@ -633,12 +705,11 @@ class Engine:
                                 "garbage. Share one dictionary or aggregate "
                                 "after merge."
                             )
-        pre, _agg, _post, _limit = _split_chain(list(p0.chain))
         # Per-agent post-pre-stage dictionaries for the group columns.
         per_agent_dicts = []
         for p in pending.payloads:
             _, rel1_a, dicts1 = _bind_pre_stage(
-                list(pre), p.input_relation, dict(p.input_dicts), self.registry
+                pre0, p.input_relation, dict(p.input_dicts), self.registry
             )
             if tuple(rel1_a.items()) != tuple(group_rel.items()):
                 raise QueryError(

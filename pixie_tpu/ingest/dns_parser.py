@@ -90,11 +90,26 @@ class DNSStitcher:
     """Pairs queries with responses by transaction id; emits dns_events
     records (header/body JSON columns, the reference table's encoding)."""
 
+    # Unanswered queries expire after this long (the reference socket
+    # tracer similarly ages out connection-tracker state); the map is also
+    # hard-capped so a txid flood can't grow it without bound.
+    PENDING_TTL_NS = 30 * 1_000_000_000
+    PENDING_MAX = 4096
+
     def __init__(self, pod: str = ""):
         self.pod = pod
         self._pending: dict[int, tuple[dict, int]] = {}
         self.records: list[dict] = []
         self.parse_errors = 0
+
+    def _expire(self, now_ns: int) -> None:
+        cutoff = now_ns - self.PENDING_TTL_NS
+        if len(self._pending) > 64:
+            self._pending = {
+                txid: v for txid, v in self._pending.items() if v[1] >= cutoff
+            }
+        while len(self._pending) >= self.PENDING_MAX:
+            self._pending.pop(next(iter(self._pending)))
 
     def feed(self, payload: bytes, ts_ns: Optional[int] = None) -> int:
         ts = ts_ns if ts_ns is not None else time.time_ns()
@@ -104,6 +119,7 @@ class DNSStitcher:
             self.parse_errors += 1
             return 0
         if not msg["is_response"]:
+            self._expire(ts)
             self._pending[msg["txid"]] = (msg, ts)
             return 0
         req = self._pending.pop(msg["txid"], None)

@@ -54,28 +54,30 @@ class _StreamParser:
         return out
 
     def _parse_one(self, ts_ns: int):
-        head_end = self._buf.find(b"\r\n\r\n")
-        if head_end < 0:
-            return None, 0
-        head = self._buf[:head_end].decode("latin-1")
-        lines = head.split("\r\n")
-        start = lines[0].split(" ", 2)
-        msg = HTTPMessage(is_request=self.is_request, ts_ns=ts_ns)
-        try:
-            if self.is_request:
-                if len(start) < 3 or not start[2].startswith("HTTP/"):
-                    raise ValueError(start)
-                msg.method, msg.path = start[0], start[1]
-            else:
-                if len(start) < 2 or not start[0].startswith("HTTP/"):
-                    raise ValueError(start)
-                msg.status = int(start[1])
-        except ValueError:
-            # Resync: drop through the CRLFCRLF boundary and keep parsing
-            # — valid messages behind the garbage must still emit this
-            # call (parse.cc's recovery on garbage bytes).
-            self._parse_errors = getattr(self, "_parse_errors", 0) + 1
-            return self._parse_one_after_skip(head_end + 4, ts_ns)
+        # Garbage-resync loop, not recursion: a chunk of binary data on a
+        # tapped connection can hold thousands of CRLFCRLF-delimited
+        # blocks (parse.cc's recovery on garbage bytes skips them all).
+        while True:
+            head_end = self._buf.find(b"\r\n\r\n")
+            if head_end < 0:
+                return None, 0
+            head = self._buf[:head_end].decode("latin-1")
+            lines = head.split("\r\n")
+            start = lines[0].split(" ", 2)
+            msg = HTTPMessage(is_request=self.is_request, ts_ns=ts_ns)
+            try:
+                if self.is_request:
+                    if len(start) < 3 or not start[2].startswith("HTTP/"):
+                        raise ValueError(start)
+                    msg.method, msg.path = start[0], start[1]
+                else:
+                    if len(start) < 2 or not start[0].startswith("HTTP/"):
+                        raise ValueError(start)
+                    msg.status = int(start[1])
+                break
+            except ValueError:
+                self._parse_errors = getattr(self, "_parse_errors", 0) + 1
+                self._buf = self._buf[head_end + 4:]
         for ln in lines[1:]:
             k, _, v = ln.partition(":")
             msg.headers[k.strip().lower()] = v.strip()
@@ -96,27 +98,43 @@ class _StreamParser:
             return msg, end + 5
         return msg, body_start  # no body (the telemetry common case)
 
-    def _parse_one_after_skip(self, n: int, ts_ns: int):
-        self._buf = self._buf[n:]
-        return self._parse_one(ts_ns)
-
 
 class HTTPStitcher:
     """Pairs requests with responses per connection; emits http_events
     records (``stitcher.cc`` ProcessMessages)."""
 
+    # Idle connections expire (the reference expires ConnTrackers after
+    # an inactivity window); per-connection pending requests are capped so
+    # a request flood with no responses can't grow without bound.
+    CONN_IDLE_TTL_NS = 300 * 1_000_000_000
+    CONN_MAX = 4096
+    PENDING_PER_CONN = 512
+
     def __init__(self, service: str = "", pod: str = ""):
         self.service = service
         self.pod = pod
-        self._conns: dict = {}  # conn_id -> (req parser, resp parser, pending)
+        # conn_id -> [req parser, resp parser, pending deque, last_ts]
+        self._conns: dict = {}
         self.records: list[dict] = []
         self.parse_errors = 0
 
-    def _conn(self, conn_id):
+    def _expire(self, now_ns: int) -> None:
+        cutoff = now_ns - self.CONN_IDLE_TTL_NS
+        if len(self._conns) > 64:
+            self._conns = {
+                cid: c for cid, c in self._conns.items() if c[3] >= cutoff
+            }
+        while len(self._conns) >= self.CONN_MAX:
+            lru = min(self._conns, key=lambda cid: self._conns[cid][3])
+            self._conns.pop(lru)
+
+    def _conn(self, conn_id, now_ns: int):
         c = self._conns.get(conn_id)
         if c is None:
-            c = (_StreamParser(True), _StreamParser(False), deque())
+            self._expire(now_ns)
+            c = [_StreamParser(True), _StreamParser(False), deque(), now_ns]
             self._conns[conn_id] = c
+        c[3] = now_ns
         return c
 
     def feed(
@@ -125,10 +143,20 @@ class HTTPStitcher:
     ) -> int:
         """Feed one captured chunk; returns records emitted."""
         ts = ts_ns if ts_ns is not None else time.time_ns()
-        req_p, resp_p, pending = self._conn(conn_id)
+        req_p, resp_p, pending, _ = self._conn(conn_id, ts)
         emitted = 0
         if is_request:
             for m in req_p.feed(data, ts):
+                if len(pending) >= self.PENDING_PER_CONN:
+                    # Pairing is positional, so dropping any one entry
+                    # would silently mispair every later response on this
+                    # connection. Kill the connection instead (the
+                    # reference disables a ConnTracker it can no longer
+                    # trust): its state is discarded and the drops are
+                    # counted; later chunks start a fresh tracker.
+                    self.parse_errors += len(pending) + 1
+                    self._conns.pop(conn_id, None)
+                    return emitted
                 pending.append(m)
         else:
             for m in resp_p.feed(data, ts):

@@ -3,13 +3,18 @@
 Reference parity: ``src/carnot/funcs/builtins/math_sketches.h:34``
 (QuantilesUDA wrapping the sequential-insertion tdigest library).
 
-TPU-first redesign: sequential insertion is hostile to XLA, so digests are
-built by **sorted quantile-binning** — a whole batch of values is sorted
-within each group, each value's within-group quantile position is mapped
-through the t-digest k1 scale function k(q) = asin(2q-1) to one of K bins,
-and bins are reduced with segment sums. Merging two digests (the partial-agg
-path across devices) concatenates centroid sets and re-compresses with the
-same binning. Everything is static-shape: [G groups, K centroids].
+TPU-first redesign: sequential insertion is hostile to XLA, and even
+whole-batch sorting is the wrong primitive on both XLA backends (TPU sort
+programs compile slowly and run sort-bound; XLA CPU sort is ~90x slower
+than its scatter). Each batch is instead **histogram-binned by value**:
+the f32 value's IEEE-754 bit pattern is made order-monotone (standard
+sign-flip transform) and its top bits index one of B log-spaced bins per
+group — a pure scatter-add, no sort, no data-dependent control flow. Bin
+(weight, weighted-mean) pairs are already value-ordered, so re-binning the
+histogram through the t-digest k1 scale function k(q) = asin(2q-1) down to
+K centroids is cumsum + segment-sum only. Merging two digests (the
+partial-agg path across devices) concatenates centroid sets and
+re-compresses with one tiny [G, 2K] sort. Everything is static-shape.
 
 The carry is (means f32[G,K], weights f32[G,K]) — a pytree, trivially
 shippable through shard_map/psum-style collectives.
@@ -37,14 +42,23 @@ def digest_init(num_groups: int, k: int = DEFAULT_K):
     )
 
 
-def _compress(means, weights, k: int):
-    """Re-bin [G, M] centroids to [G, k] by cumulative-weight position."""
+def _compress(means, weights, k: int, ordered: bool = False):
+    """Re-bin [G, M] centroids to [G, k] by cumulative-weight position.
+
+    ``ordered=True`` asserts the centroids are already ascending by mean
+    within each group (histogram bins are, by construction) and skips the
+    sort — empty (w==0) slots may then be interleaved; they carry no
+    weight, land in the trash segment, and don't perturb ``cumw``.
+    """
     g, m = means.shape
-    # Sort centroids by mean within each group; empty slots (w==0) last.
-    sort_key = jnp.where(weights > 0, means, _BIG)
-    order = jnp.argsort(sort_key, axis=-1, stable=True)
-    means_s = jnp.take_along_axis(means, order, axis=-1)
-    weights_s = jnp.take_along_axis(weights, order, axis=-1)
+    if ordered:
+        means_s, weights_s = means, weights
+    else:
+        # Sort centroids by mean within each group; empty slots last.
+        sort_key = jnp.where(weights > 0, means, _BIG)
+        order = jnp.argsort(sort_key, axis=-1, stable=True)
+        means_s = jnp.take_along_axis(means, order, axis=-1)
+        weights_s = jnp.take_along_axis(weights, order, axis=-1)
 
     total = jnp.sum(weights_s, axis=-1, keepdims=True)
     cumw = jnp.cumsum(weights_s, axis=-1)
@@ -70,57 +84,50 @@ def digest_merge(a, b):
     return _compress(means, weights, a[0].shape[-1])
 
 
+def _hist_bins(num_groups: int) -> int:
+    """Histogram width B: as fine as a [G, B] f32 scratch budget allows.
+
+    B=8192 gives positive values 4 mantissa bits of resolution (bins are
+    ~4.4% wide in value; the within-bin weighted mean recovers most of
+    that). Large-G aggregates shrink B toward a floor of K=128 so G*B
+    stays near 2^25 slots — past G=2^18 the scratch tracks the [G, K]
+    digest carry's own footprint (2 arrays of the same shape), which is
+    the dominant allocation at that scale with or without the histogram.
+    """
+    b = 8192
+    while b > DEFAULT_K and num_groups * b > (1 << 25):
+        b //= 2
+    return b
+
+
 def batch_to_digest(values, group_ids, mask, num_groups: int, k: int = DEFAULT_K):
-    """Build a [G, K] digest from one batch of (value, group) rows."""
-    n = values.shape[0]
+    """Build a [G, K] digest from one batch of (value, group) rows.
+
+    Sort-free: values land in B log-spaced histogram bins per group via
+    their order-monotone f32 bit pattern (one scatter-add), and the
+    value-ordered histogram is k1-rebinned to K centroids with
+    cumsum + segment-sum (``_compress(ordered=True)``).
+    """
     values = values.astype(jnp.float32)
     gids = jnp.where(mask, group_ids.astype(jnp.int32), num_groups)
-    vals_m = jnp.where(mask, values, _BIG)
+    b = _hist_bins(num_groups)
+    shift = jnp.uint32(32 - b.bit_length() + 1)  # top log2(B) bits
 
-    # Rows sorted by (group, value) with ONE sort: pack gid and the
-    # monotone bit-view of the f32 value into a u64 key (IEEE-754 floats
-    # order by their bits after the standard sign-flip transform), so the
-    # digest costs one argsort instead of two stable ones — sorts are the
-    # dominant cost of the sketch on both backends.
-    vb = jax.lax.bitcast_convert_type(vals_m, jnp.uint32)
-    vb = jnp.where(
-        vals_m < 0, ~vb, vb | jnp.uint32(0x80000000)
+    vb = jax.lax.bitcast_convert_type(values, jnp.uint32)
+    vb = jnp.where(values < 0, ~vb, vb | jnp.uint32(0x80000000))
+    bins = (vb >> shift).astype(jnp.int32)
+
+    flat = jnp.where(
+        mask & (gids < num_groups), gids * b + bins, num_groups * b
     )
-    key = (gids.astype(jnp.uint64) << jnp.uint64(32)) | vb.astype(jnp.uint64)
-    if jax.default_backend() == "cpu":
-        # XLA's CPU sort is ~4x slower than numpy's radix-ish argsort;
-        # a host callback is free on the CPU backend (same memory space).
-        import numpy as _np
-
-        order = jax.pure_callback(
-            lambda k: _np.argsort(k, kind="stable").astype(_np.int32),
-            jax.ShapeDtypeStruct(key.shape, jnp.int32),
-            key,
-            vmap_method="sequential",
-        )
-    else:
-        order = jnp.argsort(key).astype(jnp.int32)
-    s_gid = gids[order]
-    s_val = values[order]
-    s_mask = mask[order]
-
-    ones = mask.astype(jnp.float32)
-    counts = jax.ops.segment_sum(ones, gids, num_segments=num_groups + 1)
-    starts = jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]])
-    rank = jnp.arange(n, dtype=jnp.float32) - starts[s_gid]
-    group_n = jnp.maximum(counts[s_gid], 1.0)
-    q = (rank + 0.5) / group_n
-    bins = jnp.clip(jnp.floor(_knorm(q) * k).astype(jnp.int32), 0, k - 1)
-
-    flat = jnp.where(s_mask & (s_gid < num_groups), s_gid * k + bins, num_groups * k)
-    w_flat = s_mask.astype(jnp.float32)
-    w = jax.ops.segment_sum(w_flat, flat, num_segments=num_groups * k + 1)[:-1]
+    w = jax.ops.segment_sum(
+        mask.astype(jnp.float32), flat, num_segments=num_groups * b + 1
+    )[:-1].reshape(num_groups, b)
     mw = jax.ops.segment_sum(
-        jnp.where(s_mask, s_val, 0.0), flat, num_segments=num_groups * k + 1
-    )[:-1]
-    w = w.reshape(num_groups, k)
-    means = jnp.where(w > 0, mw.reshape(num_groups, k) / jnp.maximum(w, 1e-30), 0.0)
-    return means, w
+        jnp.where(mask, values, 0.0), flat, num_segments=num_groups * b + 1
+    )[:-1].reshape(num_groups, b)
+    means = jnp.where(w > 0, mw / jnp.maximum(w, 1e-30), 0.0)
+    return _compress(means, w, k, ordered=True)
 
 
 def digest_update(carry, group_ids, mask, values, *, num_groups: int | None = None):

@@ -138,34 +138,39 @@ class DistributedEngine(Engine):
         from ..planner.distributed import DistributedPlanner
         from ..planner.distributed.coordinator import PlanningError
 
-        planner = DistributedPlanner()
-        try:
-            split = planner.splitter.split(plan)
-            dplan = planner.coordinator.assign(split, self.distributed_state)
-        except PlanningError as e:
-            raise QueryError(str(e)) from e
+        # The replan mutates engine-scoped mesh state (self.mesh /
+        # n_devices / last_distributed_plan) that in-flight window staging
+        # reads, so it must happen inside the engine's one-query-at-a-time
+        # guard (reentrant: super().execute_plan re-acquires).
+        with self._exec_guard:
+            planner = DistributedPlanner()
+            try:
+                split = planner.splitter.split(plan)
+                dplan = planner.coordinator.assign(split, self.distributed_state)
+            except PlanningError as e:
+                raise QueryError(str(e)) from e
 
-        n_kelvin = self.mesh.devices.shape[0]  # (kelvin, agents) layout
-        max_agents = self.mesh.devices.size // n_kelvin
-        n_shards = min(dplan.n_data_shards or max_agents, max_agents)
-        if n_shards < max_agents:
-            mesh = agent_mesh(
-                n_shards, n_kelvin, devices=self.mesh.devices.flatten()
-            )
-        else:
-            mesh = self.mesh
-        planner.stitch(dplan, self.distributed_state, mesh=mesh)
-        self.last_distributed_plan = dplan
+            n_kelvin = self.mesh.devices.shape[0]  # (kelvin, agents) layout
+            max_agents = self.mesh.devices.size // n_kelvin
+            n_shards = min(dplan.n_data_shards or max_agents, max_agents)
+            if n_shards < max_agents:
+                mesh = agent_mesh(
+                    n_shards, n_kelvin, devices=self.mesh.devices.flatten()
+                )
+            else:
+                mesh = self.mesh
+            planner.stitch(dplan, self.distributed_state, mesh=mesh)
+            self.last_distributed_plan = dplan
 
-        saved = (self.mesh, self.n_devices)
-        self.mesh, self.n_devices = mesh, int(np.prod(mesh.devices.shape))
-        try:
-            return super().execute_plan(
-                plan, bridge_inputs=bridge_inputs, analyze=analyze,
-                materialize=materialize, cancel=cancel,
-            )
-        finally:
-            self.mesh, self.n_devices = saved
+            saved = (self.mesh, self.n_devices)
+            self.mesh, self.n_devices = mesh, int(np.prod(mesh.devices.shape))
+            try:
+                return super().execute_plan(
+                    plan, bridge_inputs=bridge_inputs, analyze=analyze,
+                    materialize=materialize, cancel=cancel,
+                )
+            finally:
+                self.mesh, self.n_devices = saved
 
     def _window_capacity(self, length: int) -> int:
         cap = super()._window_capacity(length)

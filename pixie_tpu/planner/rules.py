@@ -286,18 +286,21 @@ def add_limit_to_result_sinks(plan: Plan, max_rows: int) -> None:
 
 
 # -- reachability -------------------------------------------------------------
+# op -> (fn, allowed arg dtypes): folding must not change type/error
+# behavior — arithmetic on BOOLEAN literals or logicalAnd on INT64 would
+# fold to values the unfolded expression's UDF bind would have rejected.
 _FOLDABLE = {
-    "add": lambda a, b: a + b,
-    "subtract": lambda a, b: a - b,
-    "multiply": lambda a, b: a * b,
-    "lessThan": lambda a, b: a < b,
-    "lessThanEqual": lambda a, b: a <= b,
-    "greaterThan": lambda a, b: a > b,
-    "greaterThanEqual": lambda a, b: a >= b,
-    "equal": lambda a, b: a == b,
-    "notEqual": lambda a, b: a != b,
-    "logicalAnd": lambda a, b: a and b,
-    "logicalOr": lambda a, b: a or b,
+    "add": (lambda a, b: a + b, "num"),
+    "subtract": (lambda a, b: a - b, "num"),
+    "multiply": (lambda a, b: a * b, "num"),
+    "lessThan": (lambda a, b: a < b, "num"),
+    "lessThanEqual": (lambda a, b: a <= b, "num"),
+    "greaterThan": (lambda a, b: a > b, "num"),
+    "greaterThanEqual": (lambda a, b: a >= b, "num"),
+    "equal": (lambda a, b: a == b, "any"),
+    "notEqual": (lambda a, b: a != b, "any"),
+    "logicalAnd": (lambda a, b: bool(a and b), "bool"),
+    "logicalOr": (lambda a, b: bool(a or b), "bool"),
 }
 
 
@@ -314,13 +317,19 @@ def fold_constants(plan: Plan) -> None:
         if not all(isinstance(a, Literal) for a in e.args) or len(e.args) != 2:
             return e
         a, b = e.args
-        if a.dtype != b.dtype or a.dtype not in (
-            DataType.INT64, DataType.FLOAT64, DataType.BOOLEAN,
-            DataType.TIME64NS,
-        ):
+        fn, kinds = _FOLDABLE[e.name]
+        allowed = {
+            "num": (DataType.INT64, DataType.FLOAT64, DataType.TIME64NS),
+            "bool": (DataType.BOOLEAN,),
+            "any": (
+                DataType.INT64, DataType.FLOAT64, DataType.BOOLEAN,
+                DataType.TIME64NS,
+            ),
+        }[kinds]
+        if a.dtype != b.dtype or a.dtype not in allowed:
             return e
         try:
-            v = _FOLDABLE[e.name](a.value, b.value)
+            v = fn(a.value, b.value)
         except Exception:
             return e
         if isinstance(v, bool):
