@@ -101,6 +101,12 @@ define_flag("dense_domain_limit", 1 << 20,
             "(dictionary-encoded strings, booleans) with product <= this "
             "use the packed key AS the group id: no sort, no hash, and "
             "slot-aligned (regroup-free) state merges.")
+define_flag("int_dense_domain_limit", 1 << 23,
+            "Dense-domain budget for group-bys whose keys include integer "
+            "columns bounded by table min/max stats (Table.col_stats). "
+            "Separate from dense_domain_limit because a single int key "
+            "can't suffer the multi-key packing blowup; the agg carry is "
+            "one slot per domain value.")
 define_flag("fold_scan_windows", 16,
             "Fold up to this many equal-shape device-resident windows per "
             "aggregate dispatch via one lax.scan program (1 disables); "

@@ -42,6 +42,7 @@ from .plan import (
     FilterOp,
     JoinOp,
     LimitOp,
+    LookupJoinOp,
     MapOp,
     MemorySourceOp,
     OTelExportSinkOp,
@@ -71,7 +72,9 @@ class AggStatePayload:
     # Dense-domain states ship no key planes (slot index IS the packed
     # key); the producing fragment's domains let the merge side expand
     # them back to explicit keys (dictionaries may differ per agent).
+    # ``dense_offsets`` shifts stats-derived integer codes back to values.
     dense_domains: tuple = ()
+    dense_offsets: tuple = ()
 
 
 @dataclass
@@ -108,9 +111,11 @@ def _expand_dense_payload(p, group_rel, key_plane_index):
         doms,
         [group_rel.col_type(c) for c, _i in key_plane_index],
         np,
+        offsets=getattr(p, "dense_offsets", ()),
     )
     return dataclasses.replace(
-        p, state={**p.state, "keys": tuple(keys)}, dense_domains=()
+        p, state={**p.state, "keys": tuple(keys)}, dense_domains=(),
+        dense_offsets=(),
     )
 
 
@@ -171,9 +176,54 @@ class _Stream:
     chain: list
     source: object  # list[Table] | Table | HostBatch
     source_op: Optional[MemorySourceOp] = None
+    # Query-constant side-input arrays (numpy, keyed by reserved names)
+    # passed to the fragment program alongside each window — the build
+    # tables of fused lookup joins ride here, staged once per query.
+    side: dict = field(default_factory=dict)
 
     def extend(self, op):
-        return _Stream(self.relation, self.dicts, self.chain + [op], self.source, self.source_op)
+        return _Stream(
+            self.relation, self.dicts, self.chain + [op], self.source,
+            self.source_op, dict(self.side),
+        )
+
+
+def _chain_out_relation(stream: "_Stream", registry):
+    """(relation, dicts) after a stream's pre-stage chain, or None if the
+    chain does not bind (the caller falls back to the generic path)."""
+    from .fragment import _bind_pre_stage
+
+    try:
+        _, rel, dicts = _bind_pre_stage(
+            list(stream.chain), stream.relation, dict(stream.dicts), registry
+        )
+    except Exception:
+        return None
+    return rel, dicts
+
+
+def _stream_col_stats(stream: "_Stream"):
+    """Merged per-column (min, max) bounds across a stream's source
+    tablets (None when the source is not table-backed or any tablet
+    lacks stats for a column)."""
+    src = stream.source
+    if not isinstance(src, list) or not src:
+        return None
+    merged: dict | None = None
+    for t in src:
+        ts = getattr(t, "col_stats", None)
+        if ts is None:
+            return None
+        if not ts:
+            continue  # empty tablet (or no int columns): contributes no rows
+        if merged is None:
+            merged = dict(ts)
+        else:
+            merged = {
+                c: (min(merged[c][0], ts[c][0]), max(merged[c][1], ts[c][1]))
+                for c in merged.keys() & ts.keys()
+            }
+    return merged or None
 
 
 class DeviceResult:
@@ -233,7 +283,8 @@ class DeviceResult:
             # hash map grows instead, ``agg_node.cc``).
             stream = _double_agg_groups(stream)
             frag = compile_fragment(
-                stream.chain, stream.relation, stream.dicts, eng.registry
+                stream.chain, stream.relation, stream.dicts, eng.registry,
+                col_stats=_stream_col_stats(stream),
             )
             if self._qstats is not None:
                 # Fresh per-attempt stats: rows/windows stay per-attempt
@@ -460,9 +511,13 @@ class Engine:
                     st = self._as_stream(self._materialize(st))
                 results[nid] = st.extend(op)
             elif isinstance(op, JoinOp):
-                left = mat_input(node.inputs[0])
-                right = mat_input(node.inputs[1])
-                results[nid] = _join_dispatch(left, right, op)
+                fused = self._try_fused_join(nid, node, results, consumers)
+                if fused is not None:
+                    results[nid] = fused
+                else:
+                    left = mat_input(node.inputs[0])
+                    right = mat_input(node.inputs[1])
+                    results[nid] = _join_dispatch(left, right, op)
             elif isinstance(op, UnionOp):
                 mats = [mat_input(i) for i in node.inputs]
                 results[nid] = _union_host(mats)
@@ -537,9 +592,18 @@ class Engine:
         one dispatch (one tunnel round trip) per window."""
         from ..config import get_flag
 
+        import jax
+
         init_state, agg_step, _ = self._compile_steps(frag)
         state = init_state()
-        chunk_w = get_flag("fold_scan_windows") if frag.update_all else 0
+        # Scan-folding exists to amortize the TPU tunnel's ~70ms/dispatch
+        # round trip; on the CPU backend dispatches are cheap and the
+        # jnp.stack of window planes is a pure memory-bandwidth loss.
+        chunk_w = (
+            get_flag("fold_scan_windows")
+            if frag.update_all and jax.default_backend() == "tpu"
+            else 0
+        )
         pend_cols, pend_lo, pend_hi = [], [], []
 
         def flush_pending(state):
@@ -595,7 +659,8 @@ class Engine:
 
             while True:
                 frag = compile_fragment(
-                    res.chain, res.relation, res.dicts, self.registry
+                    res.chain, res.relation, res.dicts, self.registry,
+                    col_stats=_stream_col_stats(res),
                 )
                 state = self._fold_agg_state(res, frag)
                 if not bool(np.asarray(state["overflow"])):
@@ -607,6 +672,7 @@ class Engine:
                 input_dicts=dict(res.dicts),
                 state=jax.tree_util.tree_map(np.asarray, state),
                 dense_domains=frag.dense_domains,
+                dense_offsets=frag.dense_offsets,
             )
         return RowsPayload(batch=self._materialize(res))
 
@@ -864,7 +930,23 @@ class Engine:
         Table sources use the device-resident window cache (zero
         host->device transfer once staged — SURVEY.md §7 stage 1 "HBM as
         cold"); host batches and distributed engines stage per window.
+        Streams with side inputs (fused lookup-join build tables) carry
+        them in every window's cols under ``__side__`` — device_put once
+        per query, then reused as runtime args (never closure constants).
         """
+        if stream.side:
+            yield from self._staged_windows_with_side(stream, stats)
+            return
+        yield from self._staged_windows_inner(stream, stats)
+
+    def _staged_windows_with_side(self, stream: "_Stream", stats=None):
+        import jax
+
+        side = {k: jax.device_put(v) for k, v in stream.side.items()}
+        for cols, valid in self._staged_windows_inner(stream, stats):
+            yield {**cols, "__side__": side}, valid
+
+    def _staged_windows_inner(self, stream: "_Stream", stats=None):
         from ..config import get_flag
 
         import jax
@@ -916,6 +998,227 @@ class Engine:
             return frag.init_state, frag.update, None
         return None, None, frag.update
 
+    # -- fused lookup join ----------------------------------------------------
+    # DistributedEngine turns this off: side tables would need replicated
+    # shardings through the shard_map specs (future work with mesh
+    # residency).
+    fused_lookup_join = True
+
+    def _try_fused_join(self, nid, node, results, consumers):
+        """N:1 join as an in-fragment device lookup, or None to fall back.
+
+        Reference contrast: ``equijoin_node.cc`` materializes output rows
+        through a host hash map; here, when the build side resolves to a
+        dense-domain table, the probe stream keeps flowing — each window
+        gathers the build columns on device and the downstream
+        Map/Filter/Agg fuse into the same XLA program (VERDICT r03 ask
+        #2: output-row assembly never leaves the device).
+        """
+        from ..types.dtypes import device_dtypes
+
+        op = node.op
+        if not self.fused_lookup_join:
+            return None
+        if op.how not in ("inner", "left") or len(op.left_on) != 1:
+            return None
+        left_id, right_id = node.inputs
+        left_res = results[left_id]
+        if not isinstance(left_res, _Stream) or consumers.get(left_id, 0) > 1:
+            return None
+        if any(isinstance(o, (AggOp, LimitOp)) for o in left_res.chain):
+            return None
+        lc, rc = op.left_on[0], op.right_on[0]
+        bound = _chain_out_relation(left_res, self.registry)
+        if bound is None:
+            return None
+        left_rel, left_dicts = bound
+        if not left_rel.has_column(lc):
+            return None
+        l_dt = left_rel.col_type(lc)
+        if len(device_dtypes(l_dt)) != 1:
+            return None
+
+        right_res = results[right_id]
+        if (
+            isinstance(right_res, _Stream)
+            and consumers.get(right_id, 0) <= 1
+            and any(isinstance(o, AggOp) for o in right_res.chain)
+        ):
+            built = self._dense_agg_build(right_res, op, l_dt, left_dicts, lc, rc)
+            if isinstance(built, tuple) and built[0] == "fallback":
+                # The aggregate already executed; keep its rows for the
+                # generic join path rather than re-folding the stream.
+                results[right_id] = built[1]
+                built = self._host_table_build(
+                    built[1], op, l_dt, left_dicts, lc, rc
+                )
+        else:
+            if not isinstance(right_res, HostBatch):
+                return None
+            built = self._host_table_build(right_res, op, l_dt, left_dicts, lc, rc)
+        if built is None:
+            return None
+        lo, dom, found, value_tables, right_rel = built
+
+        # Output naming: all left columns keep their names; right value
+        # columns (minus the key) merge with the join suffix — the same
+        # schema ``_join_out_schema`` produces for the host paths.
+        try:
+            out_rel = left_rel.merge(
+                right_rel.select(
+                    [c for c in right_rel.column_names if c not in op.right_on]
+                ),
+                suffix=op.suffix,
+            )
+        except Exception:
+            return None
+        value_srcs = [c for c in right_rel.column_names if c not in op.right_on]
+        out_names = out_rel.column_names[len(left_rel.column_names):]
+
+        out_cols = []
+        side: dict = {}
+        prefix = f"__lj{nid}"
+        for src, out_name in zip(value_srcs, out_names):
+            dt = right_rel.col_type(src)
+            if dt == DataType.STRING:
+                return None  # string values need mid-chain dict plumbing
+            planes = value_tables[src]
+            out_cols.append((out_name, dt, len(planes)))
+            for j, p in enumerate(planes):
+                side[f"{prefix}:{out_name}:{j}"] = p
+        side[f"{prefix}:found"] = found
+
+        lj = LookupJoinOp(
+            key_col=lc, how=op.how, prefix=prefix, lo=int(lo), dom=int(dom),
+            out_cols=tuple(out_cols),
+        )
+        st = left_res.extend(lj)
+        st.side.update(side)
+        return st
+
+    def _dense_agg_build(self, right_stream, op, l_dt, left_dicts, lc, rc):
+        """Build lookup tables straight from a dense aggregate's device
+        state: the slot-aligned finalize output IS the table (slot =
+        key - lo), so the build side never visits the host."""
+        if any(isinstance(o, LimitOp) for o in right_stream.chain):
+            return None
+        frag_probe = compile_fragment(
+            right_stream.chain, right_stream.relation, right_stream.dicts,
+            self.registry, col_stats=_stream_col_stats(right_stream),
+        )
+        if (
+            not frag_probe.is_agg
+            or len(frag_probe.dense_domains) != 1
+            or frag_probe.limit is not None
+        ):
+            return None
+        # The dense slot space must be the probe key's own code space.
+        agg_i = next(
+            i for i, o in enumerate(right_stream.chain)
+            if isinstance(o, AggOp)
+        )
+        agg = right_stream.chain[agg_i]
+        if tuple(agg.group_cols) != (rc,):
+            return None
+        # Post-agg ops must leave the key column untouched — the slot
+        # arithmetic pairs probe keys with SLOT indices, so a post map
+        # that rewrites the key would silently mispair every row.
+        for o in right_stream.chain[agg_i + 1:]:
+            if isinstance(o, MapOp):
+                key_expr = dict(o.exprs).get(rc)
+                if key_expr != _col(rc):
+                    return None
+        out_rel = frag_probe.relation
+        if rc not in out_rel.column_names:
+            return None
+        if out_rel.col_type(rc) != l_dt:
+            return None
+        if l_dt == DataType.STRING:
+            meta = next(m for m in frag_probe.out_meta if m.name == rc)
+            if left_dicts.get(lc) is not meta.dict:
+                return None
+        if any(m.struct_fields for m in frag_probe.out_meta):
+            return None
+        dr = self._run_fragment(right_stream)
+        reject = bool(np.asarray(dr._overflow))  # stats raced an append
+        value_tables = {
+            n: tuple(dr._cols[n])
+            for n in out_rel.column_names
+            if n != rc and n in dr._cols
+        }
+        if set(value_tables) != {c for c in out_rel.column_names if c != rc}:
+            reject = True
+        if reject:
+            # Don't discard the executed aggregate: hand the (rebucketed
+            # if needed) rows back so the generic join path reuses them
+            # instead of re-folding the whole right stream.
+            return ("fallback", dr.to_host())
+        return (
+            frag_probe.dense_offsets[0], frag_probe.dense_domains[0],
+            dr._valid, value_tables, out_rel,
+        )
+
+    def _host_table_build(self, right_hb, op, l_dt, left_dicts, lc, rc):
+        """Build dense lookup tables from a materialized unique-key host
+        batch (the post-agg N:1 case arriving as rows)."""
+        from ..config import get_flag
+
+        if not right_hb.relation.has_column(rc):
+            return None
+        if right_hb.relation.col_type(rc) != l_dt:
+            return None
+        if right_hb.length == 0:
+            return None
+        kb = np.asarray(right_hb.cols[rc][0])
+        if l_dt == DataType.STRING:
+            ld = left_dicts.get(lc)
+            rd = right_hb.dicts.get(rc)
+            if ld is None or rd is None:
+                return None
+            if rd is not ld:
+                # Re-express build keys in the probe's id space without
+                # growing it: unseen keys can never match a probe row.
+                remap = np.fromiter(
+                    (ld.lookup(s) for s in rd.strings),
+                    dtype=np.int64, count=len(rd),
+                )
+                kb = np.where(kb >= 0, remap[np.clip(kb, 0, None)], -1)
+            lo, dom = 0, len(ld) + 1
+            in_dom = kb >= 0
+        elif l_dt in (DataType.INT64, DataType.TIME64NS):
+            lo, hi = int(kb.min()), int(kb.max())
+            dom = hi - lo + 1
+            if dom > get_flag("int_dense_domain_limit"):
+                return None
+            in_dom = np.ones(len(kb), dtype=bool)
+        else:
+            return None
+        idx = np.where(in_dom, kb - lo, 0)
+        found = np.zeros(dom, dtype=bool)
+        # Uniqueness: a duplicate build key means N:M — not this path.
+        found[idx[in_dom]] = True
+        if int(found.sum()) != int(in_dom.sum()):
+            return None
+        from ..types.dtypes import device_dtypes
+
+        value_tables = {}
+        for c in right_hb.relation.column_names:
+            if c == rc:
+                continue
+            ddts = device_dtypes(right_hb.relation.col_type(c))
+            planes = []
+            for p, ddt in zip(right_hb.cols[c], ddts):
+                # Device dtype, not host: FLOAT64 host planes are f64 but
+                # the device-plane invariant is f32 — an f64 side table
+                # would re-admit f64 into fused device code.
+                p = np.asarray(p)
+                t = np.zeros(dom, dtype=ddt)
+                if len(p):
+                    t[idx[in_dom]] = p[in_dom]
+                planes.append(t)
+            value_tables[c] = tuple(planes)
+        return lo, dom, found, value_tables, right_hb.relation
+
     def _materialize(self, res) -> HostBatch:
         if isinstance(res, HostBatch):
             return res
@@ -933,7 +1236,8 @@ class Engine:
         synchronous dispatch mode, so callers defer it as long as
         possible), non-agg chains a HostBatch."""
         frag = compile_fragment(
-            stream.chain, stream.relation, stream.dicts, self.registry
+            stream.chain, stream.relation, stream.dicts, self.registry,
+            col_stats=_stream_col_stats(stream),
         )
         qstats = getattr(self, "_query_stats", None)
         stats = qstats.new_fragment(stream.chain) if qstats is not None else None
@@ -973,10 +1277,12 @@ class Engine:
 
 def _window_shapes(cols) -> tuple:
     """Shape/dtype signature of a staged window (scan batching requires
-    identical signatures so the stacked treedef stays one program)."""
+    identical signatures so the stacked treedef stays one program).
+    Side inputs are query-constant and never affect batchability."""
     return tuple(
         (c, tuple((p.shape, str(p.dtype)) for p in planes))
         for c, planes in sorted(cols.items())
+        if c != "__side__"
     )
 
 

@@ -37,7 +37,10 @@ from ..types.relation import Relation
 from ..udf.registry import Registry
 from ..udf.udf import UDADef, apply_cast
 from .expr import BindError, BoundExpr, bind_expr
-from .plan import AggOp, FilterOp, LimitOp, MapOp
+from .plan import AggOp, ColumnRef, FilterOp, LimitOp, LookupJoinOp, MapOp
+
+# Integer-typed key columns that qualify for stats-derived dense domains.
+_INT_KEY_TYPES = (DataType.INT64, DataType.TIME64NS)
 
 
 @dataclass
@@ -77,7 +80,10 @@ class CompiledFragment:
     string_carry_sources: tuple = ()  # tuple[(out_name, tuple[col, ...])]
     # Dense-domain mode: per-group-col static domain sizes (the packed key
     # IS the group id; state["keys"] is empty). () = not dense.
+    # ``dense_offsets`` shifts stats-derived integer keys to zero base
+    # (0 for dictionary/bool columns).
     dense_domains: tuple = ()
+    dense_offsets: tuple = ()
 
 
 _FRAGMENT_CACHE: dict = {}
@@ -100,8 +106,29 @@ def _struct_key(x):
     return x
 
 
+def _stats_cache_key(ops, col_stats):
+    """The col_stats facts that can influence compilation: rounded bounds
+    of columns reaching the chain's agg group keys. Keying on anything
+    more (e.g. time_ bounds, which move every append) would defeat the
+    fragment cache."""
+    if not col_stats:
+        return ()
+    try:
+        pre, agg, _post, _limit = _split_chain(list(ops))
+    except BindError:
+        return tuple(sorted(col_stats.items()))
+    if agg is None:
+        return ()
+    stats = _propagate_stats(pre, col_stats)
+    return tuple(
+        (c, _round_stat_bounds(*stats[c]))
+        for c in agg.group_cols
+        if c in stats
+    )
+
+
 def compile_fragment_cached(ops, input_relation, input_dicts, registry,
-                            allow_dense: bool = True):
+                            allow_dense: bool = True, col_stats=None):
     """``compile_fragment`` memoized on plan structure.
 
     A fragment's jitted ``update``/``finalize`` closures hold the XLA
@@ -125,16 +152,20 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry,
             id(registry),
             get_flag("groupby_impl"),
             get_flag("dense_domain_limit") if allow_dense else -1,
+            get_flag("int_dense_domain_limit") if allow_dense else -1,
+            _stats_cache_key(ops, col_stats),
         )
         hash(key)
     except TypeError:
         return compile_fragment(
-            ops, input_relation, input_dicts, registry, allow_dense
+            ops, input_relation, input_dicts, registry, allow_dense,
+            col_stats=col_stats,
         )
     hit = _FRAGMENT_CACHE.get(key)
     if hit is None:
         frag = compile_fragment(
-            ops, input_relation, input_dicts, registry, allow_dense
+            ops, input_relation, input_dicts, registry, allow_dense,
+            col_stats=col_stats,
         )
         if len(_FRAGMENT_CACHE) >= _FRAGMENT_CACHE_MAX:
             _FRAGMENT_CACHE.pop(next(iter(_FRAGMENT_CACHE)))
@@ -154,15 +185,19 @@ def _range_valid(cols, valid):
     built INSIDE the fragment program from two scalars)."""
     if isinstance(valid, tuple):
         lo, hi = valid
-        n = next(iter(cols.values()))[0].shape[0]
+        n = next(
+            p for c, p in cols.items() if c != "__side__"
+        )[0].shape[0]
         iota = jnp.arange(n, dtype=jnp.int32)
         return (iota >= lo) & (iota < hi)
     return valid
 
 
 def _bind_pre_stage(ops, relation, dicts, registry):
-    """Bind leading Map/Filter ops; returns (apply_fn, relation, dicts)."""
+    """Bind leading Map/Filter/LookupJoin ops; returns
+    (apply_fn, relation, dicts)."""
     steps = []  # ("map", [(name, BoundExpr)]) | ("filter", BoundExpr)
+    #           | ("lookup", LookupJoinOp)
     for op in ops:
         if isinstance(op, MapOp):
             bound = [(name, bind_expr(e, relation, dicts, registry)) for name, e in op.exprs]
@@ -174,10 +209,49 @@ def _bind_pre_stage(ops, relation, dicts, registry):
             if b.dtype != DataType.BOOLEAN:
                 raise BindError(f"filter predicate has type {b.dtype}, want BOOLEAN")
             steps.append(("filter", b))
+        elif isinstance(op, LookupJoinOp):
+            if not relation.has_column(op.key_col):
+                raise BindError(f"lookup key {op.key_col!r} not in {relation}")
+            steps.append(("lookup", op))
+            relation = Relation(
+                list(relation.items())
+                + [(n, dt) for n, dt, _np in op.out_cols]
+            )
         else:
             raise AssertionError(op)
 
+    def apply_lookup(op, cols, valid, side):
+        if side is None:
+            raise BindError(
+                "LookupJoinOp fragment ran without its side-input tables "
+                "(cols['__side__'] missing — engine-internal op misuse)"
+            )
+        k = cols[op.key_col][0]
+        idx = k - op.lo
+        inb = (idx >= 0) & (idx < op.dom)
+        slot = jnp.clip(idx, 0, op.dom - 1).astype(jnp.int32)
+        found = inb & side[f"{op.prefix}:found"][slot]
+        cols = dict(cols)
+        for name, _dt, n_planes in op.out_cols:
+            planes = []
+            for j in range(n_planes):
+                t = side[f"{op.prefix}:{name}:{j}"]
+                v = t[slot]
+                if op.how == "left":
+                    # Unmatched probe rows stay valid with null values.
+                    # Inner joins skip the select: not-found rows become
+                    # invalid below, so their gathered garbage is masked
+                    # everywhere downstream.
+                    v = jnp.where(found, v, jnp.zeros((), v.dtype))
+                planes.append(v)
+            cols[name] = tuple(planes)
+        if op.how == "inner":
+            valid = valid & found
+        return cols, valid
+
     def apply(cols, valid):
+        cols = dict(cols)
+        side = cols.pop("__side__", None)
         for kind, payload in steps:
             if kind == "map":
                 # Broadcast so literal-only expressions yield full planes.
@@ -189,6 +263,8 @@ def _bind_pre_stage(ops, relation, dicts, registry):
                         jnp.broadcast_to(p, valid.shape) for p in planes
                     )
                 cols = new_cols
+            elif kind == "lookup":
+                cols, valid = apply_lookup(payload, cols, valid, side)
             else:
                 valid = valid & jnp.broadcast_to(payload.fn(cols), valid.shape)
         return cols, valid
@@ -223,8 +299,24 @@ def _split_chain(ops):
     return pre, agg, post, limit
 
 
+def _propagate_stats(ops, stats):
+    """Carry input-column (min, max) bounds through leading Map/Filter
+    ops: a map output keeps its source column's bounds only when it is a
+    pure pass-through ColumnRef; filters narrow, so bounds stay valid."""
+    if not stats:
+        return stats
+    for op in ops:
+        if isinstance(op, MapOp):
+            stats = {
+                name: stats[e.name]
+                for name, e in op.exprs
+                if isinstance(e, ColumnRef) and e.name in stats
+            }
+    return stats
+
+
 def compile_fragment(ops, input_relation, input_dicts, registry: Registry,
-                     allow_dense: bool = True) -> CompiledFragment:
+                     allow_dense: bool = True, col_stats=None) -> CompiledFragment:
     pre, agg, post, limit = _split_chain(ops)
     apply_pre, rel1, dicts1 = _bind_pre_stage(pre, input_relation, dict(input_dicts), registry)
 
@@ -246,16 +338,17 @@ def compile_fragment(ops, input_relation, input_dicts, registry: Registry,
 
     return _compile_agg(
         agg, post, limit, apply_pre, rel1, dicts1, registry,
-        allow_dense=allow_dense,
+        allow_dense=allow_dense, col_stats=_propagate_stats(pre, col_stats),
     )
 
 
-def unpack_dense_slots(iota, doms, col_types, xp):
+def unpack_dense_slots(iota, doms, col_types, xp, offsets=None):
     """Dense slot indices -> per-group-col key planes.
 
     The single source of the unpack arithmetic, shared by the traced
     finalize (xp=jnp) and the bridge-payload expansion (xp=np) so the
     packing order / NULL encoding can never diverge between them.
+    ``offsets`` shifts stats-derived integer codes back to their values.
     """
     import numpy as np
 
@@ -263,11 +356,14 @@ def unpack_dense_slots(iota, doms, col_types, xp):
     stride = 1
     for d in doms:
         stride *= d
-    for dt, dom in zip(col_types, doms):
+    offsets = offsets or (0,) * len(doms)
+    for dt, dom, off in zip(col_types, doms, offsets):
         stride //= dom
         code = (iota // stride) % dom
         if dt == DataType.BOOLEAN:
             planes.append(code.astype(np.bool_))
+        elif dt in _INT_KEY_TYPES:
+            planes.append((code + off).astype(np.int64))
         else:  # STRING: last sub-slot decodes back to NULL_ID (-1)
             planes.append(
                 xp.where(code == dom - 1, -1, code).astype(np.int32)
@@ -275,28 +371,52 @@ def unpack_dense_slots(iota, doms, col_types, xp):
     return planes
 
 
-def _static_key_domains(rel1, dicts1, group_cols):
-    """Per-column static key-domain sizes, or None when any column's
-    domain is not statically known.
+# Stats bounds round outward to this grain so ordinary appends (which
+# nudge a column's min/max) neither change the compiled domain nor churn
+# the fragment cache; only growth past the grain recompiles.
+_STATS_Q = 4096
+
+
+def _round_stat_bounds(lo: int, hi: int) -> tuple:
+    return (lo - lo % _STATS_Q, hi - hi % _STATS_Q + _STATS_Q - 1)
+
+
+def _static_key_domains(rel1, dicts1, group_cols, col_stats=None):
+    """Per-column (domain size, value offset) pairs, or None when any
+    column's domain is not known at compile time.
 
     Dictionary-encoded STRING columns have exactly ``len(dict) + 1``
     possible device codes (ids 0..len-1 plus NULL_ID), BOOLEANs two.
-    Integer/float/time keys have no static bound -> None.
+    Integer/time keys are dense when the table store's append-time
+    min/max stats (``Table.col_stats``) bound them: the domain is
+    [min, max] and the offset shifts values to zero-based codes. Rows
+    outside a stats-derived domain (appends racing the query) flag
+    overflow, and the engine's rebucket retry recompiles against fresh
+    stats. Float keys have no dense form -> None.
     """
     doms = []
     for c in group_cols:
         dt = rel1.col_type(c)
         if dt == DataType.STRING and dicts1.get(c) is not None:
-            doms.append(len(dicts1[c]) + 1)  # last slot = NULL_ID
+            doms.append((len(dicts1[c]) + 1, 0))  # last slot = NULL_ID
         elif dt == DataType.BOOLEAN:
-            doms.append(2)
+            doms.append((2, 0))
+        elif (
+            dt in (DataType.INT64, DataType.TIME64NS)
+            and col_stats
+            and c in col_stats
+        ):
+            lo, hi = _round_stat_bounds(*col_stats[c])
+            if hi - lo + 1 <= 0:
+                return None
+            doms.append((hi - lo + 1, lo))
         else:
             return None
     return doms
 
 
 def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
-                 allow_dense=True):
+                 allow_dense=True, col_stats=None):
     g = agg.max_groups
     for c in agg.group_cols:
         if not rel1.has_column(c):
@@ -308,15 +428,33 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
     # (regroup-free), the shape XLA/TPU executes best. Carnot has no
     # analog (its RowTuple hash map is domain-oblivious,
     # ``src/carnot/exec/agg_node.h:66``); this is the TPU-first design.
+    # Integer keys qualify through the table store's append-time min/max
+    # stats (a bincount-class scatter replaces hash probing); they get a
+    # larger domain budget because a single int column can't suffer the
+    # multi-key packing blowup the base limit protects against.
     dense_domains = None
+    dense_offsets = None
     if allow_dense and agg.group_cols:
-        doms = _static_key_domains(rel1, dicts1, list(agg.group_cols))
+        doms = _static_key_domains(
+            rel1, dicts1, list(agg.group_cols), col_stats
+        )
         if doms is not None:
             total = 1
-            for d in doms:
+            for d, _off in doms:
                 total *= d
-            if total <= get_flag("dense_domain_limit"):
-                dense_domains = tuple(doms)
+            has_int = any(off or rel1.col_type(c) in _INT_KEY_TYPES
+                          for (_d, off), c in zip(doms, agg.group_cols))
+            # The larger int budget is justified only for a SINGLE int
+            # key (no multi-key packing blowup); mixed/multi-key domains
+            # stay under the base limit.
+            limit_slots = (
+                get_flag("int_dense_domain_limit")
+                if has_int and len(agg.group_cols) == 1
+                else get_flag("dense_domain_limit")
+            )
+            if total <= limit_slots:
+                dense_domains = tuple(d for d, _off in doms)
+                dense_offsets = tuple(off for _d, off in doms)
                 g = total
 
     # Bind aggregate input expressions and resolve UDAs.
@@ -354,25 +492,50 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
         }
 
     def dense_slot_ids(cols, valid):
-        """Packed key code per row: slot = sum(code_i * stride_i), with
-        NULL_ID (-1) codes landing in each column's last sub-slot and
-        masked rows in the trash slot g."""
+        """Packed key code per row + out-of-domain flag.
+
+        slot = sum(code_i * stride_i); NULL_ID (-1) string codes land in
+        each column's last sub-slot and masked rows in the trash slot g.
+        Stats-derived integer codes are offset to zero base; a row whose
+        value escaped the compile-time [min, max] (an append racing the
+        query) goes to the trash slot and raises ``oob`` so the engine's
+        rebucket retry recompiles against fresh stats.
+        """
         slot = None
-        for (c, _i), dom in zip(key_plane_index, dense_domains):
+        oob = None
+        for (c, _i), dom, off in zip(
+            key_plane_index, dense_domains, dense_offsets
+        ):
             p = cols[c][0]
-            code = jnp.clip(
-                jnp.where(p < 0, dom - 1, p).astype(jnp.int32), 0, dom - 1
-            )
+            if rel1.col_type(c) in _INT_KEY_TYPES:
+                raw = p - off
+                out = (raw < 0) | (raw >= dom)
+                oob = out if oob is None else (oob | out)
+                code = jnp.clip(raw, 0, dom - 1).astype(jnp.int32)
+            else:
+                code = jnp.clip(
+                    jnp.where(p < 0, dom - 1, p).astype(jnp.int32), 0, dom - 1
+                )
             slot = code if slot is None else slot * jnp.int32(dom) + code
-        return jnp.where(valid, slot, g).astype(jnp.int32)
+        if oob is None:
+            oob_any = jnp.zeros((), dtype=jnp.bool_)
+            keep = valid
+        else:
+            oob = oob & valid
+            oob_any = jnp.any(oob)
+            keep = valid & ~oob
+        # ONE select to the trash slot (several chained wheres over [n]
+        # i64 planes cost real memory bandwidth at window scale).
+        return jnp.where(keep, slot, g).astype(jnp.int32), oob_any
 
     def dense_key_planes():
         """Reconstruct the [g] key planes from the slot index (traced)."""
         return unpack_dense_slots(
-            jnp.arange(g, dtype=jnp.int32),
+            jnp.arange(g, dtype=jnp.int64),
             dense_domains,
             [rel1.col_type(c) for c, _i in key_plane_index],
             jnp,
+            offsets=dense_offsets,
         )
 
     # NOTE: merge_states materializes neutral carries by calling uda.init(g)
@@ -399,12 +562,13 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
         valid = _range_valid(cols, valid)
         cols, valid = apply_pre(cols, valid)
         if dense_domains is not None:
-            gids = dense_slot_ids(cols, valid)
+            gids, oob = dense_slot_ids(cols, valid)
             keys_w = ()
-            valid_w = (
-                jnp.zeros(g + 1, dtype=jnp.bool_).at[gids].set(True)[:g]
-            )
-            n_w = jnp.int32(0)  # dense slots cannot overflow
+            valid_w = None  # filled below (count carries give it free)
+            # Dense slots cannot overflow by count; stats-derived integer
+            # domains overflow only when a row's key escapes the
+            # compile-time bounds (oob flags it for the rebucket retry).
+            n_w = jnp.where(oob, g + 1, 0).astype(jnp.int32)
         else:
             key_planes = [cols[c][i] for c, i in key_plane_index]
             gids, keys_w, valid_w, n_w = window_group_ids(key_planes, valid, g)
@@ -417,6 +581,21 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
             ]
             args = [jnp.broadcast_to(a, valid.shape) for a in args]
             carries_w[ae.out_name] = uda.update(uda.init(g), gids, valid, *args)
+        if valid_w is None:
+            # Dense mode: a count aggregate's fresh carry already says
+            # which slots saw rows — reuse it instead of paying a third
+            # scatter pass over the window.
+            cnt_name = next(
+                (ae.out_name for ae, uda, _b, _c in aggs_bound
+                 if ae.uda_name == "count"),
+                None,
+            )
+            if cnt_name is not None:
+                valid_w = carries_w[cnt_name] > 0
+            else:
+                valid_w = (
+                    jnp.zeros(g + 1, dtype=jnp.bool_).at[gids].set(True)[:g]
+                )
         return {
             "keys": tuple(keys_w),
             "valid": valid_w,
@@ -480,13 +659,25 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
 
         ``cols_list`` is a tuple of per-window cols dicts; ``los``/``his``
         are i32[W] row-range bounds (the mask builds in-program).
+        Query-constant side inputs (``__side__``, the fused-lookup-join
+        build tables) are identical across windows and must NOT be
+        stacked W times — they lift out and rejoin inside the scan body.
         """
+        side = None
+        stripped = []
+        for c in cols_list:
+            c = dict(c)
+            s = c.pop("__side__", None)
+            side = side if side is not None else s
+            stripped.append(c)
         stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *cols_list
+            lambda *xs: jnp.stack(xs), *stripped
         )
 
         def body(st, xs):
             c, lo, hi = xs
+            if side is not None:
+                c = {**c, "__side__": side}
             return merge_states(st, window_state(c, (lo, hi))), None
 
         out, _ = jax.lax.scan(body, state, (stacked, los, his))
@@ -591,6 +782,7 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
         group_relation=rel1,
         string_carry_sources=tuple(string_carry_sources),
         dense_domains=dense_domains or (),
+        dense_offsets=dense_offsets or (),
     )
 
 

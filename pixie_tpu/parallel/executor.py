@@ -107,6 +107,9 @@ class DistributedEngine(Engine):
     # Windows stage row-sharded over the mesh per query; the single-device
     # resident cache does not apply here (mesh residency is future work).
     device_residency = False
+    # Fused lookup joins need replicated side-table shardings through the
+    # shard_map specs — not wired yet; joins materialize on host here.
+    fused_lookup_join = False
 
     def __init__(self, registry=None, window_rows: int | None = None,
                  mesh: Mesh | None = None, n_agents: int | None = None,

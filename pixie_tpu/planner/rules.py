@@ -19,6 +19,7 @@ exec-layer Plan DAG:
 
 from __future__ import annotations
 
+from ..types.dtypes import DataType
 from ..exec.plan import (
     AggExpr,
     AggOp,
@@ -45,6 +46,7 @@ def run_rules(plan: Plan, max_output_rows: int = 10_000) -> Plan:
     fold_constants(plan)
     fuse_quantile_plucks(plan)
     push_filters_below_maps(plan)
+    push_agg_through_join(plan)
     prune_unused_columns(plan)
     add_limit_to_result_sinks(plan, max_output_rows)
     return plan
@@ -391,6 +393,192 @@ def push_filters_below_maps(plan: Plan) -> None:
         node.inputs = [up_id]
         node.relation = map_rel
 
+
+
+# -- eager aggregation through joins ------------------------------------------
+_PAJ_DECOMPOSABLE = frozenset({"count", "sum", "min", "max"})
+
+
+def push_agg_through_join(plan: Plan) -> None:
+    """Eager aggregation (Yan & Larson): rewrite GroupBy(Join(L, R)) so
+    the build side pre-aggregates below the join.
+
+    When every group key comes from the probe (left) side and every
+    aggregate decomposes, the N:M join never materializes: R partial-aggs
+    by its join keys (adding a ``__paj_cnt`` multiplicity), the join
+    becomes N:1 — which the engine executes as a fused in-fragment device
+    lookup — and the top aggregate reweights:
+
+        count(x)        -> sum(__paj_cnt)
+        sum(r_col)      -> sum(__paj_s_<col>)
+        min/max(r_col)  -> min/max(__paj_m*_<col>)
+        min/max(l_col)  -> min/max(l_col)   (fan-out can't change extremes)
+
+    The reference's optimizer has no analog (Carnot always hash-joins,
+    ``src/carnot/exec/equijoin_node.cc``); on TPU this turns the worst
+    exec-node shape (host hash join) into two dense scatter aggregates.
+    Inner joins only: outer variants change null/row semantics.
+    """
+    consumers = _consumers(plan)
+    for nid in list(plan.nodes):
+        node = plan.nodes.get(nid)
+        if node is None or not isinstance(node.op, AggOp):
+            continue
+        agg: AggOp = node.op
+        if agg.mode != "full" or not node.inputs:
+            continue
+        if any(ae.out_name.startswith("__paj_") for ae in agg.aggs):
+            continue  # already rewritten
+        jid = node.inputs[0]
+        jnode = plan.nodes.get(jid)
+        if jnode is None or not isinstance(jnode.op, JoinOp):
+            continue
+        join: JoinOp = jnode.op
+        if join.how != "inner" or consumers.get(jid, []) != [nid]:
+            continue
+        if len(jnode.inputs) != 2:
+            continue
+        left_id, right_id = jnode.inputs
+        lrel = plan.nodes[left_id].relation
+        rrel = plan.nodes[right_id].relation
+        if lrel is None or rrel is None:
+            continue
+        # Already N:1? A build side grouped by exactly the join keys is
+        # unique on them — pre-aggregating again would just stack a
+        # pointless blocking agg (and the engine's fused lookup join
+        # consumes the grouped state directly).
+        rid = right_id
+        while isinstance(plan.nodes[rid].op, (MapOp, FilterOp)) and plan.nodes[rid].inputs:
+            rid = plan.nodes[rid].inputs[0]
+        rop = plan.nodes[rid].op
+        if isinstance(rop, AggOp) and set(rop.group_cols) >= set(join.right_on):
+            continue
+        lcols = set(lrel.column_names)
+        # Join-output name -> (side, source column), mirroring the
+        # engine's _join_out_schema (left names win; right value columns
+        # take the suffix on collision).
+        src_of: dict = {c: ("l", c) for c in lrel.column_names}
+        for c in rrel.column_names:
+            if c in join.right_on:
+                continue
+            out = c + join.suffix if c in lcols else c
+            src_of.setdefault(out, ("r", c))
+        if not all(
+            c in src_of and src_of[c][0] == "l" for c in agg.group_cols
+        ):
+            continue
+
+        # Every aggregate must be a decomposable UDA over one column.
+        plan_ok = True
+        right_needs: dict = {}  # right col -> set of partial kinds
+        rewritten: list = []  # (tmp_name, final AggExpr builder data)
+        for ae in agg.aggs:
+            if (
+                ae.uda_name not in _PAJ_DECOMPOSABLE
+                or len(ae.args) != 1
+                or not isinstance(ae.args[0], ColumnRef)
+                or ae.args[0].name not in src_of
+            ):
+                plan_ok = False
+                break
+            side, src = src_of[ae.args[0].name]
+            if ae.uda_name == "count":
+                rewritten.append((ae, "sum", "__paj_cnt"))
+            elif side == "r":
+                kind = {"sum": "s", "min": "mn", "max": "mx"}[ae.uda_name]
+                right_needs.setdefault(src, set()).add(kind)
+                rewritten.append((ae, ae.uda_name, f"__paj_{kind}_{src}"))
+            elif ae.uda_name in ("min", "max"):
+                rewritten.append((ae, ae.uda_name, ae.args[0].name))
+            else:
+                plan_ok = False  # sum/mean over a left column: needs
+                break  # cnt-weighted reweighting (not yet)
+        if not plan_ok:
+            continue
+        # The partial count needs a castable (non-string) column on R.
+        cnt_src = next(
+            (
+                c
+                for c in rrel.column_names
+                if rrel.col_type(c)
+                in (DataType.INT64, DataType.FLOAT64, DataType.TIME64NS,
+                    DataType.BOOLEAN)
+            ),
+            None,
+        )
+        if cnt_src is None:
+            continue
+
+        from ..types.relation import Relation
+
+        partial_aggs = [AggExpr("__paj_cnt", "count", (ColumnRef(cnt_src),))]
+        partial_items = [(rc, rrel.col_type(rc)) for rc in join.right_on]
+        partial_items.append(("__paj_cnt", DataType.INT64))
+        for src, kinds in sorted(right_needs.items()):
+            for kind in sorted(kinds):
+                uda = {"s": "sum", "mn": "min", "mx": "max"}[kind]
+                partial_aggs.append(
+                    AggExpr(f"__paj_{kind}_{src}", uda, (ColumnRef(src),))
+                )
+                partial_items.append(
+                    (f"__paj_{kind}_{src}", rrel.col_type(src))
+                )
+        partial_id = plan.add(
+            AggOp(
+                group_cols=tuple(join.right_on),
+                aggs=tuple(partial_aggs),
+                max_groups=max(agg.max_groups, 1 << 16),
+            ),
+            inputs=[right_id],
+            relation=Relation(partial_items),
+        )
+
+        # The join (id kept) now probes the aggregated build side: N:1.
+        jnode.op = JoinOp(
+            left_on=join.left_on, right_on=join.right_on, how="inner",
+            suffix=join.suffix,
+        )
+        jnode.inputs = [left_id, partial_id]
+        jnode.relation = Relation(
+            list(lrel.items())
+            + [(n, t) for n, t in partial_items if n not in join.right_on]
+        )
+
+        # Final aggregate under a projection that restores the original
+        # output names/order (node id kept so consumers stay valid).
+        final_aggs = tuple(
+            AggExpr(f"__paj_o_{ae.out_name}", uda, (ColumnRef(src),))
+            for ae, uda, src in rewritten
+        )
+        final_items = [(c, lrel.col_type(c)) for c in agg.group_cols] + [
+            (f"__paj_o_{ae.out_name}", _paj_out_type(ae, uda, src, lrel, dict(partial_items)))
+            for ae, uda, src in rewritten
+        ]
+        final_id = plan.add(
+            AggOp(
+                group_cols=agg.group_cols, aggs=final_aggs,
+                max_groups=agg.max_groups,
+            ),
+            inputs=[jid],
+            relation=Relation(final_items),
+        )
+        node.op = MapOp(
+            exprs=tuple((c, ColumnRef(c)) for c in agg.group_cols)
+            + tuple(
+                (ae.out_name, ColumnRef(f"__paj_o_{ae.out_name}"))
+                for ae, _uda, _src in rewritten
+            )
+        )
+        node.inputs = [final_id]
+        consumers = _consumers(plan)
+
+
+def _paj_out_type(ae, uda, src, lrel, partial_types):
+    if ae.uda_name == "count":
+        return DataType.INT64
+    if src in partial_types:
+        return partial_types[src]
+    return lrel.col_type(src)
 
 
 def prune_unreachable(plan: Plan) -> None:

@@ -418,6 +418,13 @@ class Table:
         self._device_cache = None
         self._staged_through = 0
         self.device_window_rows = int(_get_flag("window_rows"))
+        # Per-column (min, max) over every row ever appended, for
+        # single-plane integer columns. Conservative bounds (ring expiry
+        # never widens them), maintained on the push path so the query
+        # compiler can pick dense-domain group-bys for integer keys the
+        # way it does for dictionary codes. The reference has no analog
+        # (its agg hash map is domain-oblivious, agg_node.h).
+        self.col_stats: dict[str, tuple[int, int]] = {}
         if len(self.relation):
             self._init_backend()
 
@@ -494,6 +501,20 @@ class Table:
                 raise ValueError(
                     f"column {c!r} plane has shape {p.shape}; expected "
                     f"1-D of length {hb.length}"
+                )
+        for (c, i), p in zip(self._plane_layout, planes):
+            if (
+                i == 0
+                and len(p)
+                and self.relation.col_type(c)
+                in (DataType.INT64, DataType.TIME64NS)
+            ):
+                lo, hi = int(p.min()), int(p.max())
+                cur = self.col_stats.get(c)
+                self.col_stats[c] = (
+                    (lo, hi)
+                    if cur is None
+                    else (min(cur[0], lo), max(cur[1], hi))
                 )
         times = cols[TIME_COLUMN][0] if (TIME_COLUMN, 0) == self._plane_layout[0] else None
         self._backend.append(planes, times)

@@ -1,0 +1,306 @@
+"""Fused lookup joins + eager aggregation through joins.
+
+Reference parity targets: ``src/carnot/exec/equijoin_node.cc`` (join
+semantics the fused path must preserve) and the optimizer rule framework
+(``src/carnot/planner/compiler/optimizer/``) for the Yan-Larson rewrite,
+which Carnot does not have — results must match the unrewritten plan
+exactly.
+"""
+
+import numpy as np
+import pytest
+
+from pixie_tpu.exec.engine import Engine
+from pixie_tpu.types.batch import HostBatch
+from pixie_tpu.types.dtypes import DataType
+from pixie_tpu.types.relation import Relation
+from pixie_tpu.types.strings import StringDictionary
+
+
+def _mk(eng, name, rel, cols, length, dicts=None):
+    eng.create_table(name)
+    eng.append_data(
+        name,
+        HostBatch(relation=rel, cols=cols, length=length, dicts=dicts or {}),
+    )
+
+
+def _two_tables(eng, n=20_000, n_keys=4_000, seed=5):
+    rng = np.random.default_rng(seed)
+    rel_l = Relation([
+        ("time_", DataType.TIME64NS),
+        ("k", DataType.INT64),
+        ("b", DataType.INT64),
+    ])
+    rel_r = Relation([
+        ("time_", DataType.TIME64NS),
+        ("k", DataType.INT64),
+        ("v", DataType.INT64),
+    ])
+    lk = rng.integers(0, n_keys, n)
+    lb = rng.integers(0, 7, n)
+    rk = rng.integers(0, n_keys, n)
+    rv = rng.integers(-50, 1000, n)
+    _mk(eng, "L", rel_l, {
+        "time_": (np.arange(n, dtype=np.int64),), "k": (lk,), "b": (lb,),
+    }, n)
+    _mk(eng, "R", rel_r, {
+        "time_": (np.arange(n, dtype=np.int64),), "k": (rk,), "v": (rv,),
+    }, n)
+    return lk, lb, rk, rv, n_keys
+
+
+JOIN_AGG = """
+import px
+l = px.DataFrame(table='L')
+r = px.DataFrame(table='R')
+g = l.merge(r, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+out = g.groupby('b').agg(
+    n=('v', px.count), s=('v', px.sum),
+    mn=('v', px.min), mx=('v', px.max), bmax=('time_', px.max))
+px.display(out)
+"""
+
+
+def _expected(lk, lb, rk, rv, n_keys):
+    cnt = np.bincount(rk, minlength=n_keys)
+    s = np.bincount(rk, weights=rv.astype(np.float64), minlength=n_keys)
+    mn = np.full(n_keys, np.iinfo(np.int64).max)
+    mx = np.full(n_keys, np.iinfo(np.int64).min)
+    np.minimum.at(mn, rk, rv)
+    np.maximum.at(mx, rk, rv)
+    out = {}
+    for b in np.unique(lb):
+        m = (lb == b) & (cnt[lk] > 0)
+        if not m.any():
+            continue
+        ks = lk[m]
+        out[int(b)] = (
+            int(cnt[ks].sum()),
+            float(s[ks].sum()),
+            int(mn[ks].min()),
+            int(mx[ks].max()),
+            int(np.nonzero(m)[0].max()),  # time_ == row index
+        )
+    return out
+
+
+def test_agg_through_join_matches_bruteforce():
+    eng = Engine(window_rows=1 << 13)  # several windows
+    lk, lb, rk, rv, n_keys = _two_tables(eng)
+    got = eng.execute_query(JOIN_AGG)["output"].to_pydict()
+    want = _expected(lk, lb, rk, rv, n_keys)
+    assert sorted(got["b"]) == sorted(want)
+    for i, b in enumerate(got["b"]):
+        n, s, mn, mx, bmax = want[int(b)]
+        assert got["n"][i] == n
+        assert got["s"][i] == s
+        assert got["mn"][i] == mn
+        assert got["mx"][i] == mx
+        assert got["bmax"][i] == bmax
+
+
+def test_rewrite_applied_and_guarded():
+    """The plan rewrites to partial-agg + N:1 join; an already-grouped
+    build side is left alone."""
+    from pixie_tpu.exec.plan import AggOp, JoinOp
+    from pixie_tpu.planner.compiler import CompilerState, compile_pxl
+    from pixie_tpu.udf.registry import default_registry
+
+    eng = Engine()
+    _two_tables(eng, n=100)
+    state = CompilerState(
+        schemas={
+            "L": eng.tables["L"].relation, "R": eng.tables["R"].relation
+        },
+        registry=default_registry(),
+    )
+    plan = compile_pxl(JOIN_AGG, state).plan
+    aggs = [n.op for n in plan.nodes.values() if isinstance(n.op, AggOp)]
+    assert any(
+        ae.out_name == "__paj_cnt" for a in aggs for ae in a.aggs
+    ), "partial agg missing: rewrite did not fire"
+    join = next(n for n in plan.nodes.values() if isinstance(n.op, JoinOp))
+    partial = plan.nodes[join.inputs[1]]
+    assert isinstance(partial.op, AggOp)
+    assert partial.op.group_cols == ("k",)
+
+    pre_grouped = """
+import px
+r = px.DataFrame(table='R')
+ra = r.groupby('k').agg(cnt=('v', px.count))
+l = px.DataFrame(table='L')
+g = l.merge(ra, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+out = g.groupby('b').agg(n=('cnt', px.sum))
+px.display(out)
+"""
+    plan2 = compile_pxl(pre_grouped, state).plan
+    aggs2 = [n.op for n in plan2.nodes.values() if isinstance(n.op, AggOp)]
+    assert not any(
+        ae.out_name.startswith("__paj_") for a in aggs2 for ae in a.aggs
+    ), "guard failed: pre-grouped build side was re-aggregated"
+
+
+def test_quantiles_blocks_rewrite():
+    """Non-decomposable aggregates must not be pushed through the join."""
+    eng = Engine(window_rows=1 << 13)
+    lk, lb, rk, rv, n_keys = _two_tables(eng, n=5_000, n_keys=50)
+    q = """
+import px
+l = px.DataFrame(table='L')
+r = px.DataFrame(table='R')
+g = l.merge(r, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+agg = g.groupby('b').agg(q=('v', px.quantiles), n=('v', px.count))
+agg.p50 = px.pluck_float64(agg.q, 'p50')
+out = agg['b', 'p50', 'n']
+px.display(out)
+"""
+    got = eng.execute_query(q)["output"].to_pydict()
+    by_key: dict = {}
+    for k, v in zip(rk, rv):
+        by_key.setdefault(int(k), []).append(v)
+    for i, b in enumerate(got["b"]):
+        m = lb == b
+        # brute force the joined multiset for group b
+        joined = []
+        for k in lk[m]:
+            joined.extend(by_key.get(int(k), []))
+        joined = np.asarray(joined, dtype=np.float64)
+        assert got["n"][i] == len(joined)
+        r50 = np.quantile(joined, 0.5)
+        denom = max(abs(r50), 1e-9)
+        assert abs(got["p50"][i] - r50) / denom < 0.15
+
+
+def test_fused_lookup_join_string_key_host_build():
+    """Post-agg N:1 join on a string key via the host dense-table build."""
+    eng = Engine(window_rows=1 << 12)
+    n = 10_000
+    rng = np.random.default_rng(9)
+    svc = StringDictionary([f"svc-{i}" for i in range(11)])
+    codes = rng.integers(0, 11, n).astype(np.int32)
+    lat = rng.integers(1, 500, n)
+    rel = Relation([
+        ("time_", DataType.TIME64NS),
+        ("service", DataType.STRING),
+        ("latency", DataType.INT64),
+    ])
+    _mk(eng, "http", rel, {
+        "time_": (np.arange(n, dtype=np.int64),),
+        "service": (codes,), "latency": (lat,),
+    }, n, dicts={"service": svc})
+    # A small dimension table keyed by service (unique).
+    dim_rel = Relation([
+        ("time_", DataType.TIME64NS),
+        ("service", DataType.STRING),
+        ("weight", DataType.INT64),
+    ])
+    dsvc = np.arange(11, dtype=np.int32)
+    _mk(eng, "dim", dim_rel, {
+        "time_": (np.zeros(11, dtype=np.int64),),
+        "service": (dsvc,),
+        "weight": ((np.arange(11, dtype=np.int64) + 1) * 10,),
+    }, 11, dicts={"service": svc})
+    q = """
+import px
+h = px.DataFrame(table='http')
+d = px.DataFrame(table='dim')
+g = h.merge(d, how='inner', left_on=['service'], right_on=['service'],
+            suffixes=['', '_d'])
+out = g.groupby('service').agg(n=('latency', px.count), w=('weight', px.max))
+px.display(out)
+"""
+    got = eng.execute_query(q)["output"].to_pydict(decode_strings=True)
+    for i, s in enumerate(got["service"]):
+        name = s.decode() if isinstance(s, bytes) else s
+        c = int(name.split("-")[1])
+        assert got["n"][i] == int((codes == c).sum())
+        assert got["w"][i] == (c + 1) * 10
+
+
+def test_dense_int_groupby_negative_and_offset_domain():
+    """Stats-derived dense domains handle negative and offset keys."""
+    eng = Engine(window_rows=1 << 12)
+    n = 30_000
+    rng = np.random.default_rng(2)
+    k = rng.integers(-1000, 9_000, n)
+    v = rng.integers(0, 100, n)
+    rel = Relation([
+        ("time_", DataType.TIME64NS),
+        ("k", DataType.INT64),
+        ("v", DataType.INT64),
+    ])
+    _mk(eng, "t", rel, {
+        "time_": (np.arange(n, dtype=np.int64),), "k": (k,), "v": (v,),
+    }, n)
+    got = eng.execute_query(
+        """
+import px
+df = px.DataFrame(table='t')
+out = df.groupby('k').agg(n=('v', px.count), s=('v', px.sum))
+px.display(out)
+""",
+        max_output_rows=100_000,
+    )["output"].to_pydict()
+    order = np.argsort(got["k"])
+    uk, cnt = np.unique(k, return_counts=True)
+    assert np.array_equal(np.asarray(got["k"])[order], uk)
+    assert np.array_equal(np.asarray(got["n"])[order], cnt)
+    s_ref = np.bincount(k + 1000, weights=v.astype(np.float64), minlength=10_000)
+    np.testing.assert_allclose(
+        np.asarray(got["s"])[order], s_ref[uk + 1000], rtol=0,
+    )
+
+
+def test_dense_int_stats_survive_bridge_payload():
+    """A dense-int partial agg ships across the wire and merges (the
+    PEM -> Kelvin path) with the offset preserved."""
+    from pixie_tpu.services.wire import decode, encode
+
+    eng = Engine(window_rows=1 << 12)
+    n = 8_000
+    rng = np.random.default_rng(4)
+    k = rng.integers(500, 2_500, n)
+    v = rng.integers(0, 10, n)
+    rel = Relation([
+        ("time_", DataType.TIME64NS),
+        ("k", DataType.INT64),
+        ("v", DataType.INT64),
+    ])
+    _mk(eng, "t", rel, {
+        "time_": (np.arange(n, dtype=np.int64),), "k": (k,), "v": (v,),
+    }, n)
+
+    from pixie_tpu.planner.compiler import CompilerState, compile_pxl
+    from pixie_tpu.planner.distributed import DistributedPlanner
+
+    state = CompilerState(
+        schemas={"t": eng.tables["t"].relation},
+        registry=eng.registry,
+    )
+    plan = compile_pxl(
+        """
+import px
+df = px.DataFrame(table='t')
+out = df.groupby('k').agg(n=('v', px.count))
+px.display(out)
+""",
+        state,
+    ).plan
+    split = DistributedPlanner().splitter.split(plan)
+    agent_out = eng.execute_plan(split.before_blocking)
+    payloads = [
+        decode(encode(p)) for kk, p in agent_out.items()
+        if isinstance(kk, tuple) and kk[0] == "bridge"
+    ]
+    assert payloads and payloads[0].dense_domains, "expected a dense payload"
+    assert payloads[0].dense_offsets, "offset lost on the wire"
+    bid = split.bridges[0].bridge_id
+    merged = eng.execute_plan(
+        split.after_blocking, bridge_inputs={bid: payloads},
+    )
+    got = merged["output"].to_pydict()
+    uk, cnt = np.unique(k, return_counts=True)
+    order = np.argsort(got["k"])
+    assert np.array_equal(np.asarray(got["k"])[order], uk)
+    assert np.array_equal(np.asarray(got["n"])[order], cnt)
