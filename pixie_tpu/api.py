@@ -103,11 +103,68 @@ class Client:
                 h.handle_done(name)
         return out
 
+    def stream_script(
+        self,
+        pxl: str,
+        on_update: Callable[[dict], None],
+        poll_interval_s: float = 0.25,
+    ) -> "StreamSubscription":
+        """Subscribe to a live query (the reference's StreamResults /
+        live-view flow): ``on_update`` receives
+        {table, rows: pydict, seq, mode} as the cluster's tables grow —
+        mode "append" carries only new rows, "replace" the full updated
+        aggregate — until ``.cancel()``. Errors arrive as {error}.
+        """
+        import uuid as _uuid
+
+        topic = f"client.stream.{_uuid.uuid4().hex[:12]}"
+
+        def _relay(msg):
+            if "batch" in msg:
+                hb = msg["batch"]
+                on_update({
+                    "table": msg.get("table"),
+                    "rows": hb.to_pydict(),
+                    "seq": msg.get("seq"),
+                    "mode": msg.get("mode"),
+                })
+            else:
+                on_update(msg)
+
+        sub = self._bus.subscribe(topic, _relay)
+        try:
+            res = self._request(
+                "broker.execute_stream",
+                {"query": pxl, "update_topic": topic,
+                 "poll_interval_s": poll_interval_s},
+            )
+        except Exception:
+            sub.unsubscribe()
+            raise
+        return StreamSubscription(self, res["qid"], sub)
+
     def _request(self, topic: str, msg: dict, timeout_s: float = 10.0) -> dict:
         res = self._bus.request(topic, msg, timeout_s=timeout_s)
         if not res.get("ok"):
             raise ScriptExecutionError(res.get("error", "unknown error"))
         return res
+
+
+class StreamSubscription:
+    """Client handle for a live query; ``cancel()`` ends it everywhere."""
+
+    def __init__(self, client: Client, qid: str, sub):
+        self.qid = qid
+        self._client = client
+        self._sub = sub
+
+    def cancel(self) -> None:
+        try:
+            self._client._request("broker.stream_cancel", {"qid": self.qid})
+        finally:
+            if self._sub is not None:
+                self._sub.unsubscribe()
+                self._sub = None
 
 
 def _py(v):

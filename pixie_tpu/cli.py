@@ -123,6 +123,45 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_live(args) -> int:
+    """Live view: subscribe to a streaming query, reprinting the result
+    as it updates (the reference UI's live-view flow over StreamResults)
+    until interrupted or --rounds updates have arrived."""
+    import threading
+
+    query = _load_query(args.script)
+    done = threading.Event()
+    seen = {"n": 0, "failed": False}
+
+    def on_update(u):
+        if "error" in u:
+            print(f"error: {u['error']}", file=sys.stderr)
+            seen["failed"] = True
+            done.set()
+            return
+        seen["n"] += 1
+        mode = u.get("mode", "")
+        print(f"-- update {seen['n']} ({mode}) --")
+        rows = u["rows"]
+        cols = list(rows)
+        for i in range(len(rows[cols[0]]) if cols else 0):
+            print({c: rows[c][i] for c in cols})
+        if args.rounds and seen["n"] >= args.rounds:
+            done.set()
+
+    with _client(args.broker) as client:
+        sub = client.stream_script(
+            query, on_update, poll_interval_s=args.interval
+        )
+        try:
+            done.wait(timeout=args.timeout if args.timeout else None)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            sub.cancel()
+    return 1 if seen["failed"] else 0
+
+
 def cmd_script(args) -> int:
     from .scripts import list_scripts, load_script
 
@@ -204,6 +243,17 @@ def main(argv=None) -> int:
     run.add_argument("-o", "--output", choices=("table", "json"),
                      default="table")
     run.set_defaults(fn=cmd_run)
+
+    lv = sub.add_parser("live", help="subscribe to a live (streaming) view")
+    lv.add_argument("script", help="library script name or .pxl path")
+    lv.add_argument("--broker", required=True, help="broker netbus HOST:PORT")
+    lv.add_argument("--interval", type=float, default=0.5,
+                    help="agent poll cadence (seconds)")
+    lv.add_argument("--rounds", type=int, default=0,
+                    help="stop after N updates (0 = until interrupted)")
+    lv.add_argument("--timeout", type=float, default=0.0,
+                    help="stop after this many seconds (0 = none)")
+    lv.set_defaults(fn=cmd_live)
 
     sc = sub.add_parser("script", help="script library")
     sc.add_argument("action", choices=("list", "show"))

@@ -1,0 +1,409 @@
+"""Live (streaming) query execution: infinite sources + incremental
+results.
+
+Reference parity: ``src/carnot/exec/memory_source_node.cc`` — a memory
+source with no stop time streams forever, emitting row batches as the
+table grows — and ``query_result_forwarder.go:470`` (StreamResults),
+which relays incremental batches to the subscribed client until cancel.
+
+TPU-first redesign: instead of a long-lived push graph, a **streaming
+cursor** holds a per-tablet row watermark and, each round, folds only
+the windows appended since the last round through the chain's compiled
+fragment:
+
+- Non-blocking chains (Map/Filter/Limit) emit each new batch once
+  (``mode="append"``) — the infinite-MemorySource behavior.
+- Blocking aggregates keep their group state ACROSS rounds: new windows
+  fold into the persistent state and the re-finalized aggregate is
+  emitted each round (``mode="replace"``) — incremental view
+  maintenance, which Carnot does not do (it recomputes live views from
+  scratch on every UI poll).
+
+The distributed form (PEM partial states re-shipped per round, Kelvin
+re-merging latest states) lives in ``services.agent``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from .engine import (
+    Engine,
+    QueryCancelled,
+    QueryError,
+    _double_agg_groups,
+    _stream_col_stats,
+    _Stream,
+    _to_host_batch,
+)
+from .fragment import compile_fragment_cached as compile_fragment
+from .plan import (
+    AggOp,
+    FilterOp,
+    LimitOp,
+    MapOp,
+    MemorySourceOp,
+    Plan,
+    ResultSinkOp,
+    TableSinkOp,
+)
+
+
+@dataclass
+class StreamUpdate:
+    """One incremental result delivery."""
+
+    table: object  # sink name (None for bridge updates)
+    batch: object  # HostBatch | AggStatePayload | RowsPayload
+    seq: int
+    # "append": batch holds only NEW rows; "replace": batch is the full
+    # current aggregate (supersedes every earlier update); "state": a
+    # partial-agg state snapshot for the merge tier (supersedes this
+    # agent's earlier snapshots); "rows": a new-rows bridge payload.
+    mode: str
+    bridge_id: object = None
+
+
+@dataclass
+class _StreamChain:
+    """A linear Source -> ops -> sink slice of a streamable plan."""
+
+    source: MemorySourceOp
+    ops: list
+    sink_name: str
+    is_agg: bool
+    bridge_id: object = None  # set when the terminal is a BridgeSinkOp
+
+
+def _linearize(plan: Plan) -> _StreamChain:
+    """Validate + flatten a streamable plan.
+
+    Streamable = exactly one MemorySource feeding a linear
+    Map/Filter/Agg run into one result sink (or a BridgeSink — the
+    distributed form's per-agent half). Joins/unions/UDTFs stay one-shot
+    (QueryError) — the service layer can still poll those.
+    """
+    from .plan import BridgeSinkOp
+
+    sources = [
+        n for n in plan.nodes.values() if isinstance(n.op, MemorySourceOp)
+    ]
+    if len(sources) != 1:
+        raise QueryError(
+            f"streaming needs exactly one memory source, plan has "
+            f"{len(sources)}"
+        )
+    node = sources[0]
+    src = node.op
+    if src.stop_time is not None:
+        raise QueryError("a time-bounded source cannot stream (stop_time set)")
+    consumers = {
+        nid: [m.id for m in plan.nodes.values() if nid in m.inputs]
+        for nid in plan.nodes
+    }
+    ops: list = []
+    sink = None
+    bridge_id = None
+    cur = node.id
+    while True:
+        outs = consumers[cur]
+        if len(outs) != 1:
+            raise QueryError("streaming plans must be linear (fan-out found)")
+        nxt = plan.nodes[outs[0]]
+        if isinstance(nxt.op, (MapOp, FilterOp, AggOp, LimitOp)):
+            ops.append(nxt.op)
+            cur = nxt.id
+        elif isinstance(nxt.op, (ResultSinkOp, TableSinkOp)):
+            sink = nxt.op
+            break
+        elif isinstance(nxt.op, BridgeSinkOp):
+            bridge_id = nxt.op.bridge_id
+            break
+        else:
+            raise QueryError(
+                f"operator {type(nxt.op).__name__} is not streamable"
+            )
+    # A LimitOp caps total rows; meaningful for append streams only.
+    n_aggs = sum(isinstance(o, AggOp) for o in ops)
+    if n_aggs > 1:
+        raise QueryError("streaming supports at most one aggregate")
+    if bridge_id is not None:
+        name = None
+    else:
+        name = sink.name if isinstance(sink, ResultSinkOp) else sink.table
+    return _StreamChain(
+        source=src, ops=ops, sink_name=name, is_agg=n_aggs == 1,
+        bridge_id=bridge_id,
+    )
+
+
+class StreamingQuery:
+    """A live cursor over one plan: ``poll()`` folds everything appended
+    since the last poll and emits 0..n StreamUpdates; ``run()`` loops
+    until cancelled (the service-loop form)."""
+
+    def __init__(self, engine: Engine, plan: Plan, emit, cancel=None):
+        self.engine = engine
+        self.emit = emit
+        self.cancel = cancel
+        self.chain = _linearize(plan)
+        src = self.chain.source
+        tablets = engine.table_store.tablets(src.table)
+        if not tablets:
+            raise QueryError(f"no table named {src.table!r}")
+        self.tablets = tablets
+        base = next((t for t in tablets if len(t.relation)), tablets[0])
+        self.relation = base.relation
+        self.dicts = dict(base.dicts)
+        pre = []
+        if src.columns is not None:
+            from .engine import _col
+
+            pre.append(MapOp(exprs=tuple((c, _col(c)) for c in src.columns)))
+        self.ops = pre + list(self.chain.ops)
+        self.seq = 0
+        self.rows_emitted = 0
+        self._wm: dict = {}  # id(tablet) -> row watermark
+        for t in tablets:
+            be = getattr(t, "_backend", None)
+            start = src.start_time
+            if be is None:
+                self._wm[id(t)] = 0
+            elif start is not None:
+                self._wm[id(t)] = be.row_id_for_time(int(start), False)
+            else:
+                self._wm[id(t)] = be.first_row_id()
+        self._state = None
+        self._frag = None
+        self._compile()
+
+    def _compile(self):
+        stream = _Stream(self.relation, self.dicts, list(self.ops), self.tablets)
+        self._frag = compile_fragment(
+            self.ops, self.relation, self.dicts, self.engine.registry,
+            col_stats=_stream_col_stats(stream),
+        )
+        if self.chain.is_agg and self._state is not None:
+            # Rebucket path: state restarts from scratch at the new size.
+            self._state = None
+
+    def _new_windows(self):
+        """(cols, valid) device windows appended since the last poll;
+        advances watermarks."""
+        for t in self.tablets:
+            be = getattr(t, "_backend", None)
+            if be is None:
+                continue
+            wm = self._wm[id(t)]
+            end = be.end_row_id()
+            # Ring expiry may have dropped rows under the watermark.
+            wm = max(wm, be.first_row_id())
+            if end <= wm:
+                self._wm[id(t)] = wm
+                continue
+            for win, lo, hi in t.device_scan(
+                window_rows=self.engine.window_rows,
+                start_row=wm, stop_row=end,
+            ):
+                yield win.cols, (
+                    np.int32(lo - win.row0), np.int32(hi - win.row0)
+                )
+            self._wm[id(t)] = end
+
+    def _check_cancel(self):
+        if self.cancel is not None and self.cancel.is_set():
+            raise QueryCancelled("stream cancelled")
+
+    def _fold_new(self, frag):
+        """Shared agg half: fold newly appended windows into the
+        persistent group state. Returns (rows, folded)."""
+        rows = 0
+        if self._state is None:
+            self._state = frag.init_state()
+            # Restart folds everything from the source's start.
+            for t in self.tablets:
+                be = getattr(t, "_backend", None)
+                if be is not None:
+                    start = self.chain.source.start_time
+                    self._wm[id(t)] = (
+                        be.row_id_for_time(int(start), False)
+                        if start is not None
+                        else be.first_row_id()
+                    )
+        folded = False
+        for cols, valid in self._new_windows():
+            self._check_cancel()
+            self._state = frag.update(self._state, cols, valid)
+            rows += int(valid[1] - valid[0])
+            folded = True
+        return rows, folded
+
+    def _rebucket(self):
+        """Group overflow: double capacity (recompiling against fresh
+        stats) and refold history."""
+        new_ops = _double_agg_groups(
+            _Stream(self.relation, self.dicts, list(self.ops), self.tablets)
+        ).chain
+        self.ops = list(new_ops)
+        self._state = None
+        self._compile()
+
+    def poll(self) -> int:
+        """Fold new rows; emit updates. Returns rows consumed."""
+        frag = self._frag
+        rows = 0
+        if self.chain.bridge_id is not None:
+            return self._poll_bridge(frag)
+        if self.chain.is_agg:
+            rows, folded = self._fold_new(frag)
+            if not folded and self.seq > 0:
+                return 0
+            cols, valid, overflow = frag.finalize(self._state)
+            if bool(np.asarray(overflow)):
+                self._rebucket()
+                return self.poll()
+            hb = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
+            if frag.limit is not None and hb.length > frag.limit:
+                hb = _head(hb, frag.limit)
+            self.emit(StreamUpdate(
+                table=self.chain.sink_name, batch=hb, seq=self.seq,
+                mode="replace",
+            ))
+            self.seq += 1
+            return rows
+        # Non-blocking: each new window emits once.
+        for cols, valid in self._new_windows():
+            self._check_cancel()
+            out_cols, out_valid = frag.update(cols, valid)
+            hb = _to_host_batch(frag.out_meta, out_cols, np.asarray(out_valid))
+            if hb.length == 0:
+                rows += int(valid[1] - valid[0])
+                continue
+            if frag.limit is not None:
+                left = frag.limit - self.rows_emitted
+                if left <= 0:
+                    raise StopStream()
+                if hb.length > left:
+                    hb = _head(hb, left)
+            self.emit(StreamUpdate(
+                table=self.chain.sink_name, batch=hb, seq=self.seq,
+                mode="append",
+            ))
+            self.seq += 1
+            self.rows_emitted += hb.length
+            rows += int(valid[1] - valid[0])
+            if frag.limit is not None and self.rows_emitted >= frag.limit:
+                raise StopStream()
+        return rows
+
+    def _poll_bridge(self, frag) -> int:
+        """Per-agent half of a distributed live query: fold new windows,
+        ship the current partial state (agg bridges) or the new rows
+        (row-gather bridges) to the merge tier."""
+        import jax
+
+        from .engine import AggStatePayload, RowsPayload
+
+        rows = 0
+        if self.chain.is_agg:
+            rows, folded = self._fold_new(frag)
+            # The first round ships even an empty (neutral) state: the
+            # merge tier gates on hearing from EVERY data agent, and an
+            # idle agent must not blank the whole live view.
+            if not folded and self.seq > 0:
+                return 0
+            if bool(np.asarray(self._state["overflow"])):
+                self._rebucket()
+                return self._poll_bridge(self._frag)
+            payload = AggStatePayload(
+                chain=tuple(self.ops),
+                input_relation=self.relation,
+                input_dicts=dict(self.dicts),
+                state=jax.tree_util.tree_map(np.asarray, self._state),
+                dense_domains=frag.dense_domains,
+                dense_offsets=frag.dense_offsets,
+            )
+            self.emit(StreamUpdate(
+                table=None, batch=payload, seq=self.seq, mode="state",
+                bridge_id=self.chain.bridge_id,
+            ))
+            self.seq += 1
+            return rows
+        for cols, valid in self._new_windows():
+            self._check_cancel()
+            out_cols, out_valid = frag.update(cols, valid)
+            hb = _to_host_batch(frag.out_meta, out_cols, np.asarray(out_valid))
+            rows += int(valid[1] - valid[0])
+            if hb.length == 0:
+                continue
+            self.emit(StreamUpdate(
+                table=None, batch=RowsPayload(batch=hb), seq=self.seq,
+                mode="rows", bridge_id=self.chain.bridge_id,
+            ))
+            self.seq += 1
+        return rows
+
+    def run(self, poll_interval_s: float = 0.25, max_rounds=None) -> int:
+        """Poll until cancelled (or the row limit / max_rounds hits).
+        Returns the number of updates emitted."""
+        rounds = 0
+        try:
+            while True:
+                self._check_cancel()
+                self.poll()
+                rounds += 1
+                if max_rounds is not None and rounds >= max_rounds:
+                    break
+                if self.cancel is not None:
+                    if self.cancel.wait(poll_interval_s):
+                        break
+                else:
+                    time.sleep(poll_interval_s)
+        except (StopStream, QueryCancelled):
+            pass
+        return self.seq
+
+
+class StopStream(Exception):
+    """Row limit satisfied: the stream ends itself (LimitNode's abort
+    signal to upstream sources)."""
+
+
+def _head(hb, n: int):
+    from ..types.batch import HostBatch
+
+    return HostBatch(
+        relation=hb.relation,
+        cols={c: tuple(p[:n] for p in planes) for c, planes in hb.cols.items()},
+        length=n,
+        dicts=dict(hb.dicts),
+    )
+
+
+def stream_query(
+    engine: Engine, query: str, emit, cancel=None, now_ns: int = 0,
+    max_output_rows: int | None = None,
+) -> StreamingQuery:
+    """Compile a PxL script into a live StreamingQuery on ``engine``.
+
+    ``max_output_rows=None`` (the default) disables the result-sink row
+    cap: a live stream is unbounded by design; pass a value to cap the
+    append stream like the reference's 10k default does for one-shots.
+    """
+    from ..planner import CompilerState, compile_pxl
+
+    state = CompilerState(
+        schemas={
+            name: t.relation
+            for name, t in engine.tables.items()
+            if t is not None and len(t.relation)
+        },
+        registry=engine.registry,
+        now_ns=now_ns,
+        max_output_rows=max_output_rows or (1 << 62),
+    )
+    compiled = compile_pxl(query, state)
+    return StreamingQuery(engine, compiled.plan, emit, cancel=cancel)

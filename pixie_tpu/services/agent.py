@@ -72,6 +72,9 @@ class Agent:
         # qid -> threading.Event for fragments currently executing: a
         # cancel mid-stream aborts between windows (ExecState keep_running).
         self._running: "dict[str, object]" = {}
+        # Live queries (StreamResults analog): qid -> merge state for the
+        # Kelvin half {plan, expect, latest {(bid, agent): payload}, seq}.
+        self._streaming_merges: dict = {}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Agent":
@@ -82,6 +85,15 @@ class Agent:
             self.bus.subscribe(f"agent.{a}.execute", self._on_execute),
             self.bus.subscribe(f"agent.{a}.merge", self._on_merge),
             self.bus.subscribe(f"agent.{a}.bridge", self._on_bridge),
+            self.bus.subscribe(
+                f"agent.{a}.stream_execute", self._on_stream_execute
+            ),
+            self.bus.subscribe(
+                f"agent.{a}.stream_merge", self._on_stream_merge
+            ),
+            self.bus.subscribe(
+                f"agent.{a}.stream_bridge", self._on_stream_bridge
+            ),
             self.bus.subscribe(f"agent.{a}.tracepoint", self._on_tracepoint),
             self.bus.subscribe("query.cancel", self._on_cancel),
         ]
@@ -208,6 +220,7 @@ class Agent:
             while len(self._cancelled) > self._max_cancelled:
                 self._cancelled.pop(next(iter(self._cancelled)))
             self._pending_merges.pop(msg["qid"], None)
+            self._streaming_merges.pop(msg["qid"], None)
             ev = self._running.get(msg["qid"])
         if ev is not None:
             ev.set()
@@ -326,6 +339,217 @@ class Agent:
                 {"table": name, "batch": batch, "agent": self.agent_id},
             )
         self.bus.publish(f"query.{qid}.results", {"eos": True})
+
+
+    # -- live queries (StreamResults analog) ---------------------------------
+    def _on_stream_execute(self, msg):
+        """Run a live data fragment: a streaming cursor folds appended
+        rows on cadence and ships partial states / new rows to the merge
+        agent until the query is cancelled
+        (``query_result_forwarder.go:470`` StreamResults; infinite
+        MemorySource per ``memory_source_node.cc``)."""
+        from ..exec.streaming import StreamingQuery
+
+        qid, plan = msg["qid"], msg["plan"]
+        merge_agent = msg.get("merge_agent")
+        interval = float(msg.get("poll_interval_s", 0.25))
+        ev = threading.Event()
+        with self._lock:
+            if qid in self._cancelled:
+                return
+            self._running[qid] = ev
+
+        def emit(up):
+            if up.mode in ("state", "rows"):
+                self.bus.publish(
+                    f"agent.{merge_agent}.stream_bridge",
+                    {
+                        "qid": qid,
+                        "bridge_id": up.bridge_id,
+                        "from_agent": self.agent_id,
+                        "payload": up.batch,
+                        "seq": up.seq,
+                    },
+                )
+            else:
+                self.bus.publish(
+                    f"query.{qid}.results",
+                    {
+                        "table": up.table,
+                        "batch": up.batch,
+                        "seq": up.seq,
+                        "mode": up.mode,
+                        "agent": self.agent_id,
+                    },
+                )
+
+        def run():
+            try:
+                sq = StreamingQuery(self.engine, plan, emit, cancel=ev)
+                sq.run(poll_interval_s=interval)
+            except Exception as e:
+                if qid not in self._cancelled:
+                    self.bus.publish(
+                        f"query.{qid}.results",
+                        {
+                            "error": f"{self.agent_id}: {e}",
+                            "trace": traceback.format_exc(),
+                        },
+                    )
+            finally:
+                with self._lock:
+                    self._running.pop(qid, None)
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def _stream_state(self, qid):
+        return self._streaming_merges.setdefault(
+            qid,
+            {
+                "plan": None,
+                "expect": None,
+                "latest": {},
+                "pending_rows": [],  # chunks that beat the plan install
+                "seq": 0,
+                "dirty": False,
+                "merging": False,
+                "merge_lock": threading.Lock(),
+            },
+        )
+
+    def _on_stream_merge(self, msg):
+        """Install a live merge: each round's freshest per-agent states
+        re-merge into an updated result (incremental view maintenance —
+        the reference re-runs live views from scratch on every poll)."""
+        qid = msg["qid"]
+        with self._lock:
+            if qid in self._cancelled:
+                return
+            st = self._stream_state(qid)
+            st["plan"] = msg["plan"]
+            st["expect"] = {
+                (bid, aid)
+                for bid in msg["bridge_ids"]
+                for aid in msg["data_agents"]
+            }
+            backlog = st["pending_rows"]
+            st["pending_rows"] = []
+        # Row chunks that raced ahead of the install flow through now, in
+        # arrival order (the one-shot _on_bridge path buffers the same way).
+        for bid, payload in backlog:
+            self._stream_emit_rows(qid, bid, payload)
+        self._maybe_stream_remerge(qid)
+
+    def _on_stream_bridge(self, msg):
+        qid = msg["qid"]
+        from ..exec.engine import RowsPayload
+
+        payload = msg["payload"]
+        with self._lock:
+            if qid in self._cancelled:
+                return
+            st = self._stream_state(qid)
+            if isinstance(payload, RowsPayload):
+                # Row-gather bridges append: every chunk flows through the
+                # merge plan once, independently.
+                st["latest"][(msg["bridge_id"], msg["from_agent"])] = None
+                if st["plan"] is None:
+                    st["pending_rows"].append((msg["bridge_id"], payload))
+                    return
+            else:
+                # Agg bridges replace: only this agent's freshest state
+                # participates in the next re-merge.
+                st["latest"][(msg["bridge_id"], msg["from_agent"])] = payload
+                payload = None
+        if payload is not None:
+            self._stream_emit_rows(qid, msg["bridge_id"], payload)
+        else:
+            self._maybe_stream_remerge(qid)
+
+    def _stream_emit_rows(self, qid, bridge_id, payload):
+        with self._lock:
+            st = self._streaming_merges.get(qid)
+            if st is None or st["plan"] is None:
+                return
+            plan = st["plan"]
+            lock = st["merge_lock"]
+        # Serialize executes + publishes per stream so the client's
+        # arrival order matches seq order.
+        with lock:
+            with self._lock:
+                seq = st["seq"]
+                st["seq"] += 1
+            try:
+                outputs = self.engine.execute_plan(
+                    plan, bridge_inputs={bridge_id: [payload]}
+                )
+            except Exception as e:
+                self.bus.publish(
+                    f"query.{qid}.results",
+                    {"error": f"{self.agent_id}: {e}",
+                     "trace": traceback.format_exc()},
+                )
+                return
+            for name, batch in outputs.items():
+                self.bus.publish(
+                    f"query.{qid}.results",
+                    {"table": name, "batch": batch, "seq": seq,
+                     "mode": "append", "agent": self.agent_id},
+                )
+
+    def _maybe_stream_remerge(self, qid):
+        """Re-merge the freshest per-agent states, coalescing bursts: a
+        merge already in flight absorbs any states that land meanwhile
+        (one follow-up run instead of N stale ones)."""
+        with self._lock:
+            st = self._streaming_merges.get(qid)
+            if (
+                st is None
+                or st["plan"] is None
+                or st["expect"] is None
+                or not st["expect"] <= set(st["latest"])
+            ):
+                return
+            if st["merging"]:
+                st["dirty"] = True
+                return
+            st["merging"] = True
+        try:
+            while True:
+                with self._lock:
+                    st["dirty"] = False
+                    plan = st["plan"]
+                    by_bridge: dict = {}
+                    for (bid, _aid), p in st["latest"].items():
+                        if p is not None:
+                            by_bridge.setdefault(bid, []).append(p)
+                    seq = st["seq"]
+                    st["seq"] += 1
+                if by_bridge:
+                    with st["merge_lock"]:
+                        try:
+                            outputs = self.engine.execute_plan(
+                                plan, bridge_inputs=by_bridge
+                            )
+                        except Exception as e:
+                            self.bus.publish(
+                                f"query.{qid}.results",
+                                {"error": f"{self.agent_id}: {e}",
+                                 "trace": traceback.format_exc()},
+                            )
+                            return
+                        for name, batch in outputs.items():
+                            self.bus.publish(
+                                f"query.{qid}.results",
+                                {"table": name, "batch": batch, "seq": seq,
+                                 "mode": "replace", "agent": self.agent_id},
+                            )
+                with self._lock:
+                    if not st["dirty"]:
+                        return
+        finally:
+            with self._lock:
+                st["merging"] = False
 
 
 class PEMAgent(Agent):

@@ -97,6 +97,22 @@ class QueryResultForwarder:
                 s.unsubscribe()
 
 
+class StreamHandle:
+    """A live query's client handle: ``cancel()`` stops the agents'
+    streaming cursors and detaches the subscriber."""
+
+    def __init__(self, qid: str, broker: "QueryBroker", sub):
+        self.qid = qid
+        self._broker = broker
+        self._sub = sub
+
+    def cancel(self) -> None:
+        self._broker.bus.publish("query.cancel", {"qid": self.qid})
+        if self._sub is not None:
+            self._sub.unsubscribe()
+            self._sub = None
+
+
 class QueryBroker:
     def __init__(
         self,
@@ -116,6 +132,8 @@ class QueryBroker:
         # Dynamic-tracing support (the MutationExecutor dependency,
         # mutation_executor.go:84); wire a TracepointRegistry to enable.
         self.tracepoints = None
+        # Live queries started over the bus (qid -> StreamHandle).
+        self._stream_handles: dict = {}
 
     def execute_script(
         self,
@@ -224,6 +242,79 @@ class QueryBroker:
             result["mutations"] = mutation_states
         return result
 
+    def execute_script_streaming(
+        self,
+        query: str,
+        on_update,
+        poll_interval_s: float = 0.25,
+        now_ns: int = 0,
+    ) -> "StreamHandle":
+        """Live ExecuteScript (StreamResults analog,
+        ``query_result_forwarder.go:470``): dispatch streaming fragments
+        to the agents and deliver incremental result batches to
+        ``on_update`` until ``handle.cancel()``.
+
+        ``on_update`` receives dicts {table, batch, seq, mode, agent}
+        where mode is "append" (new rows) or "replace" (full updated
+        aggregate). Errors arrive as {error}.
+        """
+        compiler_state = CompilerState(
+            schemas=self.tracker.schemas(),
+            registry=self.registry,
+            now_ns=now_ns,
+            max_output_rows=1 << 62,  # live streams are unbounded
+        )
+        state = self.tracker.distributed_state()
+        compiled = compile_pxl(query, compiler_state)
+        try:
+            dplan = self.planner.plan(compiled.plan, state)
+        except PlanningError as e:
+            raise QueryError(str(e)) from e
+        # Validate streamability up front (one linear source chain): a
+        # bad script should fail the call, not trickle errors later.
+        from ..exec.streaming import _linearize
+
+        _linearize(dplan.split.before_blocking)
+
+        qid = uuid.uuid4().hex[:12]
+        data_agents = list(dplan.data_agent_ids)
+        if not dplan.kelvin_agent_ids:
+            raise QueryError("no live agent available to run the query")
+        merge_agent = dplan.kelvin_agent_ids[0]
+
+        cell: dict = {}
+
+        def _relay(msg):
+            on_update(msg)
+            if "error" in msg and cell.get("handle") is not None:
+                # An errored stream never recovers: stop the agents'
+                # polling loops instead of leaking them server-side.
+                cell["handle"].cancel()
+
+        sub = self.bus.subscribe(f"query.{qid}.results", _relay)
+        handle = StreamHandle(qid, self, sub)
+        cell["handle"] = handle
+        self.bus.publish(
+            f"agent.{merge_agent}.stream_merge",
+            {
+                "qid": qid,
+                "plan": dplan.merge_plan,
+                "bridge_ids": [b.bridge_id for b in dplan.split.bridges],
+                "data_agents": data_agents,
+            },
+        )
+        for aid in data_agents:
+            self.bus.publish(
+                f"agent.{aid}.stream_execute",
+                {
+                    "qid": qid,
+                    "plan": dplan.split.before_blocking,
+                    "merge_agent": merge_agent,
+                    "poll_interval_s": poll_interval_s,
+                },
+            )
+        return handle
+
     # -- bus API (the VizierService gRPC surface analog) ---------------------
 
     def serve(self) -> None:
@@ -235,6 +326,11 @@ class QueryBroker:
         Topics (all request/reply via ``_reply_to``):
           broker.execute  {query, timeout_s?, max_output_rows?}
                           -> {ok, qid, tables, agent_stats} | {ok: False, error}
+          broker.execute_stream {query, update_topic, poll_interval_s?}
+                          -> {ok, qid}; incremental updates then flow to
+                          ``update_topic`` as {table, batch, seq, mode}
+                          (or {error}) until broker.stream_cancel {qid}
+          broker.stream_cancel {qid} -> {ok}
           broker.schemas  {} -> {ok, schemas: {table: Relation}}
           broker.agents   {} -> {ok, agents: [agent info dict]}
           broker.scripts  {} -> {ok, scripts: [name]}
@@ -263,6 +359,43 @@ class QueryBroker:
             except Exception as e:  # errors cross the wire as data
                 _reply(msg, {"ok": False, "error": f"{type(e).__name__}: {e}"})
 
+        def _on_execute_stream(msg):
+            topic = msg.get("update_topic")
+            try:
+                if not topic:
+                    raise QueryError("execute_stream needs an update_topic")
+
+                def _push(u, _topic=topic):
+                    # publish() reports delivery count: the client
+                    # subscribed to its inbox before requesting, so zero
+                    # receivers means it disconnected — reap the stream
+                    # rather than polling for a ghost.
+                    if self.bus.publish(_topic, u) == 0:
+                        h = self._stream_handles.pop(
+                            handle_box.get("qid"), None
+                        )
+                        if h is not None:
+                            h.cancel()
+
+                handle_box: dict = {}
+                handle = self.execute_script_streaming(
+                    msg["query"],
+                    on_update=_push,
+                    poll_interval_s=float(msg.get("poll_interval_s", 0.25)),
+                    now_ns=int(msg.get("now_ns", 0)),
+                )
+                handle_box["qid"] = handle.qid
+                self._stream_handles[handle.qid] = handle
+                _reply(msg, {"ok": True, "qid": handle.qid})
+            except Exception as e:
+                _reply(msg, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+        def _on_stream_cancel(msg):
+            handle = self._stream_handles.pop(msg.get("qid"), None)
+            if handle is not None:
+                handle.cancel()
+            _reply(msg, {"ok": True})
+
         def _on_schemas(msg):
             _reply(msg, {"ok": True, "schemas": self.tracker.schemas()})
 
@@ -276,6 +409,8 @@ class QueryBroker:
 
         self._serve_subs = [
             self.bus.subscribe("broker.execute", _on_execute),
+            self.bus.subscribe("broker.execute_stream", _on_execute_stream),
+            self.bus.subscribe("broker.stream_cancel", _on_stream_cancel),
             self.bus.subscribe("broker.schemas", _on_schemas),
             self.bus.subscribe("broker.agents", _on_agents),
             self.bus.subscribe("broker.scripts", _on_scripts),

@@ -575,13 +575,16 @@ class Table:
             self._staged_through = (k + 1) * w
 
     def device_scan(self, start_time=None, stop_time=None,
-                    window_rows: int | None = None):
+                    window_rows: int | None = None, start_row=None,
+                    stop_row=None):
         """Yield (DeviceWindow, lo_row, hi_row) covering the time range.
 
         Windows come from the device-resident cache when staged (zero
         transfer); misses — typically the partial tail window — stage on
         demand and are cached keyed by their length, so a grown tail
-        re-stages while full windows stay immutable.
+        re-stages while full windows stay immutable. ``start_row`` /
+        ``stop_row`` clamp by absolute row id — the streaming
+        (live-query) cursor's watermark interface.
         """
         from .device_cache import DeviceWindowCache, stage_window
 
@@ -600,15 +603,21 @@ class Table:
             self._staged_through = 0
         self._device_cache.evict_other_window_sizes(w)
         if start_time is not None:
-            start_row = be.row_id_for_time(int(start_time), False)
+            row0 = be.row_id_for_time(int(start_time), False)
         else:
-            start_row = be.first_row_id()
+            row0 = be.first_row_id()
+        if start_row is not None:
+            row0 = max(row0, int(start_row))
+        start_row = row0
         if stop_time is not None:
-            stop_row = min(
+            row1 = min(
                 be.row_id_for_time(int(stop_time) - 1, True), be.end_row_id()
             )
         else:
-            stop_row = be.end_row_id()
+            row1 = be.end_row_id()
+        if stop_row is not None:
+            row1 = min(row1, int(stop_row))
+        stop_row = row1
         if stop_row <= start_row:
             return
         for k in range(start_row // w, (stop_row + w - 1) // w):
