@@ -1,0 +1,231 @@
+"""MySQL wire-protocol parser + stitcher: captured bytes -> mysql_events.
+
+Reference parity: the socket tracer's MySQL protocol pair
+(``/root/reference/src/stirling/source_connectors/socket_tracer/
+protocols/mysql/parse.cc`` — packet framing + command classification —
+and ``stitcher.cc`` — request/response pairing with resultset
+consumption). Like the HTTP parser here, capture arrives as byte chunks
+from any tap (proxy, pcap export, fixtures) and flows through an
+incremental per-connection state machine; partial packets survive
+across ``feed`` calls.
+
+Protocol essentials (MySQL client/server protocol, public spec):
+- Every packet: 3-byte little-endian payload length + 1-byte sequence
+  id, then the payload.
+- A client COMMAND packet has sequence id 0; its first payload byte is
+  the command code (COM_QUERY=0x03 carries SQL text). Client packets
+  with seq > 0 belong to the login/auth handshake and are skipped.
+- A response begins with an OK (0x00), ERR (0xff: error code u16 +
+  '#' + 5-byte sqlstate + message) or EOF (0xfe, payload < 9 bytes)
+  packet, or a column-count packet opening a resultset; a resultset
+  runs column definitions then rows, each section closed by EOF (or a
+  terminating OK with the DEPRECATE_EOF capability).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+# Command codes (protocol constants; mysql/types.h Command enum).
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_FIELD_LIST = 0x04
+COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_SEND_LONG_DATA = 0x18
+COM_STMT_CLOSE = 0x19
+MAX_COMMAND = 0x1F
+
+#: Commands whose body is a single human-readable string.
+_STRING_BODY = {COM_QUERY, COM_STMT_PREPARE, COM_INIT_DB, COM_FIELD_LIST}
+#: Commands the server never answers (stitcher.cc kNoResponse set).
+_NO_RESPONSE = {COM_QUIT, COM_STMT_SEND_LONG_DATA, COM_STMT_CLOSE}
+
+# RespStatus enum values (mysql/types.h RespStatus ordering).
+RESP_UNKNOWN = 0
+RESP_NONE = 1
+RESP_OK = 2
+RESP_ERR = 3
+
+
+class _Framer:
+    """Incremental MySQL packet framing for one direction."""
+
+    MAX_BUF = 1 << 20
+
+    def __init__(self):
+        self._buf = b""
+
+    def feed(self, data: bytes):
+        self._buf += data
+        if len(self._buf) > self.MAX_BUF:
+            self._buf = self._buf[-self.MAX_BUF:]
+        out = []
+        while len(self._buf) >= 4:
+            plen = int.from_bytes(self._buf[:3], "little")
+            if len(self._buf) < 4 + plen:
+                break
+            out.append((self._buf[3], self._buf[4:4 + plen]))
+            self._buf = self._buf[4 + plen:]
+        return out
+
+
+class _Conn:
+    def __init__(self):
+        self.req = _Framer()
+        self.resp = _Framer()
+        self.pending: deque = deque()  # (cmd, body, ts)
+        # Resultset consumption state: None = expecting a response head;
+        # otherwise {"eofs": n, "rows": n, "cols": n, "defs_seen": n}.
+        self.rs = None
+        self.last_ts = 0
+
+
+class MySQLStitcher:
+    """Pairs command packets with their responses; emits mysql_events
+    records (``stitcher.cc`` ProcessMySQLPackets)."""
+
+    CONN_IDLE_TTL_NS = 300 * 1_000_000_000
+    CONN_MAX = 4096
+    PENDING_PER_CONN = 256
+
+    def __init__(self, service: str = "", pod: str = ""):
+        self.service = service
+        self.pod = pod
+        self._conns: dict = {}
+        self.records: list[dict] = []
+        self.parse_errors = 0
+
+    def _expire(self, now_ns: int) -> None:
+        cutoff = now_ns - self.CONN_IDLE_TTL_NS
+        if len(self._conns) > 64:
+            self._conns = {
+                cid: c for cid, c in self._conns.items()
+                if c.last_ts >= cutoff
+            }
+        while len(self._conns) >= self.CONN_MAX:
+            lru = min(self._conns, key=lambda cid: self._conns[cid].last_ts)
+            self._conns.pop(lru)
+
+    def _conn(self, conn_id, now_ns: int) -> _Conn:
+        c = self._conns.get(conn_id)
+        if c is None:
+            self._expire(now_ns)
+            c = _Conn()
+            self._conns[conn_id] = c
+        c.last_ts = now_ns
+        return c
+
+    def feed(
+        self, conn_id, data: bytes, is_request: bool,
+        ts_ns: Optional[int] = None,
+    ) -> int:
+        ts = ts_ns if ts_ns is not None else time.time_ns()
+        c = self._conn(conn_id, ts)
+        emitted = 0
+        if is_request:
+            for seq, payload in c.req.feed(data):
+                if seq != 0 or not payload:
+                    continue  # login/auth handshake continuation
+                cmd = payload[0]
+                if cmd > MAX_COMMAND:
+                    self.parse_errors += 1
+                    continue
+                body = (
+                    payload[1:].decode("utf-8", "replace")
+                    if cmd in _STRING_BODY
+                    else ""
+                )
+                if cmd in _NO_RESPONSE:
+                    self._emit(cmd, body, ts, ts, RESP_NONE, "")
+                    emitted += 1
+                    continue
+                if len(c.pending) >= self.PENDING_PER_CONN:
+                    # Positional pairing: overflow kills the tracker (the
+                    # same policy as the HTTP stitcher).
+                    self.parse_errors += len(c.pending) + 1
+                    self._conns.pop(conn_id, None)
+                    return emitted
+                c.pending.append((cmd, body, ts))
+            return emitted
+        for _seq, payload in c.resp.feed(data):
+            emitted += self._response_packet(c, payload, ts)
+        return emitted
+
+    # -- response state machine ----------------------------------------------
+    def _response_packet(self, c: _Conn, payload: bytes, ts: int) -> int:
+        if not c.pending:
+            return 0  # server greeting / unsolicited: connection setup
+        if c.rs is not None:
+            return self._resultset_packet(c, payload, ts)
+        head = payload[0] if payload else -1
+        cmd, _body, _rts = c.pending[0]
+        if head == 0xFF:
+            code = int.from_bytes(payload[1:3], "little") if len(payload) >= 3 else 0
+            msg = payload[9:].decode("utf-8", "replace") if len(payload) > 9 else ""
+            return self._finish(c, ts, RESP_ERR, f"({code}) {msg}".strip())
+        if head == 0x00:
+            return self._finish(c, ts, RESP_OK, "")
+        if head == 0xFE and len(payload) < 9:
+            return self._finish(c, ts, RESP_OK, "")
+        if cmd == COM_STMT_PREPARE:
+            # Prepare-OK: header 0x00 handled above; anything else is a
+            # protocol surprise — classify unknown and move on.
+            return self._finish(c, ts, RESP_UNKNOWN, "")
+        # Column-count packet: a resultset begins.
+        ncols = payload[0] if payload else 0
+        c.rs = {"cols": int(ncols), "defs_seen": 0, "eofs": 0, "rows": 0}
+        return 0
+
+    def _resultset_packet(self, c: _Conn, payload: bytes, ts: int) -> int:
+        head = payload[0] if payload else -1
+        rs = c.rs
+        if head == 0xFF:
+            code = int.from_bytes(payload[1:3], "little") if len(payload) >= 3 else 0
+            msg = payload[9:].decode("utf-8", "replace") if len(payload) > 9 else ""
+            return self._finish(c, ts, RESP_ERR, f"({code}) {msg}".strip())
+        if head == 0xFE and len(payload) < 9:
+            # Classic framing: one EOF closes the column definitions, a
+            # second closes the rows. (DEPRECATE_EOF's OK terminator is
+            # indistinguishable from a row starting 0x00 without the
+            # handshake's capability flags; classic framing is what taps
+            # record.)
+            rs["eofs"] += 1
+            if rs["eofs"] >= 2:
+                return self._finish(
+                    c, ts, RESP_OK, f"Resultset rows={rs['rows']}"
+                )
+            return 0
+        if rs["defs_seen"] < rs["cols"]:
+            rs["defs_seen"] += 1
+        else:
+            rs["rows"] += 1
+        return 0
+
+    def _finish(self, c: _Conn, ts: int, status: int, resp_body: str) -> int:
+        c.rs = None
+        if not c.pending:
+            return 0
+        cmd, body, req_ts = c.pending.popleft()
+        self._emit(cmd, body, req_ts, ts, status, resp_body)
+        return 1
+
+    def _emit(self, cmd, body, req_ts, resp_ts, status, resp_body):
+        self.records.append({
+            "time_": req_ts,
+            "req_cmd": int(cmd),
+            "query_str": body,
+            "resp_status": int(status),
+            "resp_body": resp_body,
+            "latency_ns": max(resp_ts - req_ts, 0),
+            "service": self.service,
+            "pod": self.pod,
+        })
+
+    def drain(self) -> list[dict]:
+        out, self.records = self.records, []
+        return out

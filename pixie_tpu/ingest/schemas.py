@@ -66,6 +66,19 @@ MYSQL_EVENTS_RELATION = Relation(
     ]
 )
 
+# pgsql_table.h kPGSQLTable (subset; req_cmd is the protocol verb).
+PGSQL_EVENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("req_cmd", DataType.STRING),
+        ("req", DataType.STRING),
+        ("resp", DataType.STRING),
+        ("latency_ns", DataType.INT64),
+        ("service", DataType.STRING),
+    ]
+)
+
 # process_stats connector (proc-fs metrics).
 PROCESS_STATS_RELATION = Relation(
     [
@@ -122,6 +135,7 @@ CANONICAL_SCHEMAS: dict[str, Relation] = {
     "conn_stats": CONN_STATS_RELATION,
     "stack_traces.beta": STACK_TRACES_RELATION,
     "mysql_events": MYSQL_EVENTS_RELATION,
+    "pgsql_events": PGSQL_EVENTS_RELATION,
     "process_stats": PROCESS_STATS_RELATION,
     "network_stats": NETWORK_STATS_RELATION,
     "dns_events": DNS_EVENTS_RELATION,

@@ -6,8 +6,10 @@ socket_trace_connector.cc`` TransferData: drain per-connection capture
 buffers through protocol parsers/stitchers into the protocol tables).
 The capture source here is a recorded tap — a JSONL file or an
 in-memory feed of ``{"conn": id, "dir": "req"|"resp", "ts": ns,
-"data_b64": ...}`` events (what a sidecar proxy or pcap exporter
-produces) — pushed through the same incremental HTTP/DNS parsers.
+"proto": "http"|"dns"|"mysql"|"pgsql", "data_b64": ...}`` events (what
+a sidecar proxy or pcap exporter produces) — pushed through the same
+incremental per-protocol parsers/stitchers into http_events,
+dns_events, mysql_events and pgsql_events.
 """
 
 from __future__ import annotations
@@ -16,10 +18,18 @@ import base64
 import json
 from typing import Iterable, Optional
 
+from ..types.dtypes import DataType
 from .core import SourceConnector
 from .dns_parser import DNSStitcher
 from .http_parser import HTTPStitcher
-from .schemas import DNS_EVENTS_RELATION, HTTP_EVENTS_RELATION
+from .mysql_parser import MySQLStitcher
+from .pgsql_parser import PgSQLStitcher
+from .schemas import (
+    DNS_EVENTS_RELATION,
+    HTTP_EVENTS_RELATION,
+    MYSQL_EVENTS_RELATION,
+    PGSQL_EVENTS_RELATION,
+)
 
 
 class CaptureTapConnector(SourceConnector):
@@ -29,6 +39,8 @@ class CaptureTapConnector(SourceConnector):
     tables = [
         ("http_events", HTTP_EVENTS_RELATION),
         ("dns_events", DNS_EVENTS_RELATION),
+        ("mysql_events", MYSQL_EVENTS_RELATION),
+        ("pgsql_events", PGSQL_EVENTS_RELATION),
     ]
 
     def __init__(self, feed: Optional[Iterable] = None, path: str = "",
@@ -39,6 +51,8 @@ class CaptureTapConnector(SourceConnector):
         self._fh = None
         self.http = HTTPStitcher(service=service, pod=pod)
         self.dns = DNSStitcher(pod=pod)
+        self.mysql = MySQLStitcher(service=service, pod=pod)
+        self.pgsql = PgSQLStitcher(service=service, pod=pod)
         self.upid_value = 0
 
     def init(self) -> None:
@@ -74,6 +88,13 @@ class CaptureTapConnector(SourceConnector):
             proto = ev.get("proto", "http")
             if proto == "dns":
                 self.dns.feed(data, ts_ns=ev.get("ts"))
+            elif proto in ("mysql", "pgsql"):
+                stitcher = self.mysql if proto == "mysql" else self.pgsql
+                stitcher.feed(
+                    ev.get("conn", 0), data,
+                    is_request=(ev.get("dir", "req") == "req"),
+                    ts_ns=ev.get("ts"),
+                )
             else:
                 self.http.feed(
                     ev.get("conn", 0), data,
@@ -96,16 +117,22 @@ class CaptureTapConnector(SourceConnector):
                     continue
                 full[name] = self._default_column(name, n, http_recs)
             data_tables["http_events"].append(full)
-        dns_recs = self.dns.drain()
-        if dns_recs:
-            n = len(dns_recs)
+        for table, rel, recs in (
+            ("dns_events", DNS_EVENTS_RELATION, self.dns.drain()),
+            ("mysql_events", MYSQL_EVENTS_RELATION, self.mysql.drain()),
+            ("pgsql_events", PGSQL_EVENTS_RELATION, self.pgsql.drain()),
+        ):
+            if not recs:
+                continue
+            n = len(recs)
             full = {}
-            for name, _dt in DNS_EVENTS_RELATION.items():
+            for name, dt in rel.items():
                 if name == "upid":
                     full[name] = [self.upid_value] * n
                 else:
-                    full[name] = [r.get(name, "") for r in dns_recs]
-            data_tables["dns_events"].append(full)
+                    dflt = "" if dt == DataType.STRING else 0
+                    full[name] = [r.get(name, dflt) for r in recs]
+            data_tables[table].append(full)
 
     def _default_column(self, name: str, n: int, recs):
         if name == "upid":

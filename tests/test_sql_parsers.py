@@ -1,0 +1,270 @@
+"""MySQL / PgSQL wire parsers + stitchers over recorded byte streams.
+
+The test pattern follows the reference's
+``protocols/mysql/parse_test.cc`` / ``pgsql/parse_test.cc``: hand-built
+protocol bytes (incl. partial chunks and garbage) fed through the
+incremental stitchers, then a tap integration test driving captured
+events into mysql_events/pgsql_events and a sql_stats-style query.
+"""
+
+import base64
+
+import numpy as np
+
+from pixie_tpu.ingest.mysql_parser import (
+    COM_PING,
+    COM_QUERY,
+    COM_QUIT,
+    COM_STMT_PREPARE,
+    RESP_ERR,
+    RESP_NONE,
+    RESP_OK,
+    MySQLStitcher,
+)
+from pixie_tpu.ingest.pgsql_parser import PgSQLStitcher
+
+
+# -- byte builders ------------------------------------------------------------
+def my_pkt(seq: int, payload: bytes) -> bytes:
+    return len(payload).to_bytes(3, "little") + bytes([seq]) + payload
+
+
+def my_query(sql: str) -> bytes:
+    return my_pkt(0, bytes([COM_QUERY]) + sql.encode())
+
+
+def my_ok(seq: int = 1) -> bytes:
+    return my_pkt(seq, b"\x00\x00\x00\x02\x00\x00\x00")
+
+
+def my_err(code: int, msg: str, seq: int = 1) -> bytes:
+    return my_pkt(
+        seq,
+        b"\xff" + code.to_bytes(2, "little") + b"#42000" + msg.encode(),
+    )
+
+
+def my_eof(seq: int) -> bytes:
+    return my_pkt(seq, b"\xfe\x00\x00\x02\x00")
+
+
+def my_resultset(n_cols: int, rows: list) -> bytes:
+    out = my_pkt(1, bytes([n_cols]))
+    seq = 2
+    for i in range(n_cols):
+        out += my_pkt(seq, b"\x03def" + f"col{i}".encode())
+        seq += 1
+    out += my_eof(seq)
+    seq += 1
+    for r in rows:
+        out += my_pkt(seq, r)
+        seq += 1
+    out += my_eof(seq)
+    return out
+
+
+def pg_msg(tag: str, body: bytes) -> bytes:
+    return tag.encode() + (len(body) + 4).to_bytes(4, "big") + body
+
+
+def pg_startup() -> bytes:
+    body = (3 << 16).to_bytes(4, "big") + b"user\0app\0\0"
+    return (len(body) + 4).to_bytes(4, "big") + body
+
+
+class TestMySQLStitcher:
+    def test_query_ok_err_pairing(self):
+        st = MySQLStitcher(service="db")
+        st.feed(1, my_query("SELECT 1"), True, ts_ns=100)
+        st.feed(1, my_ok(), False, ts_ns=150)
+        st.feed(1, my_query("UPDATE t SET x=1"), True, ts_ns=200)
+        st.feed(1, my_err(1064, "syntax error"), False, ts_ns=260)
+        recs = st.drain()
+        assert [r["query_str"] for r in recs] == ["SELECT 1", "UPDATE t SET x=1"]
+        assert recs[0]["resp_status"] == RESP_OK
+        assert recs[0]["latency_ns"] == 50
+        assert recs[1]["resp_status"] == RESP_ERR
+        assert "syntax error" in recs[1]["resp_body"]
+        assert "1064" in recs[1]["resp_body"]
+        assert all(r["req_cmd"] == COM_QUERY for r in recs)
+        assert all(r["service"] == "db" for r in recs)
+
+    def test_resultset_consumed_as_one_response(self):
+        st = MySQLStitcher()
+        st.feed(7, my_query("SELECT * FROM t"), True, ts_ns=10)
+        st.feed(7, my_resultset(2, [b"\x01a\x01b", b"\x01c\x01d", b"\x01e\x01f"]),
+                False, ts_ns=90)
+        st.feed(7, my_query("SELECT 2"), True, ts_ns=100)
+        st.feed(7, my_ok(), False, ts_ns=120)
+        recs = st.drain()
+        assert len(recs) == 2
+        assert recs[0]["resp_status"] == RESP_OK
+        assert recs[0]["resp_body"] == "Resultset rows=3"
+        assert recs[1]["query_str"] == "SELECT 2"
+
+    def test_partial_packets_across_feeds(self):
+        st = MySQLStitcher()
+        q = my_query("SELECT now()")
+        st.feed(3, q[:5], True, ts_ns=10)
+        st.feed(3, q[5:], True, ts_ns=11)
+        ok = my_ok()
+        st.feed(3, ok[:2], False, ts_ns=40)
+        st.feed(3, ok[2:], False, ts_ns=41)
+        recs = st.drain()
+        assert len(recs) == 1
+        assert recs[0]["query_str"] == "SELECT now()"
+
+    def test_handshake_and_no_response_commands(self):
+        st = MySQLStitcher()
+        # Server greeting before any request: ignored.
+        st.feed(2, my_pkt(0, b"\x0a8.0.30\x00rest"), False, ts_ns=1)
+        # Client auth continuation (seq 1): ignored.
+        st.feed(2, my_pkt(1, b"loginblob"), True, ts_ns=2)
+        st.feed(2, my_pkt(0, bytes([COM_QUIT])), True, ts_ns=3)
+        st.feed(2, my_pkt(0, bytes([COM_PING])), True, ts_ns=4)
+        st.feed(2, my_ok(), False, ts_ns=9)
+        recs = st.drain()
+        assert len(recs) == 2
+        assert recs[0]["req_cmd"] == COM_QUIT
+        assert recs[0]["resp_status"] == RESP_NONE
+        assert recs[1]["req_cmd"] == COM_PING
+        assert recs[1]["resp_status"] == RESP_OK
+
+    def test_stmt_prepare_body(self):
+        st = MySQLStitcher()
+        st.feed(4, my_pkt(0, bytes([COM_STMT_PREPARE]) + b"SELECT ?"), True,
+                ts_ns=5)
+        st.feed(4, my_ok(), False, ts_ns=6)
+        (rec,) = st.drain()
+        assert rec["req_cmd"] == COM_STMT_PREPARE
+        assert rec["query_str"] == "SELECT ?"
+
+
+class TestPgSQLStitcher:
+    def test_simple_query_roundtrip(self):
+        st = PgSQLStitcher(service="pg")
+        st.feed(1, pg_startup(), True, ts_ns=1)
+        st.feed(1, pg_msg("Q", b"SELECT * FROM users;\0"), True, ts_ns=100)
+        resp = (
+            pg_msg("T", b"\x00\x01name...")
+            + pg_msg("D", b"\x00\x01\x00\x00\x00\x03bob")
+            + pg_msg("D", b"\x00\x01\x00\x00\x00\x03eve")
+            + pg_msg("C", b"SELECT 2\0")
+            + pg_msg("Z", b"I")
+        )
+        st.feed(1, resp, False, ts_ns=180)
+        (rec,) = st.drain()
+        assert rec["req_cmd"] == "QUERY"
+        assert rec["req"] == "SELECT * FROM users;"
+        assert rec["resp"] == "SELECT 2"
+        assert rec["latency_ns"] == 80
+        assert rec["service"] == "pg"
+
+    def test_error_response(self):
+        st = PgSQLStitcher()
+        st.feed(2, pg_startup(), True, ts_ns=1)
+        st.feed(2, pg_msg("Q", b"SELEKT 1;\0"), True, ts_ns=10)
+        err = b"SERROR\0C42601\0Msyntax error at or near \"SELEKT\"\0\0"
+        st.feed(2, pg_msg("E", err) + pg_msg("Z", b"I"), False, ts_ns=25)
+        (rec,) = st.drain()
+        assert "syntax error" in rec["resp"]
+        assert rec["resp"].startswith("ERROR:")
+
+    def test_extended_protocol_parse_bind_execute(self):
+        st = PgSQLStitcher()
+        st.feed(3, pg_startup(), True, ts_ns=1)
+        req = (
+            pg_msg("P", b"\0INSERT INTO t VALUES ($1)\0\x00\x00")
+            + pg_msg("B", b"\0\0\x00\x00\x00\x01...")
+            + pg_msg("E", b"\0\x00\x00\x00\x00")
+            + pg_msg("S", b"")
+        )
+        st.feed(3, req, True, ts_ns=50)
+        resp = (
+            pg_msg("1", b"") + pg_msg("2", b"")
+            + pg_msg("C", b"INSERT 0 1\0") + pg_msg("Z", b"I")
+        )
+        st.feed(3, resp, False, ts_ns=95)
+        (rec,) = st.drain()
+        assert rec["req_cmd"] == "EXECUTE"
+        assert rec["req"] == "INSERT INTO t VALUES ($1)"
+        assert rec["resp"] == "INSERT 0 1"
+        assert rec["latency_ns"] == 45
+
+    def test_partial_messages_and_pipelining(self):
+        st = PgSQLStitcher()
+        st.feed(4, pg_startup(), True, ts_ns=1)
+        q1 = pg_msg("Q", b"SELECT 1;\0")
+        q2 = pg_msg("Q", b"SELECT 2;\0")
+        both = q1 + q2
+        st.feed(4, both[:7], True, ts_ns=10)
+        st.feed(4, both[7:], True, ts_ns=11)
+        resp = (
+            pg_msg("C", b"SELECT 1\0") + pg_msg("Z", b"I")
+            + pg_msg("C", b"SELECT 1\0") + pg_msg("Z", b"I")
+        )
+        st.feed(4, resp, False, ts_ns=30)
+        recs = st.drain()
+        assert [r["req"] for r in recs] == ["SELECT 1;", "SELECT 2;"]
+
+
+class TestTapIntegration:
+    def test_sql_capture_to_query(self):
+        """Recorded mysql+pgsql capture -> tap -> tables -> PxL query:
+        the sql_stats path, end to end (VERDICT r03 ask #6)."""
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.ingest.collector import Collector
+        from pixie_tpu.ingest.tap import CaptureTapConnector
+
+        def ev(conn, direction, data, ts, proto):
+            return {
+                "conn": conn, "dir": direction, "ts": ts, "proto": proto,
+                "data_b64": base64.b64encode(data).decode(),
+            }
+
+        feed = []
+        for i in range(40):
+            q = f"SELECT * FROM orders WHERE id={i}"
+            feed.append(ev(1, "req", my_query(q), 1000 + i * 10, "mysql"))
+            feed.append(ev(1, "resp", my_ok(), 1005 + i * 10, "mysql"))
+        feed.append(ev(9, "req", pg_startup(), 1, "pgsql"))
+        for i in range(25):
+            feed.append(ev(
+                9, "req", pg_msg("Q", f"SELECT {i};\0".encode()),
+                5000 + i * 10, "pgsql",
+            ))
+            feed.append(ev(
+                9, "resp",
+                pg_msg("C", b"SELECT 1\0") + pg_msg("Z", b"I"),
+                5003 + i * 10, "pgsql",
+            ))
+
+        eng = Engine(window_rows=1 << 10)
+        tap = CaptureTapConnector(feed=feed, service="checkout")
+        coll = Collector()
+        coll.wire_to(eng)
+        coll.register_source(tap)
+        tap.transfer_data(coll, coll._data_tables)
+        coll.flush()
+
+        out = eng.execute_query("""
+import px
+df = px.DataFrame(table='mysql_events')
+df.q = px.normalize_mysql(df.query_str)
+out = df.groupby('q').agg(
+    n=('latency_ns', px.count), p50=('latency_ns', px.quantiles))
+px.display(out)
+""")
+        got = out["output"].to_pydict()
+        assert len(got["q"]) == 1  # all 40 normalize to one shape
+        assert int(got["n"][0]) == 40
+
+        out2 = eng.execute_query("""
+import px
+df = px.DataFrame(table='pgsql_events')
+out = df.groupby('req_cmd').agg(n=('latency_ns', px.count))
+px.display(out)
+""")
+        got2 = out2["output"].to_pydict()
+        assert list(got2["req_cmd"]) == ["QUERY"]
+        assert int(got2["n"][0]) == 25
