@@ -17,6 +17,8 @@ from __future__ import annotations
 import time
 from collections import deque
 from dataclasses import dataclass, field
+
+from .conn_table import ConnectionTable
 from typing import Optional
 
 _MAX_BUF = 1 << 20  # per-direction cap; a stuck stream drops oldest bytes
@@ -99,43 +101,30 @@ class _StreamParser:
         return msg, body_start  # no body (the telemetry common case)
 
 
+class _HTTPConn:
+    last_ts = 0
+
+    def __init__(self):
+        self.req = _StreamParser(True)
+        self.resp = _StreamParser(False)
+        self.pending: deque = deque()
+
+
 class HTTPStitcher:
     """Pairs requests with responses per connection; emits http_events
     records (``stitcher.cc`` ProcessMessages)."""
 
-    # Idle connections expire (the reference expires ConnTrackers after
-    # an inactivity window); per-connection pending requests are capped so
-    # a request flood with no responses can't grow without bound.
-    CONN_IDLE_TTL_NS = 300 * 1_000_000_000
-    CONN_MAX = 4096
+    # Per-connection pending requests are capped so a request flood
+    # with no responses can't grow without bound; idle-connection expiry
+    # lives in the shared ConnectionTable.
     PENDING_PER_CONN = 512
 
     def __init__(self, service: str = "", pod: str = ""):
         self.service = service
         self.pod = pod
-        # conn_id -> [req parser, resp parser, pending deque, last_ts]
-        self._conns: dict = {}
+        self._conns = ConnectionTable(_HTTPConn)
         self.records: list[dict] = []
         self.parse_errors = 0
-
-    def _expire(self, now_ns: int) -> None:
-        cutoff = now_ns - self.CONN_IDLE_TTL_NS
-        if len(self._conns) > 64:
-            self._conns = {
-                cid: c for cid, c in self._conns.items() if c[3] >= cutoff
-            }
-        while len(self._conns) >= self.CONN_MAX:
-            lru = min(self._conns, key=lambda cid: self._conns[cid][3])
-            self._conns.pop(lru)
-
-    def _conn(self, conn_id, now_ns: int):
-        c = self._conns.get(conn_id)
-        if c is None:
-            self._expire(now_ns)
-            c = [_StreamParser(True), _StreamParser(False), deque(), now_ns]
-            self._conns[conn_id] = c
-        c[3] = now_ns
-        return c
 
     def feed(
         self, conn_id, data: bytes, is_request: bool,
@@ -143,7 +132,8 @@ class HTTPStitcher:
     ) -> int:
         """Feed one captured chunk; returns records emitted."""
         ts = ts_ns if ts_ns is not None else time.time_ns()
-        req_p, resp_p, pending, _ = self._conn(conn_id, ts)
+        c = self._conns.get(conn_id, ts)
+        req_p, resp_p, pending = c.req, c.resp, c.pending
         emitted = 0
         if is_request:
             for m in req_p.feed(data, ts):
@@ -155,7 +145,7 @@ class HTTPStitcher:
                     # trust): its state is discarded and the drops are
                     # counted; later chunks start a fresh tracker.
                     self.parse_errors += len(pending) + 1
-                    self._conns.pop(conn_id, None)
+                    self._conns.kill(conn_id)
                     return emitted
                 pending.append(m)
         else:

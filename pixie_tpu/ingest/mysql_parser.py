@@ -28,6 +28,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from .conn_table import ConnectionTable
+
 # Command codes (protocol constants; mysql/types.h Command enum).
 COM_QUIT = 0x01
 COM_INIT_DB = 0x02
@@ -59,14 +61,41 @@ class _Framer:
 
     def __init__(self):
         self._buf = b""
+        self._skip = 0  # bytes of an oversized packet still to discard
+        self._skip_head = None  # its first payload byte, when seen
+        self.oversized = 0
 
     def feed(self, data: bytes):
         self._buf += data
-        if len(self._buf) > self.MAX_BUF:
-            self._buf = self._buf[-self.MAX_BUF:]
         out = []
-        while len(self._buf) >= 4:
+        while True:
+            if self._skip:
+                drop = min(self._skip, len(self._buf))
+                self._buf = self._buf[drop:]
+                self._skip -= drop
+                if self._skip:
+                    break
+                # The oversized packet's place in the stream is marked so
+                # the stitcher keeps request/response pairing aligned.
+                out.append((None, self._skip_head))
+                continue
+            if len(self._buf) < 4:
+                break
             plen = int.from_bytes(self._buf[:3], "little")
+            if 4 + plen > self.MAX_BUF:
+                # Protocol allows 16MB packets; discard incrementally —
+                # truncating the buffer mid-packet desyncs framing
+                # forever. The marker keeps pairing aligned and carries
+                # the first payload byte (the command/response head).
+                self.oversized += 1
+                self._skip_head = self._buf[4] if len(self._buf) > 4 else None
+                drop = min(4 + plen, len(self._buf))
+                self._skip = 4 + plen - drop
+                self._buf = self._buf[drop:]
+                if self._skip:
+                    break
+                out.append((None, self._skip_head))
+                continue
             if len(self._buf) < 4 + plen:
                 break
             out.append((self._buf[3], self._buf[4:4 + plen]))
@@ -75,60 +104,51 @@ class _Framer:
 
 
 class _Conn:
+    last_ts = 0
+
     def __init__(self):
         self.req = _Framer()
         self.resp = _Framer()
         self.pending: deque = deque()  # (cmd, body, ts)
         # Resultset consumption state: None = expecting a response head;
-        # otherwise {"eofs": n, "rows": n, "cols": n, "defs_seen": n}.
+        # otherwise {"eofs", "rows", "cols", "defs_seen", "mode"}.
         self.rs = None
-        self.last_ts = 0
+        # Prepare-OK definition packets still to consume (None = not in
+        # a prepare followup; 0 = defs done, trailing EOF may remain).
+        self.prep_skip = None
 
 
 class MySQLStitcher:
     """Pairs command packets with their responses; emits mysql_events
     records (``stitcher.cc`` ProcessMySQLPackets)."""
 
-    CONN_IDLE_TTL_NS = 300 * 1_000_000_000
-    CONN_MAX = 4096
     PENDING_PER_CONN = 256
 
     def __init__(self, service: str = "", pod: str = ""):
         self.service = service
         self.pod = pod
-        self._conns: dict = {}
+        self._conns = ConnectionTable(_Conn)
         self.records: list[dict] = []
         self.parse_errors = 0
-
-    def _expire(self, now_ns: int) -> None:
-        cutoff = now_ns - self.CONN_IDLE_TTL_NS
-        if len(self._conns) > 64:
-            self._conns = {
-                cid: c for cid, c in self._conns.items()
-                if c.last_ts >= cutoff
-            }
-        while len(self._conns) >= self.CONN_MAX:
-            lru = min(self._conns, key=lambda cid: self._conns[cid].last_ts)
-            self._conns.pop(lru)
-
-    def _conn(self, conn_id, now_ns: int) -> _Conn:
-        c = self._conns.get(conn_id)
-        if c is None:
-            self._expire(now_ns)
-            c = _Conn()
-            self._conns[conn_id] = c
-        c.last_ts = now_ns
-        return c
 
     def feed(
         self, conn_id, data: bytes, is_request: bool,
         ts_ns: Optional[int] = None,
     ) -> int:
         ts = ts_ns if ts_ns is not None else time.time_ns()
-        c = self._conn(conn_id, ts)
+        c = self._conns.get(conn_id, ts)
         emitted = 0
         if is_request:
             for seq, payload in c.req.feed(data):
+                if seq is None:
+                    # Oversized command packet (e.g. a multi-MB INSERT):
+                    # body lost, but the slot must pair with its response.
+                    self.parse_errors += 1
+                    head = payload
+                    cmd = head if head is not None and head <= MAX_COMMAND else COM_QUERY
+                    if cmd not in _NO_RESPONSE:
+                        c.pending.append((cmd, "<oversized>", ts))
+                    continue
                 if seq != 0 or not payload:
                     continue  # login/auth handshake continuation
                 cmd = payload[0]
@@ -148,7 +168,7 @@ class MySQLStitcher:
                     # Positional pairing: overflow kills the tracker (the
                     # same policy as the HTTP stitcher).
                     self.parse_errors += len(c.pending) + 1
-                    self._conns.pop(conn_id, None)
+                    self._conns.kill(conn_id)
                     return emitted
                 c.pending.append((cmd, body, ts))
             return emitted
@@ -157,11 +177,15 @@ class MySQLStitcher:
         return emitted
 
     # -- response state machine ----------------------------------------------
-    def _response_packet(self, c: _Conn, payload: bytes, ts: int) -> int:
-        if not c.pending:
+    def _response_packet(self, c: _Conn, payload, ts: int) -> int:
+        if not c.pending and c.prep_skip is None:
             return 0  # server greeting / unsolicited: connection setup
+        if c.prep_skip is not None:
+            return self._prepare_followup(c, payload, ts)
         if c.rs is not None:
             return self._resultset_packet(c, payload, ts)
+        if payload is None:  # oversized packet where a head was expected
+            return self._finish(c, ts, RESP_UNKNOWN, "<oversized>")
         head = payload[0] if payload else -1
         cmd, _body, _rts = c.pending[0]
         if head == 0xFF:
@@ -169,40 +193,96 @@ class MySQLStitcher:
             msg = payload[9:].decode("utf-8", "replace") if len(payload) > 9 else ""
             return self._finish(c, ts, RESP_ERR, f"({code}) {msg}".strip())
         if head == 0x00:
+            if cmd == COM_STMT_PREPARE and len(payload) >= 9:
+                # Prepare-OK carries num_columns/num_params (u16 each);
+                # their definition packets follow and must be consumed or
+                # they would be misread as the NEXT command's response.
+                n_cols = int.from_bytes(payload[5:7], "little")
+                n_params = int.from_bytes(payload[7:9], "little")
+                n = self._finish(c, ts, RESP_OK, "")
+                if n_cols or n_params:
+                    c.prep_skip = n_cols + n_params
+                return n
             return self._finish(c, ts, RESP_OK, "")
         if head == 0xFE and len(payload) < 9:
             return self._finish(c, ts, RESP_OK, "")
         if cmd == COM_STMT_PREPARE:
-            # Prepare-OK: header 0x00 handled above; anything else is a
-            # protocol surprise — classify unknown and move on.
+            # Anything else is a protocol surprise — classify unknown.
             return self._finish(c, ts, RESP_UNKNOWN, "")
-        # Column-count packet: a resultset begins.
+        # Column-count packet: a resultset begins. The framing mode
+        # (classic EOFs vs DEPRECATE_EOF) reveals itself after the
+        # definitions: classic sends an EOF there.
         ncols = payload[0] if payload else 0
-        c.rs = {"cols": int(ncols), "defs_seen": 0, "eofs": 0, "rows": 0}
+        c.rs = {"cols": int(ncols), "defs_seen": 0, "eofs": 0, "rows": 0,
+                "mode": None}
         return 0
 
-    def _resultset_packet(self, c: _Conn, payload: bytes, ts: int) -> int:
-        head = payload[0] if payload else -1
+    def _prepare_followup(self, c: _Conn, payload, ts: int) -> int:
+        """Consume a Prepare-OK's parameter/column definition packets
+        (EOF separators included, in classic framing)."""
+        if payload is not None and payload[:1] == b"\xfe" and len(payload) < 9:
+            if c.prep_skip <= 0:
+                c.prep_skip = None  # trailing EOF closed the last section
+            return 0
+        if c.prep_skip is not None and c.prep_skip > 0:
+            c.prep_skip -= 1
+            if c.prep_skip == 0:
+                # Definitions done; a trailing EOF may still follow (and
+                # is absorbed above); anything else re-enters normally.
+                c.prep_skip = 0
+            return 0
+        # prep_skip exhausted and a non-EOF packet arrived: this packet
+        # belongs to the next response — reprocess it.
+        c.prep_skip = None
+        return self._response_packet(c, payload, ts)
+
+    def _resultset_packet(self, c: _Conn, payload, ts: int) -> int:
         rs = c.rs
+        if payload is None:  # oversized packet: count as one row/def
+            if rs["defs_seen"] < rs["cols"]:
+                rs["defs_seen"] += 1
+            else:
+                rs["rows"] += 1
+            return 0
+        head = payload[0] if payload else -1
         if head == 0xFF:
             code = int.from_bytes(payload[1:3], "little") if len(payload) >= 3 else 0
             msg = payload[9:].decode("utf-8", "replace") if len(payload) > 9 else ""
             return self._finish(c, ts, RESP_ERR, f"({code}) {msg}".strip())
+        in_defs = rs["defs_seen"] < rs["cols"]
         if head == 0xFE and len(payload) < 9:
-            # Classic framing: one EOF closes the column definitions, a
-            # second closes the rows. (DEPRECATE_EOF's OK terminator is
-            # indistinguishable from a row starting 0x00 without the
-            # handshake's capability flags; classic framing is what taps
-            # record.)
+            # An EOF right after the definitions marks classic framing
+            # (defs EOF + rows EOF); the second one ends the resultset.
+            if rs["mode"] is None:
+                rs["mode"] = "classic"
             rs["eofs"] += 1
-            if rs["eofs"] >= 2:
+            if rs["eofs"] >= 2 or rs["mode"] == "deprecate_eof":
                 return self._finish(
                     c, ts, RESP_OK, f"Resultset rows={rs['rows']}"
                 )
             return 0
-        if rs["defs_seen"] < rs["cols"]:
+        if (
+            head == 0xFE and not in_defs and len(payload) < 32
+            and rs["mode"] != "classic"
+        ):
+            # DEPRECATE_EOF (MySQL >= 5.7.5 default): rows end with an
+            # OK packet whose header byte is 0xFE. Distinguished from a
+            # data row by its short length (heuristic — the capability
+            # flags live in the handshake, which taps often miss);
+            # classic mode never takes this branch (its explicit final
+            # EOF is authoritative).
+            return self._finish(
+                c, ts, RESP_OK, f"Resultset rows={rs['rows']}"
+            )
+        if in_defs:
             rs["defs_seen"] += 1
+            if rs["defs_seen"] == rs["cols"]:
+                # Next packet decides the framing mode: EOF = classic,
+                # a row = DEPRECATE_EOF.
+                pass
         else:
+            if rs["mode"] is None:
+                rs["mode"] = "deprecate_eof"
             rs["rows"] += 1
         return 0
 

@@ -32,6 +32,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from .conn_table import ConnectionTable
+
 
 class _Framer:
     """Incremental tagged-message framing for one direction."""
@@ -42,26 +44,38 @@ class _Framer:
         self._buf = b""
         self.frontend = frontend
         self._startup_done = not frontend
+        self._skip = 0  # bytes of an oversized message still to discard
+        self.oversized = 0
 
     def feed(self, data: bytes):
         self._buf += data
-        if len(self._buf) > self.MAX_BUF:
-            self._buf = self._buf[-self.MAX_BUF:]
         out = []
         while True:
+            if self._skip:
+                drop = min(self._skip, len(self._buf))
+                self._buf = self._buf[drop:]
+                self._skip -= drop
+                if self._skip:
+                    break
+                continue
             if self.frontend and not self._startup_done:
                 # Startup / SSLRequest / CancelRequest: length-prefixed,
-                # no tag. Consume until a plausible tagged message leads.
-                if len(self._buf) < 4:
+                # no tag. An SSLRequest (code 80877103) is typically
+                # followed by the real StartupMessage (protocol v3) on
+                # plaintext connections — stay in startup mode until the
+                # StartupMessage itself has been consumed.
+                if len(self._buf) < 8:
                     break
                 ln = int.from_bytes(self._buf[:4], "big")
-                if ln < 4 or ln > self.MAX_BUF:
+                code = int.from_bytes(self._buf[4:8], "big")
+                if ln < 8 or ln > self.MAX_BUF:
                     self._startup_done = True  # already tagged traffic
                     continue
                 if len(self._buf) < ln:
                     break
                 self._buf = self._buf[ln:]
-                self._startup_done = True
+                if code >> 16 == 3:  # StartupMessage (major version 3)
+                    self._startup_done = True
                 continue
             if not self._buf:
                 break
@@ -77,8 +91,19 @@ class _Framer:
             if len(self._buf) < 5:
                 break
             ln = int.from_bytes(self._buf[1:5], "big")
-            if ln < 4 or ln > self.MAX_BUF:
+            if ln < 4:
                 self._buf = self._buf[1:]  # resync: skip a garbage byte
+                continue
+            if ln > self.MAX_BUF:
+                # Oversized message (e.g. a giant COPY payload): discard
+                # its remaining bytes incrementally — truncating the
+                # buffer mid-message would desync framing forever.
+                self.oversized += 1
+                drop = min(1 + ln, len(self._buf))
+                self._skip = 1 + ln - drop
+                self._buf = self._buf[drop:]
+                if self._skip:
+                    break
                 continue
             if len(self._buf) < 1 + ln:
                 break
@@ -111,6 +136,8 @@ def _error_message(body: bytes) -> str:
 
 
 class _Conn:
+    last_ts = 0
+
     def __init__(self):
         self.req = _Framer(frontend=True)
         self.resp = _Framer(frontend=False)
@@ -119,49 +146,26 @@ class _Conn:
         self.resp_parts: list = []
         self.resp_rows = 0
         self.resp_err = ""
-        self.last_ts = 0
 
 
 class PgSQLStitcher:
     """Pairs sync-point exchanges; emits pgsql_events records."""
 
-    CONN_IDLE_TTL_NS = 300 * 1_000_000_000
-    CONN_MAX = 4096
     PENDING_PER_CONN = 256
 
     def __init__(self, service: str = "", pod: str = ""):
         self.service = service
         self.pod = pod
-        self._conns: dict = {}
+        self._conns = ConnectionTable(_Conn)
         self.records: list[dict] = []
         self.parse_errors = 0
-
-    def _expire(self, now_ns: int) -> None:
-        cutoff = now_ns - self.CONN_IDLE_TTL_NS
-        if len(self._conns) > 64:
-            self._conns = {
-                cid: c for cid, c in self._conns.items()
-                if c.last_ts >= cutoff
-            }
-        while len(self._conns) >= self.CONN_MAX:
-            lru = min(self._conns, key=lambda cid: self._conns[cid].last_ts)
-            self._conns.pop(lru)
-
-    def _conn(self, conn_id, now_ns: int) -> _Conn:
-        c = self._conns.get(conn_id)
-        if c is None:
-            self._expire(now_ns)
-            c = _Conn()
-            self._conns[conn_id] = c
-        c.last_ts = now_ns
-        return c
 
     def feed(
         self, conn_id, data: bytes, is_request: bool,
         ts_ns: Optional[int] = None,
     ) -> int:
         ts = ts_ns if ts_ns is not None else time.time_ns()
-        c = self._conn(conn_id, ts)
+        c = self._conns.get(conn_id, ts)
         emitted = 0
         if is_request:
             for tag, body in c.req.feed(data):
@@ -174,7 +178,7 @@ class PgSQLStitcher:
     def _push_pending(self, conn_id, c: _Conn, unit) -> bool:
         if len(c.pending) >= self.PENDING_PER_CONN:
             self.parse_errors += len(c.pending) + 1
-            self._conns.pop(conn_id, None)
+            self._conns.kill(conn_id)
             return False
         c.pending.append(unit)
         return True

@@ -44,8 +44,11 @@ ALL = None  # "requires every column" marker
 def run_rules(plan: Plan, max_output_rows: int = 10_000) -> Plan:
     prune_unreachable(plan)
     fold_constants(plan)
+    prune_noop_filters(plan)
     fuse_quantile_plucks(plan)
     push_filters_below_maps(plan)
+    fuse_consecutive_maps(plan)
+    merge_nodes(plan)
     push_agg_through_join(plan)
     prune_unused_columns(plan)
     add_limit_to_result_sinks(plan, max_output_rows)
@@ -579,6 +582,119 @@ def _paj_out_type(ae, uda, src, lrel, partial_types):
     if src in partial_types:
         return partial_types[src]
     return lrel.col_type(src)
+
+
+# -- common-subplan dedup -----------------------------------------------------
+def merge_nodes(plan: Plan) -> None:
+    """Unify structurally identical subplans so shared work executes
+    once (reference ``optimizer/merge_nodes_rule.h``).
+
+    Bottom-up over the topo order: a node whose (op, canonical inputs)
+    pair was already seen redirects its consumers to the first
+    occurrence. The engine materializes any fan-out node once, so a
+    multi-output script whose branches re-state the same filter/map
+    prefix computes it one time. Sinks never merge (each display/export
+    is its own effect).
+    """
+    from ..exec.plan import (
+        BridgeSinkOp,
+        BridgeSourceOp,
+        OTelExportSinkOp,
+        TableSinkOp,
+        UDTFSourceOp,
+    )
+
+    never = (
+        ResultSinkOp, TableSinkOp, OTelExportSinkOp, BridgeSinkOp,
+        BridgeSourceOp,
+        # UDTFs may be stateful/impure (cluster introspection snapshots).
+        UDTFSourceOp,
+    )
+    canon: dict = {}
+    remap: dict = {}
+    for nid in plan.topo_order():
+        node = plan.nodes[nid]
+        node.inputs = [remap.get(i, i) for i in node.inputs]
+        if isinstance(node.op, never):
+            continue
+        try:
+            key = (node.op, tuple(node.inputs))
+            hash(key)
+        except TypeError:
+            continue
+        if key in canon:
+            remap[nid] = canon[key]
+        else:
+            canon[key] = nid
+    for nid in remap:
+        del plan.nodes[nid]
+
+
+# -- plan-level simplifications ----------------------------------------------
+def prune_noop_filters(plan: Plan) -> None:
+    """Drop FilterOps whose predicate folded to literal True."""
+    for nid in list(plan.nodes):
+        node = plan.nodes.get(nid)
+        if node is None or not isinstance(node.op, FilterOp):
+            continue
+        p = node.op.predicate
+        if isinstance(p, Literal) and p.value is True and node.inputs:
+            src = node.inputs[0]
+            for m in plan.nodes.values():
+                m.inputs = [src if i == nid else i for i in m.inputs]
+            del plan.nodes[nid]
+
+
+def fuse_consecutive_maps(plan: Plan) -> None:
+    """Inline Map(Map(x)) into one projection when the inner map has a
+    single consumer (reference ``combine_consecutive_maps_rule``): the
+    outer expressions substitute the inner's column definitions."""
+    consumers = _consumers(plan)
+    changed = True
+    while changed:
+        changed = False
+        for nid in list(plan.nodes):
+            node = plan.nodes.get(nid)
+            if node is None or not isinstance(node.op, MapOp):
+                continue
+            if not node.inputs:
+                continue
+            inner = plan.nodes.get(node.inputs[0])
+            if (
+                inner is None
+                or not isinstance(inner.op, MapOp)
+                or consumers.get(inner.id, []) != [nid]
+            ):
+                continue
+            defs = dict(inner.op.exprs)
+            # Inlining duplicates an inner expression once per outer
+            # reference; only pass-through/literal defs may be inlined
+            # into multiple sites (the reference rule's copyability
+            # guard) — an expensive expr referenced twice must not run
+            # twice in the fused fragment.
+            refs: dict = {}
+            for _n, e in node.op.exprs:
+                for c in _expr_columns(e, set()):
+                    refs[c] = refs.get(c, 0) + 1
+            if any(
+                refs.get(name, 0) > 1
+                and not isinstance(e, (ColumnRef, Literal))
+                for name, e in defs.items()
+            ):
+                continue
+
+            def subst(e):
+                if isinstance(e, ColumnRef) and e.name in defs:
+                    return defs[e.name]
+                return e
+
+            node.op = MapOp(exprs=tuple(
+                (n, _rewrite_expr(e, subst)) for n, e in node.op.exprs
+            ))
+            node.inputs = list(inner.inputs)
+            del plan.nodes[inner.id]
+            consumers = _consumers(plan)
+            changed = True
 
 
 def prune_unreachable(plan: Plan) -> None:

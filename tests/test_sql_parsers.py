@@ -268,3 +268,92 @@ px.display(out)
         got2 = out2["output"].to_pydict()
         assert list(got2["req_cmd"]) == ["QUERY"]
         assert int(got2["n"][0]) == 25
+
+
+class TestParserHardening:
+    """Regressions for review-found protocol gaps."""
+
+    def test_pg_ssl_preamble_then_startup(self):
+        # sslmode=prefer on plaintext: SSLRequest -> 'N' -> Startup -> Q.
+        st = PgSQLStitcher()
+        sslreq = (8).to_bytes(4, "big") + (80877103).to_bytes(4, "big")
+        st.feed(1, sslreq, True, ts_ns=1)
+        st.feed(1, b"N", False, ts_ns=2)
+        st.feed(1, pg_startup(), True, ts_ns=3)
+        st.feed(1, pg_msg("Q", b"SELECT 1;\0"), True, ts_ns=10)
+        st.feed(1, pg_msg("C", b"SELECT 1\0") + pg_msg("Z", b"I"), False,
+                ts_ns=20)
+        (rec,) = st.drain()
+        assert rec["req"] == "SELECT 1;"
+
+    def test_mysql_deprecate_eof_resultset(self):
+        # MySQL >= 8.0 default framing: no defs EOF; rows end with an
+        # OK packet whose header is 0xFE.
+        st = MySQLStitcher()
+        for i in range(3):
+            st.feed(1, my_query(f"SELECT {i}"), True, ts_ns=i * 100)
+            resp = my_pkt(1, b"\x01")          # 1 column
+            resp += my_pkt(2, b"\x03defc0")    # column definition
+            resp += my_pkt(3, b"\x01a")        # row
+            resp += my_pkt(4, b"\x01b")        # row
+            resp += my_pkt(5, b"\xfe\x00\x00\x02\x00\x00\x00")  # OK-as-EOF
+            st.feed(1, resp, False, ts_ns=i * 100 + 7)
+        recs = st.drain()
+        assert len(recs) == 3
+        assert [r["resp_body"] for r in recs] == ["Resultset rows=2"] * 3
+        assert all(r["latency_ns"] == 7 for r in recs)
+
+    def test_mysql_oversized_packet_keeps_pairing(self):
+        st = MySQLStitcher()
+        big = bytes([COM_QUERY]) + b"x" * (2 << 20)  # 2MB query
+        pkt = len(big).to_bytes(3, "little") + b"\x00" + big
+        for off in range(0, len(pkt), 1 << 16):
+            st.feed(1, pkt[off:off + (1 << 16)], True, ts_ns=10)
+        st.feed(1, my_query("SELECT 1"), True, ts_ns=20)
+        st.feed(1, my_ok(), False, ts_ns=30)  # answers the oversized query
+        st.feed(1, my_ok(), False, ts_ns=40)  # answers SELECT 1
+        recs = st.drain()
+        assert len(recs) == 2
+        assert recs[0]["query_str"] == "<oversized>"
+        assert recs[1]["query_str"] == "SELECT 1"
+        assert recs[1]["latency_ns"] == 20
+        assert st.parse_errors >= 1
+
+    def test_mysql_prepare_definitions_consumed(self):
+        # Prepare-OK with 1 param + 1 column: the four definition/EOF
+        # packets must not bleed into the next command's response.
+        st = MySQLStitcher()
+        st.feed(1, my_pkt(0, bytes([COM_STMT_PREPARE]) + b"SELECT ?"), True,
+                ts_ns=10)
+        prep_ok = my_pkt(1, b"\x00\x01\x00\x00\x00\x01\x00\x01\x00\x00")
+        followup = (
+            my_pkt(2, b"\x03defp0") + my_eof(3)
+            + my_pkt(4, b"\x03defc0") + my_eof(5)
+        )
+        st.feed(1, prep_ok + followup, False, ts_ns=15)
+        st.feed(1, my_query("SELECT 2"), True, ts_ns=20)
+        st.feed(1, my_ok(), False, ts_ns=26)
+        recs = st.drain()
+        assert len(recs) == 2
+        assert recs[0]["req_cmd"] == COM_STMT_PREPARE
+        assert recs[0]["latency_ns"] == 5
+        assert recs[1]["query_str"] == "SELECT 2"
+        assert recs[1]["resp_status"] == RESP_OK
+        assert recs[1]["latency_ns"] == 6
+
+    def test_pg_oversized_copy_payload_skipped(self):
+        st = PgSQLStitcher()
+        st.feed(1, pg_startup(), True, ts_ns=1)
+        # A giant CopyData ('d') message streams through without
+        # desyncing later framing.
+        big_len = (2 << 20) + 4
+        st.feed(1, b"d" + big_len.to_bytes(4, "big"), True, ts_ns=5)
+        payload = b"z" * (2 << 20)
+        for off in range(0, len(payload), 1 << 16):
+            st.feed(1, payload[off:off + (1 << 16)], True, ts_ns=6)
+        st.feed(1, pg_msg("Q", b"SELECT 9;\0"), True, ts_ns=10)
+        st.feed(1, pg_msg("C", b"SELECT 1\0") + pg_msg("Z", b"I"), False,
+                ts_ns=21)
+        (rec,) = st.drain()
+        assert rec["req"] == "SELECT 9;"
+        assert rec["latency_ns"] == 11
