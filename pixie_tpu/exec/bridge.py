@@ -1,0 +1,355 @@
+"""Agent-mode bridge payloads + merge: partial-agg state shipping.
+
+Reference parity: GRPCSinkNode/GRPCSourceNode pairs plus the UDA
+``Serialize``/``DeSerialize`` contract (``src/carnot/exec/
+grpc_sink_node.h:54``, ``udf/udf.h:99-100``). The TPU redesign ships the
+fragment's carry pytree itself — the merge tier recompiles the identical
+fragment and folds states through its associative merge, instead of
+streaming serialized row batches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..types.batch import HostBatch, bucket_capacity
+from ..types.dtypes import DataType
+from ..types.strings import NULL_ID, StringDictionary
+from .fragment import ColumnMeta, compile_fragment_cached as compile_fragment
+from .plan import AggOp
+from .stream import (
+    QueryError,
+    _double_agg_groups,
+    _Stream,
+    _stream_col_stats,
+    _to_host_batch,
+)
+
+
+@dataclass
+class AggStatePayload:
+    """Partial-agg state shipped across a bridge (agent mode).
+
+    The UDA ``Serialize``/``DeSerialize`` analog (``udf.h:99-100``): the
+    serialized form IS the carry pytree plus enough metadata for the
+    merge tier to recompile the identical fragment and realign string
+    dictionary ids. String-valued *carries* (e.g. ``any`` over a string
+    column) are not realigned — only group keys are; such UDAs need a
+    shared dictionary to cross agents.
+    """
+
+    chain: tuple  # fragment ops [pre..., AggOp]
+    input_relation: object  # Relation at fragment input
+    input_dicts: dict  # {col: StringDictionary} at fragment input
+    state: dict  # group-state pytree (numpy leaves)
+    # Dense-domain states ship no key planes (slot index IS the packed
+    # key); the producing fragment's domains let the merge side expand
+    # them back to explicit keys (dictionaries may differ per agent).
+    # ``dense_offsets`` shifts stats-derived integer codes back to values.
+    dense_domains: tuple = ()
+    dense_offsets: tuple = ()
+
+
+@dataclass
+class RowsPayload:
+    """Materialized rows shipped across a bridge (plain GRPCSink analog)."""
+
+    batch: HostBatch
+
+
+@dataclass
+class _PendingAggBridge:
+    """Agg-bridge payloads awaiting their finalize AggOp."""
+
+    payloads: list  # list[AggStatePayload]
+
+
+def _expand_dense_payload(p, group_rel, key_plane_index):
+    """Expand a dense-domain AggStatePayload to explicit key planes.
+
+    Dense states carry no keys (slot index IS the packed key); the merge
+    tier reconstructs them with the same unpack arithmetic the producing
+    fragment's finalize uses, so the generic realign/merge path applies.
+    """
+    import dataclasses
+
+    from .fragment import unpack_dense_slots
+
+    doms = getattr(p, "dense_domains", ())
+    if not doms:
+        return p
+    gd = len(p.state["valid"])
+    keys = unpack_dense_slots(
+        np.arange(gd, dtype=np.int64),
+        doms,
+        [group_rel.col_type(c) for c, _i in key_plane_index],
+        np,
+        offsets=getattr(p, "dense_offsets", ()),
+    )
+    return dataclasses.replace(
+        p, state={**p.state, "keys": tuple(keys)}, dense_domains=(),
+        dense_offsets=(),
+    )
+
+
+def _compact_payload(p):
+    """Shrink an expanded dense-domain payload to its live slots.
+
+    A dense state is domain-sized (up to ``dense_domain_limit`` slots)
+    however few groups are live; merging every payload at that capacity
+    is a large avoidable cost for small aggregates. Live slots compact to
+    the front (padded to a power-of-two bucket with neutral invalid
+    slots, so merge-fragment compiles stay shape-bucketed).
+    """
+    import dataclasses
+
+    import jax
+
+    valid = np.asarray(p.state["valid"])
+    g = len(valid)
+    live = int(valid.sum())
+    cap = bucket_capacity(max(live, 1))
+    if cap >= g:
+        return p
+    idx = np.nonzero(valid)[0]
+    if len(idx) < cap:
+        # Invalid slots hold uda-neutral carries by construction, so any
+        # one of them is safe padding.
+        fill = int(np.nonzero(~valid)[0][0])
+        idx = np.concatenate(
+            [idx, np.full(cap - len(idx), fill, dtype=np.int64)]
+        )
+
+    def take(leaf):
+        a = np.asarray(leaf)
+        return a[idx] if a.ndim and a.shape[0] == g else a
+
+    return dataclasses.replace(p, state={
+        "keys": tuple(take(k) for k in p.state["keys"]),
+        "valid": valid[idx],
+        "carries": jax.tree_util.tree_map(take, p.state["carries"]),
+        "overflow": p.state["overflow"],
+    })
+
+
+def bridge_payload(engine, res):
+    """Produce a BridgeSink payload: partial-agg state for agg chains,
+    materialized rows otherwise (GRPCSinkNode's two modes)."""
+    if isinstance(res, _Stream) and any(
+        isinstance(o, AggOp) for o in res.chain
+    ):
+        import jax
+
+        while True:
+            frag = compile_fragment(
+                res.chain, res.relation, res.dicts, engine.registry,
+                col_stats=_stream_col_stats(res),
+            )
+            state = engine._fold_agg_state(res, frag)
+            if not bool(np.asarray(state["overflow"])):
+                break
+            res = _double_agg_groups(res)  # rebucket before shipping
+        return AggStatePayload(
+            chain=tuple(res.chain),
+            input_relation=res.relation,
+            input_dicts=dict(res.dicts),
+            state=jax.tree_util.tree_map(np.asarray, state),
+            dense_domains=frag.dense_domains,
+            dense_offsets=frag.dense_offsets,
+        )
+    return RowsPayload(batch=engine._materialize(res))
+
+
+def bind_bridge(payloads):
+    from .joins import _union_host
+
+    payloads = payloads if isinstance(payloads, list) else [payloads]
+    if not payloads:
+        raise QueryError("bridge received no payloads")
+    if all(isinstance(p, RowsPayload) for p in payloads):
+        return _union_host([p.batch for p in payloads])
+    if all(isinstance(p, AggStatePayload) for p in payloads):
+        return _PendingAggBridge(payloads)
+    raise QueryError("mixed payload kinds on one bridge")
+
+
+def merge_agg_bridge(engine, pending: _PendingAggBridge) -> HostBatch:
+    """Merge shipped partial-agg states and finalize.
+
+    The agent-mode replacement for the on-mesh collective: states from
+    k agents fold through the fragment's associative merge, after the
+    group-key string ids of every agent are remapped into one
+    canonical dictionary (the reference ships raw strings over GRPC,
+    so alignment is implicit there; here ids must be reconciled).
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from .fragment import _bind_pre_stage, _split_chain
+    from ..types.dtypes import device_dtypes
+
+    p0 = pending.payloads[0]
+    # The merge fragment is compiled WITHOUT dense mode: agents encode
+    # against their own dictionaries, so dense slot spaces are not
+    # comparable across payloads — expand each dense state to explicit
+    # key planes (then compact to live slots: a dense state is
+    # domain-sized regardless of how few groups are live, and the
+    # merge must not inherit that capacity) and realign through the
+    # generic (sort-space) path. The group relation / key planes come
+    # from binding the pre-stage directly — no compile needed before
+    # the payload sizes are known.
+    pre0, agg0, _post0, _limit0 = _split_chain(list(p0.chain))
+    _, rel1, _ = _bind_pre_stage(
+        pre0, p0.input_relation, dict(p0.input_dicts), engine.registry
+    )
+    key_plane_index = tuple(
+        (c, i)
+        for c in agg0.group_cols
+        for i in range(len(device_dtypes(rel1.col_type(c))))
+    )
+    group_rel = rel1
+    pending = _PendingAggBridge(payloads=[
+        _compact_payload(_expand_dense_payload(p, rel1, key_plane_index))
+        for p in pending.payloads
+    ])
+    p0 = pending.payloads[0]
+    # Merge at the largest payload capacity (smaller states pad with
+    # neutral slots below); overflow rebucketing grows it if the
+    # union of live groups spills.
+    g = max(
+        op.max_groups
+        for p in pending.payloads
+        for op in p.chain
+        if isinstance(op, AggOp)
+    )
+    g = max([g] + [len(p.state["valid"]) for p in pending.payloads])
+    chain = [
+        dataclasses.replace(op, max_groups=g) if isinstance(op, AggOp) else op
+        for op in p0.chain
+    ]
+    frag = compile_fragment(
+        chain, p0.input_relation, dict(p0.input_dicts), engine.registry,
+        allow_dense=False,
+    )
+    if frag.string_carry_sources and len(pending.payloads) > 1:
+        # String ids inside a CARRY (not a group key) cannot be
+        # realigned after the fact; reject unless every agent encoded
+        # from the very same dictionary objects (keys only are realigned
+        # here — reference ships raw strings over GRPC instead).
+        for out_name, src_cols in frag.string_carry_sources:
+            for c in src_cols:
+                d0 = pending.payloads[0].input_dicts.get(c)
+                s0 = list(d0.strings) if d0 is not None else None
+                for p in pending.payloads[1:]:
+                    d = p.input_dicts.get(c)
+                    same = (
+                        d is d0
+                        or (d is not None and s0 is not None
+                            and list(d.strings) == s0)
+                    )
+                    if not same:
+                        raise QueryError(
+                            f"aggregate {out_name!r} carries string ids "
+                            f"of column {c!r} across agents whose "
+                            "dictionaries disagree; results would be "
+                            "garbage. Share one dictionary or aggregate "
+                            "after merge."
+                        )
+    # Per-agent post-pre-stage dictionaries for the group columns.
+    per_agent_dicts = []
+    for p in pending.payloads:
+        _, rel1_a, dicts1 = _bind_pre_stage(
+            pre0, p.input_relation, dict(p.input_dicts), engine.registry
+        )
+        if tuple(rel1_a.items()) != tuple(group_rel.items()):
+            raise QueryError(
+                f"bridge schema mismatch: {rel1_a} vs {group_rel}"
+            )
+        per_agent_dicts.append(dicts1)
+    # Canonical dictionary + id remap per string group column.
+    canonical: dict[str, StringDictionary] = {}
+    states = []
+    for p, dicts1 in zip(pending.payloads, per_agent_dicts):
+        keys = list(p.state["keys"])
+        for pi, (c, i) in enumerate(key_plane_index):
+            if group_rel.col_type(c) != DataType.STRING or i != 0:
+                continue
+            src = dicts1.get(c)
+            if src is None:
+                continue
+            dst = canonical.setdefault(c, StringDictionary())
+            remap = np.fromiter(
+                (dst.get_or_add(s) for s in src.strings),
+                dtype=np.int32,
+                count=len(src),
+            )
+            ids = np.asarray(keys[pi])
+            if len(remap) == 0:
+                # Empty dictionary (agent had no rows): every slot is
+                # already the null id — nothing to remap.
+                keys[pi] = np.full_like(ids, NULL_ID, dtype=np.int32)
+            else:
+                keys[pi] = np.where(
+                    ids >= 0, remap[np.clip(ids, 0, None)], NULL_ID
+                ).astype(np.int32)
+        if bool(np.asarray(p.state["overflow"])):
+            # Lost groups at the source cannot be recovered here; the
+            # producing agent rebuckets before shipping (bridge_payload).
+            raise QueryError(
+                "bridge payload arrived with group overflow; producing "
+                "agent failed to rebucket"
+            )
+        states.append({**p.state, "keys": tuple(keys)})
+    while True:
+        # Pad smaller states into g neutral slots, fold-merge, and on
+        # merged-distinct overflow double g and retry from the (still
+        # intact) original states.
+        init = frag.init_state()
+
+        def pad(a, i):
+            a = jnp.asarray(a)
+            if a.ndim == 0 or a.shape[0] >= i.shape[0]:
+                return a
+            return jnp.concatenate([a, i[a.shape[0]:]])
+
+        merge = jax.jit(frag.merge_states)
+        padded = [jax.tree_util.tree_map(pad, s, init) for s in states]
+        acc = padded[0]
+        for s in padded[1:]:
+            acc = merge(acc, s)
+        cols, valid, overflow = frag.finalize(acc)
+        if not bool(overflow):
+            break
+        from ..config import get_flag
+
+        if g * 2 > get_flag("max_groups_limit"):
+            raise QueryError(
+                f"group-by overflow merging bridge states at "
+                f"max_groups={g}; rebucketing past the "
+                f"{get_flag('max_groups_limit')} cap refused "
+                "(PIXIE_TPU_MAX_GROUPS_LIMIT)"
+            )
+        g *= 2
+        chain = [
+            dataclasses.replace(op, max_groups=g)
+            if isinstance(op, AggOp)
+            else op
+            for op in chain
+        ]
+        frag = compile_fragment(
+            chain, p0.input_relation, dict(p0.input_dicts), engine.registry,
+            allow_dense=False,  # states carry explicit key planes
+        )
+    meta = [
+        (
+            ColumnMeta(m.name, m.dtype, dict=canonical[m.name])
+            if m.name in canonical
+            else m
+        )
+        for m in frag.out_meta
+    ]
+    return _to_host_batch(meta, cols, np.asarray(valid))

@@ -9,30 +9,47 @@ TPU execution model:
 - Tables stream through in fixed-capacity windows (static shapes -> one
   compile, reused every window; the Table::Cursor batch loop analog).
 - DAG joints (Join/Union) materialize their small (post-agg) inputs and
-  continue; joins run host-side on dense ids (N:1, right-unique).
+  continue; joins run host-side on dense ids (N:1, right-unique) or
+  fuse into the probe fragment (see joins.py).
 - Aggregation group state survives across windows via the regroup
   machinery, so a billion-row table aggregates in O(windows) device
   dispatches with O(G) memory.
+
+Module layout (split r5): stream.py (stream/result primitives),
+joins.py (join routing + union + fused lookup build), bridge.py
+(agent-mode payloads + merge). This module keeps the Engine facade and
+the window-staging/fold execution core, and re-exports the split names
+for compatibility.
 """
 
 from __future__ import annotations
 
-import functools
 import itertools
-import json
 import threading
 import time
-from dataclasses import dataclass, field
-from typing import Optional
 
 import numpy as np
 
 from ..types.batch import HostBatch, bucket_capacity
-from ..types.dtypes import DataType, host_dtypes
 from ..types.relation import Relation
-from ..types.strings import NULL_ID, StringDictionary
 from ..udf.registry import Registry, default_registry
-from .fragment import ColumnMeta, compile_fragment_cached as compile_fragment
+from .bridge import (  # noqa: F401  (re-exported)
+    AggStatePayload,
+    RowsPayload,
+    _PendingAggBridge,
+    _compact_payload,
+    _expand_dense_payload,
+    bind_bridge,
+    bridge_payload,
+    merge_agg_bridge,
+)
+from .fragment import compile_fragment_cached as compile_fragment
+from .joins import (  # noqa: F401  (re-exported)
+    DEVICE_JOIN_MIN_ROWS,
+    _join_dispatch,
+    _union_host,
+    try_fused_join,
+)
 from .plan import (
     AggOp,
     TableSinkOp,
@@ -42,7 +59,6 @@ from .plan import (
     FilterOp,
     JoinOp,
     LimitOp,
-    LookupJoinOp,
     MapOp,
     MemorySourceOp,
     OTelExportSinkOp,
@@ -51,179 +67,22 @@ from .plan import (
     UDTFSourceOp,
     UnionOp,
 )
-
-
-@dataclass
-class AggStatePayload:
-    """Partial-agg state shipped across a bridge (agent mode).
-
-    The UDA ``Serialize``/``DeSerialize`` analog (``udf.h:99-100``): the
-    serialized form IS the carry pytree plus enough metadata for the
-    merge tier to recompile the identical fragment and realign string
-    dictionary ids. String-valued *carries* (e.g. ``any`` over a string
-    column) are not realigned — only group keys are; such UDAs need a
-    shared dictionary to cross agents.
-    """
-
-    chain: tuple  # fragment ops [pre..., AggOp]
-    input_relation: object  # Relation at fragment input
-    input_dicts: dict  # {col: StringDictionary} at fragment input
-    state: dict  # group-state pytree (numpy leaves)
-    # Dense-domain states ship no key planes (slot index IS the packed
-    # key); the producing fragment's domains let the merge side expand
-    # them back to explicit keys (dictionaries may differ per agent).
-    # ``dense_offsets`` shifts stats-derived integer codes back to values.
-    dense_domains: tuple = ()
-    dense_offsets: tuple = ()
-
-
-@dataclass
-class RowsPayload:
-    """Materialized rows shipped across a bridge (plain GRPCSink analog)."""
-
-    batch: HostBatch
-
-
-@dataclass
-class _PendingAggBridge:
-    """Agg-bridge payloads awaiting their finalize AggOp."""
-
-    payloads: list  # list[AggStatePayload]
-
-
-def _expand_dense_payload(p, group_rel, key_plane_index):
-    """Expand a dense-domain AggStatePayload to explicit key planes.
-
-    Dense states carry no keys (slot index IS the packed key); the merge
-    tier reconstructs them with the same unpack arithmetic the producing
-    fragment's finalize uses, so the generic realign/merge path applies.
-    """
-    import dataclasses
-
-    from .fragment import unpack_dense_slots
-
-    doms = getattr(p, "dense_domains", ())
-    if not doms:
-        return p
-    gd = len(p.state["valid"])
-    keys = unpack_dense_slots(
-        np.arange(gd, dtype=np.int64),
-        doms,
-        [group_rel.col_type(c) for c, _i in key_plane_index],
-        np,
-        offsets=getattr(p, "dense_offsets", ()),
-    )
-    return dataclasses.replace(
-        p, state={**p.state, "keys": tuple(keys)}, dense_domains=(),
-        dense_offsets=(),
-    )
-
-
-def _compact_payload(p):
-    """Shrink an expanded dense-domain payload to its live slots.
-
-    A dense state is domain-sized (up to ``dense_domain_limit`` slots)
-    however few groups are live; merging every payload at that capacity
-    is a large avoidable cost for small aggregates. Live slots compact to
-    the front (padded to a power-of-two bucket with neutral invalid
-    slots, so merge-fragment compiles stay shape-bucketed).
-    """
-    import dataclasses
-
-    import jax
-
-    valid = np.asarray(p.state["valid"])
-    g = len(valid)
-    live = int(valid.sum())
-    cap = bucket_capacity(max(live, 1))
-    if cap >= g:
-        return p
-    idx = np.nonzero(valid)[0]
-    if len(idx) < cap:
-        # Invalid slots hold uda-neutral carries by construction, so any
-        # one of them is safe padding.
-        fill = int(np.nonzero(~valid)[0][0])
-        idx = np.concatenate(
-            [idx, np.full(cap - len(idx), fill, dtype=np.int64)]
-        )
-
-    def take(leaf):
-        a = np.asarray(leaf)
-        return a[idx] if a.ndim and a.shape[0] == g else a
-
-    return dataclasses.replace(p, state={
-        "keys": tuple(take(k) for k in p.state["keys"]),
-        "valid": valid[idx],
-        "carries": jax.tree_util.tree_map(take, p.state["carries"]),
-        "overflow": p.state["overflow"],
-    })
-
-
-class QueryError(Exception):
-    pass
-
-
-class QueryCancelled(QueryError):
-    """Raised mid-stream when a query's cancel event fires (the
-    ExecState::keep_running / exec_graph abort path,
-    ``src/carnot/exec/exec_state.h``)."""
-
-
-@dataclass
-class _Stream:
-    relation: Relation
-    dicts: dict
-    chain: list
-    source: object  # list[Table] | Table | HostBatch
-    source_op: Optional[MemorySourceOp] = None
-    # Query-constant side-input arrays (numpy, keyed by reserved names)
-    # passed to the fragment program alongside each window — the build
-    # tables of fused lookup joins ride here, staged once per query.
-    side: dict = field(default_factory=dict)
-
-    def extend(self, op):
-        return _Stream(
-            self.relation, self.dicts, self.chain + [op], self.source,
-            self.source_op, dict(self.side),
-        )
-
-
-def _chain_out_relation(stream: "_Stream", registry):
-    """(relation, dicts) after a stream's pre-stage chain, or None if the
-    chain does not bind (the caller falls back to the generic path)."""
-    from .fragment import _bind_pre_stage
-
-    try:
-        _, rel, dicts = _bind_pre_stage(
-            list(stream.chain), stream.relation, dict(stream.dicts), registry
-        )
-    except Exception:
-        return None
-    return rel, dicts
-
-
-def _stream_col_stats(stream: "_Stream"):
-    """Merged per-column (min, max) bounds across a stream's source
-    tablets (None when the source is not table-backed or any tablet
-    lacks stats for a column)."""
-    src = stream.source
-    if not isinstance(src, list) or not src:
-        return None
-    merged: dict | None = None
-    for t in src:
-        ts = getattr(t, "col_stats", None)
-        if ts is None:
-            return None
-        if not ts:
-            continue  # empty tablet (or no int columns): contributes no rows
-        if merged is None:
-            merged = dict(ts)
-        else:
-            merged = {
-                c: (min(merged[c][0], ts[c][0]), max(merged[c][1], ts[c][1]))
-                for c in merged.keys() & ts.keys()
-            }
-    return merged or None
+from .stream import (  # noqa: F401  (re-exported)
+    QueryCancelled,
+    QueryError,
+    _apply_limit,
+    _block_if,
+    _chain_out_relation,
+    _col,
+    _concat_host,
+    _double_agg_groups,
+    _empty_host_batch,
+    _Stream,
+    _stream_col_stats,
+    _timed,
+    _to_host_batch,
+    _window_shapes,
+)
 
 
 class DeviceResult:
@@ -254,7 +113,7 @@ class DeviceResult:
         self._overflow = overflow
         self._stats = stats
         self._qstats = qstats  # the CREATING query's stats (analyze mode)
-        self._host: Optional[HostBatch] = None
+        self._host: HostBatch | None = None
 
     @property
     def relation(self):
@@ -494,7 +353,7 @@ class Engine:
                         raise QueryError(
                             "agg bridge must feed its finalize AggOp"
                         )
-                    results[nid] = self._merge_agg_bridge(upstream)
+                    results[nid] = merge_agg_bridge(self, upstream)
                     continue
                 st = self._as_stream(upstream)
                 if st.chain and isinstance(st.chain[-1], LimitOp):
@@ -511,7 +370,7 @@ class Engine:
                     st = self._as_stream(self._materialize(st))
                 results[nid] = st.extend(op)
             elif isinstance(op, JoinOp):
-                fused = self._try_fused_join(nid, node, results, consumers)
+                fused = try_fused_join(self, nid, node, results, consumers)
                 if fused is not None:
                     results[nid] = fused
                 else:
@@ -546,13 +405,13 @@ class Engine:
                 payload = batch_to_otlp(mat_input(node.inputs[0]), op.spec)
                 self.export_otel(payload, op.spec.endpoint)
             elif isinstance(op, BridgeSinkOp):
-                outputs[("bridge", op.bridge_id)] = self._bridge_payload(
-                    results[node.inputs[0]]
+                outputs[("bridge", op.bridge_id)] = bridge_payload(
+                    self, results[node.inputs[0]]
                 )
             elif isinstance(op, BridgeSourceOp):
                 if not bridge_inputs or op.bridge_id not in bridge_inputs:
                     raise QueryError(f"no input for bridge {op.bridge_id}")
-                results[nid] = self._bind_bridge(bridge_inputs[op.bridge_id])
+                results[nid] = bind_bridge(bridge_inputs[op.bridge_id])
             else:
                 raise QueryError(f"unsupported operator {op}")
             # Fan-out of a stream: materialize once, share the batch.
@@ -582,7 +441,7 @@ class Engine:
         hb = HostBatch.from_pydict(data, relation=rel, time_cols=())
         return hb
 
-    # -- bridge (agent-mode) machinery ----------------------------------------
+    # -- window fold core -----------------------------------------------------
     def _fold_agg_state(self, stream: "_Stream", frag, stats=None):
         """Stream the source through the fragment's window fold, returning
         the accumulated (unfinalized) group state.
@@ -649,223 +508,6 @@ class Engine:
             _block_if(stats, state)
         return state
 
-    def _bridge_payload(self, res):
-        """Produce a BridgeSink payload: partial-agg state for agg chains,
-        materialized rows otherwise (GRPCSinkNode's two modes)."""
-        if isinstance(res, _Stream) and any(
-            isinstance(o, AggOp) for o in res.chain
-        ):
-            import jax
-
-            while True:
-                frag = compile_fragment(
-                    res.chain, res.relation, res.dicts, self.registry,
-                    col_stats=_stream_col_stats(res),
-                )
-                state = self._fold_agg_state(res, frag)
-                if not bool(np.asarray(state["overflow"])):
-                    break
-                res = _double_agg_groups(res)  # rebucket before shipping
-            return AggStatePayload(
-                chain=tuple(res.chain),
-                input_relation=res.relation,
-                input_dicts=dict(res.dicts),
-                state=jax.tree_util.tree_map(np.asarray, state),
-                dense_domains=frag.dense_domains,
-                dense_offsets=frag.dense_offsets,
-            )
-        return RowsPayload(batch=self._materialize(res))
-
-    def _bind_bridge(self, payloads):
-        payloads = payloads if isinstance(payloads, list) else [payloads]
-        if not payloads:
-            raise QueryError("bridge received no payloads")
-        if all(isinstance(p, RowsPayload) for p in payloads):
-            return _union_host([p.batch for p in payloads])
-        if all(isinstance(p, AggStatePayload) for p in payloads):
-            return _PendingAggBridge(payloads)
-        raise QueryError("mixed payload kinds on one bridge")
-
-    def _merge_agg_bridge(self, pending: _PendingAggBridge) -> HostBatch:
-        """Merge shipped partial-agg states and finalize.
-
-        The agent-mode replacement for the on-mesh collective: states from
-        k agents fold through the fragment's associative merge, after the
-        group-key string ids of every agent are remapped into one
-        canonical dictionary (the reference ships raw strings over GRPC,
-        so alignment is implicit there; here ids must be reconciled).
-        """
-        import dataclasses
-
-        import jax
-        import jax.numpy as jnp
-
-        from .fragment import _bind_pre_stage, _split_chain
-
-        p0 = pending.payloads[0]
-        # The merge fragment is compiled WITHOUT dense mode: agents encode
-        # against their own dictionaries, so dense slot spaces are not
-        # comparable across payloads — expand each dense state to explicit
-        # key planes (then compact to live slots: a dense state is
-        # domain-sized regardless of how few groups are live, and the
-        # merge must not inherit that capacity) and realign through the
-        # generic (sort-space) path. The group relation / key planes come
-        # from binding the pre-stage directly — no compile needed before
-        # the payload sizes are known.
-        from ..types.dtypes import device_dtypes
-
-        pre0, agg0, _post0, _limit0 = _split_chain(list(p0.chain))
-        _, rel1, _ = _bind_pre_stage(
-            pre0, p0.input_relation, dict(p0.input_dicts), self.registry
-        )
-        key_plane_index = tuple(
-            (c, i)
-            for c in agg0.group_cols
-            for i in range(len(device_dtypes(rel1.col_type(c))))
-        )
-        group_rel = rel1
-        pending = _PendingAggBridge(payloads=[
-            _compact_payload(_expand_dense_payload(p, rel1, key_plane_index))
-            for p in pending.payloads
-        ])
-        p0 = pending.payloads[0]
-        # Merge at the largest payload capacity (smaller states pad with
-        # neutral slots below); overflow rebucketing grows it if the
-        # union of live groups spills.
-        g = max(
-            op.max_groups
-            for p in pending.payloads
-            for op in p.chain
-            if isinstance(op, AggOp)
-        )
-        g = max([g] + [len(p.state["valid"]) for p in pending.payloads])
-        chain = [
-            dataclasses.replace(op, max_groups=g) if isinstance(op, AggOp) else op
-            for op in p0.chain
-        ]
-        frag = compile_fragment(
-            chain, p0.input_relation, dict(p0.input_dicts), self.registry,
-            allow_dense=False,
-        )
-        if frag.string_carry_sources and len(pending.payloads) > 1:
-            # String ids inside a CARRY (not a group key) cannot be
-            # realigned after the fact; reject unless every agent encoded
-            # from the very same dictionary objects (engine.py realigns
-            # keys only — reference ships raw strings over GRPC instead).
-            for out_name, src_cols in frag.string_carry_sources:
-                for c in src_cols:
-                    d0 = pending.payloads[0].input_dicts.get(c)
-                    s0 = list(d0.strings) if d0 is not None else None
-                    for p in pending.payloads[1:]:
-                        d = p.input_dicts.get(c)
-                        same = (
-                            d is d0
-                            or (d is not None and s0 is not None
-                                and list(d.strings) == s0)
-                        )
-                        if not same:
-                            raise QueryError(
-                                f"aggregate {out_name!r} carries string ids "
-                                f"of column {c!r} across agents whose "
-                                "dictionaries disagree; results would be "
-                                "garbage. Share one dictionary or aggregate "
-                                "after merge."
-                            )
-        # Per-agent post-pre-stage dictionaries for the group columns.
-        per_agent_dicts = []
-        for p in pending.payloads:
-            _, rel1_a, dicts1 = _bind_pre_stage(
-                pre0, p.input_relation, dict(p.input_dicts), self.registry
-            )
-            if tuple(rel1_a.items()) != tuple(group_rel.items()):
-                raise QueryError(
-                    f"bridge schema mismatch: {rel1_a} vs {group_rel}"
-                )
-            per_agent_dicts.append(dicts1)
-        # Canonical dictionary + id remap per string group column.
-        canonical: dict[str, StringDictionary] = {}
-        states = []
-        for p, dicts1 in zip(pending.payloads, per_agent_dicts):
-            keys = list(p.state["keys"])
-            for pi, (c, i) in enumerate(key_plane_index):
-                if group_rel.col_type(c) != DataType.STRING or i != 0:
-                    continue
-                src = dicts1.get(c)
-                if src is None:
-                    continue
-                dst = canonical.setdefault(c, StringDictionary())
-                remap = np.fromiter(
-                    (dst.get_or_add(s) for s in src.strings),
-                    dtype=np.int32,
-                    count=len(src),
-                )
-                ids = np.asarray(keys[pi])
-                if len(remap) == 0:
-                    # Empty dictionary (agent had no rows): every slot is
-                    # already the null id — nothing to remap.
-                    keys[pi] = np.full_like(ids, NULL_ID, dtype=np.int32)
-                else:
-                    keys[pi] = np.where(
-                        ids >= 0, remap[np.clip(ids, 0, None)], NULL_ID
-                    ).astype(np.int32)
-            if bool(np.asarray(p.state["overflow"])):
-                # Lost groups at the source cannot be recovered here; the
-                # producing agent rebuckets before shipping (_bridge_payload).
-                raise QueryError(
-                    "bridge payload arrived with group overflow; producing "
-                    "agent failed to rebucket"
-                )
-            states.append({**p.state, "keys": tuple(keys)})
-        while True:
-            # Pad smaller states into g neutral slots, fold-merge, and on
-            # merged-distinct overflow double g and retry from the (still
-            # intact) original states.
-            init = frag.init_state()
-
-            def pad(a, i):
-                a = jnp.asarray(a)
-                if a.ndim == 0 or a.shape[0] >= i.shape[0]:
-                    return a
-                return jnp.concatenate([a, i[a.shape[0]:]])
-
-            merge = jax.jit(frag.merge_states)
-            padded = [jax.tree_util.tree_map(pad, s, init) for s in states]
-            acc = padded[0]
-            for s in padded[1:]:
-                acc = merge(acc, s)
-            cols, valid, overflow = frag.finalize(acc)
-            if not bool(overflow):
-                break
-            from ..config import get_flag
-
-            if g * 2 > get_flag("max_groups_limit"):
-                raise QueryError(
-                    f"group-by overflow merging bridge states at "
-                    f"max_groups={g}; rebucketing past the "
-                    f"{get_flag('max_groups_limit')} cap refused "
-                    "(PIXIE_TPU_MAX_GROUPS_LIMIT)"
-                )
-            g *= 2
-            chain = [
-                dataclasses.replace(op, max_groups=g)
-                if isinstance(op, AggOp)
-                else op
-                for op in chain
-            ]
-            frag = compile_fragment(
-                chain, p0.input_relation, dict(p0.input_dicts), self.registry,
-                allow_dense=False,  # states carry explicit key planes
-            )
-        meta = [
-            (
-                ColumnMeta(m.name, m.dtype, dict=canonical[m.name])
-                if m.name in canonical
-                else m
-            )
-            for m in frag.out_meta
-        ]
-        return _to_host_batch(meta, cols, np.asarray(valid))
-
     # -- internals -----------------------------------------------------------
     def _as_stream(self, res) -> _Stream:
         if isinstance(res, _Stream):
@@ -910,6 +552,10 @@ class Engine:
     # Whether this engine may consume device-resident table windows (HBM
     # cold store). DistributedEngine stages row-sharded instead.
     device_residency = True
+    # Whether N:1 joins may fuse into probe fragments as device lookups
+    # (joins.try_fused_join); DistributedEngine gates this on mesh
+    # side-table replication.
+    fused_lookup_join = True
 
     def _window_capacity(self, length: int) -> int:
         return max(bucket_capacity(self.window_rows), bucket_capacity(length))
@@ -948,8 +594,6 @@ class Engine:
 
     def _staged_windows_inner(self, stream: "_Stream", stats=None):
         from ..config import get_flag
-
-        import jax
 
         use_cache = (
             self.device_residency
@@ -998,227 +642,6 @@ class Engine:
             return frag.init_state, frag.update, None
         return None, None, frag.update
 
-    # -- fused lookup join ----------------------------------------------------
-    # DistributedEngine turns this off: side tables would need replicated
-    # shardings through the shard_map specs (future work with mesh
-    # residency).
-    fused_lookup_join = True
-
-    def _try_fused_join(self, nid, node, results, consumers):
-        """N:1 join as an in-fragment device lookup, or None to fall back.
-
-        Reference contrast: ``equijoin_node.cc`` materializes output rows
-        through a host hash map; here, when the build side resolves to a
-        dense-domain table, the probe stream keeps flowing — each window
-        gathers the build columns on device and the downstream
-        Map/Filter/Agg fuse into the same XLA program (VERDICT r03 ask
-        #2: output-row assembly never leaves the device).
-        """
-        from ..types.dtypes import device_dtypes
-
-        op = node.op
-        if not self.fused_lookup_join:
-            return None
-        if op.how not in ("inner", "left") or len(op.left_on) != 1:
-            return None
-        left_id, right_id = node.inputs
-        left_res = results[left_id]
-        if not isinstance(left_res, _Stream) or consumers.get(left_id, 0) > 1:
-            return None
-        if any(isinstance(o, (AggOp, LimitOp)) for o in left_res.chain):
-            return None
-        lc, rc = op.left_on[0], op.right_on[0]
-        bound = _chain_out_relation(left_res, self.registry)
-        if bound is None:
-            return None
-        left_rel, left_dicts = bound
-        if not left_rel.has_column(lc):
-            return None
-        l_dt = left_rel.col_type(lc)
-        if len(device_dtypes(l_dt)) != 1:
-            return None
-
-        right_res = results[right_id]
-        if (
-            isinstance(right_res, _Stream)
-            and consumers.get(right_id, 0) <= 1
-            and any(isinstance(o, AggOp) for o in right_res.chain)
-        ):
-            built = self._dense_agg_build(right_res, op, l_dt, left_dicts, lc, rc)
-            if isinstance(built, tuple) and built[0] == "fallback":
-                # The aggregate already executed; keep its rows for the
-                # generic join path rather than re-folding the stream.
-                results[right_id] = built[1]
-                built = self._host_table_build(
-                    built[1], op, l_dt, left_dicts, lc, rc
-                )
-        else:
-            if not isinstance(right_res, HostBatch):
-                return None
-            built = self._host_table_build(right_res, op, l_dt, left_dicts, lc, rc)
-        if built is None:
-            return None
-        lo, dom, found, value_tables, right_rel = built
-
-        # Output naming: all left columns keep their names; right value
-        # columns (minus the key) merge with the join suffix — the same
-        # schema ``_join_out_schema`` produces for the host paths.
-        try:
-            out_rel = left_rel.merge(
-                right_rel.select(
-                    [c for c in right_rel.column_names if c not in op.right_on]
-                ),
-                suffix=op.suffix,
-            )
-        except Exception:
-            return None
-        value_srcs = [c for c in right_rel.column_names if c not in op.right_on]
-        out_names = out_rel.column_names[len(left_rel.column_names):]
-
-        out_cols = []
-        side: dict = {}
-        prefix = f"__lj{nid}"
-        for src, out_name in zip(value_srcs, out_names):
-            dt = right_rel.col_type(src)
-            if dt == DataType.STRING:
-                return None  # string values need mid-chain dict plumbing
-            planes = value_tables[src]
-            out_cols.append((out_name, dt, len(planes)))
-            for j, p in enumerate(planes):
-                side[f"{prefix}:{out_name}:{j}"] = p
-        side[f"{prefix}:found"] = found
-
-        lj = LookupJoinOp(
-            key_col=lc, how=op.how, prefix=prefix, lo=int(lo), dom=int(dom),
-            out_cols=tuple(out_cols),
-        )
-        st = left_res.extend(lj)
-        st.side.update(side)
-        return st
-
-    def _dense_agg_build(self, right_stream, op, l_dt, left_dicts, lc, rc):
-        """Build lookup tables straight from a dense aggregate's device
-        state: the slot-aligned finalize output IS the table (slot =
-        key - lo), so the build side never visits the host."""
-        if any(isinstance(o, LimitOp) for o in right_stream.chain):
-            return None
-        frag_probe = compile_fragment(
-            right_stream.chain, right_stream.relation, right_stream.dicts,
-            self.registry, col_stats=_stream_col_stats(right_stream),
-        )
-        if (
-            not frag_probe.is_agg
-            or len(frag_probe.dense_domains) != 1
-            or frag_probe.limit is not None
-        ):
-            return None
-        # The dense slot space must be the probe key's own code space.
-        agg_i = next(
-            i for i, o in enumerate(right_stream.chain)
-            if isinstance(o, AggOp)
-        )
-        agg = right_stream.chain[agg_i]
-        if tuple(agg.group_cols) != (rc,):
-            return None
-        # Post-agg ops must leave the key column untouched — the slot
-        # arithmetic pairs probe keys with SLOT indices, so a post map
-        # that rewrites the key would silently mispair every row.
-        for o in right_stream.chain[agg_i + 1:]:
-            if isinstance(o, MapOp):
-                key_expr = dict(o.exprs).get(rc)
-                if key_expr != _col(rc):
-                    return None
-        out_rel = frag_probe.relation
-        if rc not in out_rel.column_names:
-            return None
-        if out_rel.col_type(rc) != l_dt:
-            return None
-        if l_dt == DataType.STRING:
-            meta = next(m for m in frag_probe.out_meta if m.name == rc)
-            if left_dicts.get(lc) is not meta.dict:
-                return None
-        if any(m.struct_fields for m in frag_probe.out_meta):
-            return None
-        dr = self._run_fragment(right_stream)
-        reject = bool(np.asarray(dr._overflow))  # stats raced an append
-        value_tables = {
-            n: tuple(dr._cols[n])
-            for n in out_rel.column_names
-            if n != rc and n in dr._cols
-        }
-        if set(value_tables) != {c for c in out_rel.column_names if c != rc}:
-            reject = True
-        if reject:
-            # Don't discard the executed aggregate: hand the (rebucketed
-            # if needed) rows back so the generic join path reuses them
-            # instead of re-folding the whole right stream.
-            return ("fallback", dr.to_host())
-        return (
-            frag_probe.dense_offsets[0], frag_probe.dense_domains[0],
-            dr._valid, value_tables, out_rel,
-        )
-
-    def _host_table_build(self, right_hb, op, l_dt, left_dicts, lc, rc):
-        """Build dense lookup tables from a materialized unique-key host
-        batch (the post-agg N:1 case arriving as rows)."""
-        from ..config import get_flag
-
-        if not right_hb.relation.has_column(rc):
-            return None
-        if right_hb.relation.col_type(rc) != l_dt:
-            return None
-        if right_hb.length == 0:
-            return None
-        kb = np.asarray(right_hb.cols[rc][0])
-        if l_dt == DataType.STRING:
-            ld = left_dicts.get(lc)
-            rd = right_hb.dicts.get(rc)
-            if ld is None or rd is None:
-                return None
-            if rd is not ld:
-                # Re-express build keys in the probe's id space without
-                # growing it: unseen keys can never match a probe row.
-                remap = np.fromiter(
-                    (ld.lookup(s) for s in rd.strings),
-                    dtype=np.int64, count=len(rd),
-                )
-                kb = np.where(kb >= 0, remap[np.clip(kb, 0, None)], -1)
-            lo, dom = 0, len(ld) + 1
-            in_dom = kb >= 0
-        elif l_dt in (DataType.INT64, DataType.TIME64NS):
-            lo, hi = int(kb.min()), int(kb.max())
-            dom = hi - lo + 1
-            if dom > get_flag("int_dense_domain_limit"):
-                return None
-            in_dom = np.ones(len(kb), dtype=bool)
-        else:
-            return None
-        idx = np.where(in_dom, kb - lo, 0)
-        found = np.zeros(dom, dtype=bool)
-        # Uniqueness: a duplicate build key means N:M — not this path.
-        found[idx[in_dom]] = True
-        if int(found.sum()) != int(in_dom.sum()):
-            return None
-        from ..types.dtypes import device_dtypes
-
-        value_tables = {}
-        for c in right_hb.relation.column_names:
-            if c == rc:
-                continue
-            ddts = device_dtypes(right_hb.relation.col_type(c))
-            planes = []
-            for p, ddt in zip(right_hb.cols[c], ddts):
-                # Device dtype, not host: FLOAT64 host planes are f64 but
-                # the device-plane invariant is f32 — an f64 side table
-                # would re-admit f64 into fused device code.
-                p = np.asarray(p)
-                t = np.zeros(dom, dtype=ddt)
-                if len(p):
-                    t[idx[in_dom]] = p[in_dom]
-                planes.append(t)
-            value_tables[c] = tuple(planes)
-        return lo, dom, found, value_tables, right_hb.relation
-
     def _materialize(self, res) -> HostBatch:
         if isinstance(res, HostBatch):
             return res
@@ -1229,16 +652,19 @@ class Engine:
             return dr.to_host()
         return dr
 
-    def _run_fragment(self, stream: "_Stream"):
+    def _run_fragment(self, stream: "_Stream", frag=None):
         """Run a stream's fragment; agg chains return a DeviceResult
         (device-resident, no host readback — the first device-to-host
         transfer permanently switches the axon tunnel into a slow
         synchronous dispatch mode, so callers defer it as long as
-        possible), non-agg chains a HostBatch."""
-        frag = compile_fragment(
-            stream.chain, stream.relation, stream.dicts, self.registry,
-            col_stats=_stream_col_stats(stream),
-        )
+        possible), non-agg chains a HostBatch. Callers that captured
+        domain metadata from a probe compile pass that fragment in so
+        the run cannot recompile against racing stats."""
+        if frag is None:
+            frag = compile_fragment(
+                stream.chain, stream.relation, stream.dicts, self.registry,
+                col_stats=_stream_col_stats(stream),
+            )
         qstats = getattr(self, "_query_stats", None)
         stats = qstats.new_fragment(stream.chain) if qstats is not None else None
 
@@ -1273,571 +699,3 @@ class Engine:
         if stats is not None:
             stats.rows_out = out.length
         return _apply_limit(out, frag.limit)
-
-
-def _window_shapes(cols) -> tuple:
-    """Shape/dtype signature of a staged window (scan batching requires
-    identical signatures so the stacked treedef stays one program).
-    Side inputs are query-constant and never affect batchability."""
-    return tuple(
-        (c, tuple((p.shape, str(p.dtype)) for p in planes))
-        for c, planes in sorted(cols.items())
-        if c != "__side__"
-    )
-
-
-def _timed(stats, stage: str, rows: int = 0):
-    """Stage timer context (no-op without stats) — keeps the analyze and
-    plain execution paths one code path."""
-    if stats is None:
-        import contextlib
-
-        return contextlib.nullcontext()
-    return stats.timed(stage, rows)
-
-
-def _block_if(stats, x) -> None:
-    """block_until_ready under analyze only (attribution needs sync)."""
-    if stats is not None:
-        import jax
-
-        jax.block_until_ready(x)
-
-
-def _col(name):
-    from .plan import ColumnRef
-
-    return ColumnRef(name)
-
-
-def _double_agg_groups(stream: "_Stream") -> "_Stream":
-    """Return the stream with its AggOp's max_groups doubled (rebucket)."""
-    import dataclasses
-
-    from ..config import get_flag
-
-    limit = get_flag("max_groups_limit")
-    chain = []
-    doubled = False
-    for op in stream.chain:
-        if isinstance(op, AggOp) and not doubled:
-            g2 = op.max_groups * 2
-            if g2 > limit:
-                raise QueryError(
-                    f"group-by overflow at max_groups={op.max_groups}; "
-                    f"rebucketing past the {limit} cap refused "
-                    "(PIXIE_TPU_MAX_GROUPS_LIMIT)"
-                )
-            chain.append(dataclasses.replace(op, max_groups=g2))
-            doubled = True
-        else:
-            chain.append(op)
-    if not doubled:
-        raise AssertionError("no AggOp in overflowing chain")
-    return _Stream(
-        stream.relation, stream.dicts, chain, stream.source, stream.source_op
-    )
-
-
-def _to_host_batch(meta_list, cols, valid) -> HostBatch:
-    idx = np.nonzero(valid)[0]
-    out_cols: dict = {}
-    dicts: dict = {}
-    rel_items = []
-    for m in meta_list:
-        if m.struct_fields is not None:
-            planes = np.asarray(cols[m.name][0])[idx]  # [rows, k] floats
-            d = StringDictionary()
-            ids = np.fromiter(
-                (
-                    d.get_or_add(
-                        json.dumps(
-                            {f: round(float(v), 6) for f, v in zip(m.struct_fields, row)}
-                        )
-                    )
-                    for row in planes
-                ),
-                dtype=np.int32,
-                count=len(planes),
-            )
-            out_cols[m.name] = (ids,)
-            dicts[m.name] = d
-            rel_items.append((m.name, DataType.STRING))
-            continue
-        hdts = host_dtypes(m.dtype)
-        out_cols[m.name] = tuple(
-            np.asarray(p)[idx].astype(h) for p, h in zip(cols[m.name], hdts)
-        )
-        if m.dict is not None:
-            dicts[m.name] = m.dict
-        rel_items.append((m.name, m.dtype))
-    return HostBatch(
-        relation=Relation(rel_items), cols=out_cols, length=len(idx), dicts=dicts
-    )
-
-
-def _empty_host_batch(relation, dicts=None) -> HostBatch:
-    cols = {
-        n: tuple(np.empty(0, dtype=h) for h in host_dtypes(t))
-        for n, t in relation.items()
-    }
-    return HostBatch(relation=relation, cols=cols, length=0, dicts=dict(dicts or {}))
-
-
-def _concat_host(pieces, relation) -> HostBatch:
-    nonempty = [p for p in pieces if p.length > 0]
-    if not nonempty:
-        dicts = pieces[0].dicts if pieces else {}
-        return _empty_host_batch(relation, dicts)
-    pieces = nonempty
-    first = pieces[0]
-    if len(pieces) == 1:
-        return first
-    cols = {
-        n: tuple(
-            np.concatenate([p.cols[n][i] for p in pieces])
-            for i in range(len(first.cols[n]))
-        )
-        for n in first.relation.column_names
-    }
-    return HostBatch(
-        relation=first.relation,
-        cols=cols,
-        length=sum(p.length for p in pieces),
-        dicts=first.dicts,
-    )
-
-
-def _apply_limit(hb: HostBatch, limit) -> HostBatch:
-    if limit is None or hb.length <= limit:
-        return hb
-    return HostBatch(
-        relation=hb.relation,
-        cols={n: tuple(p[:limit] for p in ps) for n, ps in hb.cols.items()},
-        length=limit,
-        dicts=hb.dicts,
-    )
-
-
-def _key_tuples(hb: HostBatch, on, remaps):
-    keys = []
-    for c in on:
-        ids = hb.cols[c][0]
-        if c in remaps:
-            # Null string ids (-1) must stay null, not wrap to the last entry.
-            ids = np.where(
-                ids >= 0, remaps[c][np.clip(ids, 0, None)], NULL_ID
-            ).astype(ids.dtype)
-        keys.append(ids)
-    extra = [hb.cols[c][1] for c in on if len(hb.cols[c]) > 1]
-    return list(zip(*(list(k) for k in (keys + extra)))) if keys else []
-
-
-# Inputs smaller than this run the host dict join (when N:1 applies);
-# larger inputs and right/outer/N:M joins go to the device kernel.
-DEVICE_JOIN_MIN_ROWS = 1 << 15
-
-
-def _join_dispatch(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
-    """Route a join to the host N:1 path or the device N:M kernel.
-
-    Reference: ``equijoin_node.cc`` always hash-joins; here small unique-
-    key inner/left joins (the post-agg common case) stay on host, and
-    everything else uses ``pixie_tpu.ops.join.device_join``.
-    """
-    if len(op.left_on) != len(op.right_on):
-        raise QueryError("join key arity mismatch")
-    small = left.length + right.length < DEVICE_JOIN_MIN_ROWS
-    if op.how in ("inner", "left") and small:
-        try:
-            return _join_host(left, right, op)
-        except _BuildNotUnique:
-            pass  # N:M fan-out -> device kernel
-    if left.length == 0 or right.length == 0:
-        return _join_degenerate(left, right, op)
-    import jax
-
-    if op.how in ("inner", "left") and jax.default_backend() != "tpu":
-        # XLA CPU sorts make the device kernel a regression there; the
-        # vectorized numpy N:M join is the CPU-backend fast path.
-        return _join_host_nm(left, right, op)
-    return _join_device(left, right, op)
-
-
-class _BuildNotUnique(Exception):
-    pass
-
-
-def _align_join_dicts(left, right, op):
-    """String-dictionary id remaps so key ids compare across sides.
-
-    Returns (l_remap, r_remap, key_dicts): key_dicts maps a left key
-    column to the merged dictionary (union preserves left ids, so pair
-    rows stay valid and coalesced build-side ids land past them).
-    """
-    l_remap: dict = {}
-    r_remap: dict = {}
-    key_dicts: dict = {}
-    for lc, rc in zip(op.left_on, op.right_on):
-        ld, rd = left.dicts.get(lc), right.dicts.get(rc)
-        if ld is not None and rd is not None and ld is not rd:
-            merged, rl, rr = ld.union(rd)
-            l_remap[lc], r_remap[rc] = rl, rr
-            key_dicts[lc] = merged
-    return l_remap, r_remap, key_dicts
-
-
-def _join_out_schema(left, right, op):
-    """(out_rel, ordered (side, src_col) pairs) for join output columns."""
-    out_rel = left.relation.merge(
-        right.relation.select(
-            [c for c in right.relation.column_names if c not in op.right_on]
-        ),
-        suffix=op.suffix,
-    )
-    src = [("l", c) for c in left.relation.column_names] + [
-        ("r", c) for c in right.relation.column_names if c not in op.right_on
-    ]
-    return out_rel, src
-
-
-def _join_degenerate(left, right, op: JoinOp) -> HostBatch:
-    """Joins where one side is empty (device kernel needs real rows)."""
-    out_rel, src = _join_out_schema(left, right, op)
-    if op.how == "inner" or (op.how == "left" and left.length == 0) or (
-        op.how == "right" and right.length == 0
-    ):
-        keep_l = keep_r = np.zeros(0, dtype=np.int64)
-    elif op.how in ("left", "outer") and right.length == 0:
-        keep_l, keep_r = np.arange(left.length), np.full(left.length, -1)
-    elif op.how in ("right", "outer") and left.length == 0:
-        keep_l, keep_r = np.full(right.length, -1), np.arange(right.length)
-    else:  # outer with one side non-empty handled above; both empty:
-        keep_l = keep_r = np.zeros(0, dtype=np.int64)
-    _, r_remap, key_dicts = _align_join_dicts(left, right, op)
-    return _assemble_join(
-        left, right, op, out_rel, src,
-        keep_l, keep_l >= 0, keep_r, keep_r >= 0,
-        r_remap=r_remap, key_dicts=key_dicts,
-    )
-
-
-def _assemble_join(left, right, op, out_rel, src, l_idx, l_take, r_idx, r_take,
-                   r_remap=None, key_dicts=None):
-    """Gather output columns from per-row indices + take masks.
-
-    Join key columns coalesce (SQL USING semantics): a right/outer extra
-    row — whose probe side is null — takes its key from the build side,
-    remapped into the merged dictionary for strings.
-    """
-    r_remap = r_remap or {}
-    key_dicts = key_dicts or {}
-    key_map = dict(zip(op.left_on, op.right_on))
-    out_cols: dict = {}
-    out_dicts: dict = {}
-    names = iter(out_rel.column_names)
-    for side, c in src:
-        n = next(names)
-        hb = left if side == "l" else right
-        idx = l_idx if side == "l" else r_idx
-        take = l_take if side == "l" else r_take
-        rc = key_map.get(c) if side == "l" else None
-        nullv = NULL_ID if hb.relation.col_type(c) == DataType.STRING else 0
-        planes = []
-        for pi, p in enumerate(hb.cols[c]):
-            if len(p) == 0:
-                taken = np.full(len(idx), nullv, dtype=p.dtype)
-            else:
-                taken = p[np.clip(idx, 0, len(p) - 1)]
-            if not take.all():
-                if rc is not None:
-                    q = right.cols[rc][pi]
-                    if pi == 0 and rc in r_remap:
-                        q = np.where(
-                            q >= 0, r_remap[rc][np.clip(q, 0, None)], NULL_ID
-                        ).astype(q.dtype)
-                    alt = (
-                        np.full(len(r_idx), nullv, dtype=p.dtype)
-                        if len(q) == 0
-                        else q[np.clip(r_idx, 0, len(q) - 1)]
-                    )
-                    taken = np.where(
-                        take, taken, np.where(r_take, alt, nullv)
-                    ).astype(p.dtype)
-                else:
-                    taken = np.where(take, taken, nullv).astype(p.dtype)
-            planes.append(taken)
-        out_cols[n] = tuple(planes)
-        if c in hb.dicts:
-            out_dicts[n] = (
-                key_dicts.get(c, hb.dicts[c]) if side == "l" else hb.dicts[c]
-            )
-    return HostBatch(
-        relation=out_rel, cols=out_cols, length=len(l_idx), dicts=out_dicts
-    )
-
-
-def _join_key_planes(hb, cols, remaps):
-    planes = []
-    for c in cols:
-        for i, p in enumerate(hb.cols[c]):
-            if i == 0 and c in remaps:
-                p = np.where(
-                    p >= 0, remaps[c][np.clip(p, 0, None)], NULL_ID
-                ).astype(p.dtype)
-            planes.append(p)
-    return planes
-
-
-@functools.lru_cache(maxsize=64)
-def _device_join_cache(n_build, n_probe, dtypes, capacity, how):
-    """One jitted kernel per (bucketed shapes, key dtypes, capacity, how)."""
-    import jax
-
-    from ..ops.join import device_join
-
-    return jax.jit(
-        lambda bk, bv, pk, pv: device_join(bk, bv, pk, pv, capacity, how)
-    )
-
-
-def _join_device(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
-    """N:M device join: pad to bucketed capacities, run the sort-based
-    kernel, re-run doubled on overflow, gather columns host-side."""
-    l_remap, r_remap, key_dicts = _align_join_dicts(left, right, op)
-    probe_planes = _join_key_planes(left, op.left_on, l_remap)
-    build_planes = _join_key_planes(right, op.right_on, r_remap)
-    for bp, pp in zip(build_planes, probe_planes):
-        if bp.dtype != pp.dtype:
-            raise QueryError(
-                f"join key dtype mismatch: {bp.dtype} vs {pp.dtype}"
-            )
-
-    nb, np_ = bucket_capacity(right.length), bucket_capacity(left.length)
-
-    def pad(p, cap):
-        out = np.zeros(cap, dtype=p.dtype)
-        out[: len(p)] = p
-        return out
-
-    bk = [pad(p, nb) for p in build_planes]
-    pk = [pad(p, np_) for p in probe_planes]
-    bv = np.zeros(nb, dtype=bool)
-    bv[: right.length] = True
-    pv = np.zeros(np_, dtype=bool)
-    pv[: left.length] = True
-
-    capacity = bucket_capacity(max(left.length + right.length, 1))
-    while True:
-        fn = _device_join_cache(
-            nb, np_, tuple(str(p.dtype) for p in bk), capacity, op.how
-        )
-        p_idx, p_take, b_idx, b_take, out_valid, overflow = (
-            np.asarray(a) for a in fn(bk, bv, pk, pv)
-        )
-        if not bool(overflow):
-            break
-        capacity *= 2
-
-    sel = np.nonzero(out_valid)[0]
-    out_rel, src = _join_out_schema(left, right, op)
-    return _assemble_join(
-        left, right, op, out_rel, src,
-        p_idx[sel], p_take[sel], b_idx[sel], b_take[sel],
-        r_remap=r_remap, key_dicts=key_dicts,
-    )
-
-
-def _join_host(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
-    """N:1 equijoin on host (post-agg inputs are small).
-
-    Reference: ``src/carnot/exec/equijoin_node.cc`` build+probe — here the
-    build side must be unique on the key (raises _BuildNotUnique for the
-    dispatcher to fall through to the device kernel).
-    """
-    l_remap, r_remap, _ = _align_join_dicts(left, right, op)
-
-    lk = _key_tuples(left, op.left_on, l_remap)
-    rk = _key_tuples(right, op.right_on, r_remap)
-    lookup: dict = {}
-    for i, k in enumerate(rk):
-        if k in lookup:
-            raise _BuildNotUnique(op.right_on, k)
-        lookup[k] = i
-
-    match = np.fromiter((lookup.get(k, -1) for k in lk), dtype=np.int64, count=len(lk))
-    if op.how == "inner":
-        l_idx = np.nonzero(match >= 0)[0]
-    elif op.how == "left":
-        l_idx = np.arange(left.length)
-    else:
-        raise QueryError(f"unsupported join how={op.how!r}")
-    r_idx = match[l_idx]
-    return _assemble_join_host(left, right, op, l_idx, r_idx)
-
-
-def _join_host_nm(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
-    """Vectorized N:M inner/left equijoin on host (numpy sort+searchsorted)
-    — the CPU-backend analog of the device kernel (XLA CPU sorts are too
-    slow to route big joins through the device path there)."""
-    l_remap, r_remap, _ = _align_join_dicts(left, right, op)
-    lk = _packed_key_ids(left, op.left_on, l_remap,
-                         right, op.right_on, r_remap)
-    lkeys, rkeys = lk
-    order = np.argsort(rkeys, kind="stable")
-    span = 0
-    if len(rkeys) and len(lkeys):
-        kmin = min(int(rkeys.min()), int(lkeys.min()))
-        kmax = max(int(rkeys.max()), int(lkeys.max()))
-        span = kmax - kmin + 1
-    if 0 < span <= 4 * (len(lkeys) + len(rkeys)):
-        # Dense key range: bincount + cumsum offsets replace the two
-        # binary searches (random-access searchsorted over millions of
-        # probes is the profile's hot spot).
-        kcounts = np.bincount(rkeys - kmin, minlength=span)
-        key_starts = np.zeros(span + 1, dtype=np.int64)
-        np.cumsum(kcounts, out=key_starts[1:])
-        lo = key_starts[lkeys - kmin]
-        counts = kcounts[lkeys - kmin]
-        hi = lo + counts
-    else:
-        srk = rkeys[order]
-        lo = np.searchsorted(srk, lkeys, side="left")
-        hi = np.searchsorted(srk, lkeys, side="right")
-        counts = hi - lo
-    if op.how == "left":
-        counts = np.maximum(counts, 1)  # unmatched keep one null row
-        unmatched = (hi - lo) == 0
-    total = int(counts.sum())
-    starts = np.zeros(len(counts) + 1, dtype=np.int64)
-    np.cumsum(counts, out=starts[1:])
-    l_idx = np.repeat(np.arange(left.length, dtype=np.int64), counts)
-    within = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1], counts)
-    if len(rkeys):
-        r_idx = order[
-            np.clip(np.repeat(lo, counts) + within, 0, len(rkeys) - 1)
-        ]
-    else:
-        r_idx = np.full(total, -1, dtype=np.int64)
-    if op.how == "left" and len(rkeys):
-        r_idx = np.where(np.repeat(unmatched, counts), -1, r_idx)
-    return _assemble_join_host(left, right, op, l_idx, r_idx)
-
-
-def _packed_key_ids(left, left_on, l_remap, right, right_on, r_remap):
-    """Dense i64 key ids comparable across both sides (np.unique over the
-    stacked key planes of the concatenated inputs)."""
-    def planes(b, cols, remap):
-        out = []
-        for c in cols:
-            for i, p in enumerate(b.cols[c]):
-                q = p
-                if i == 0 and c in remap:
-                    q = remap[c][np.clip(p, 0, None)]
-                    q = np.where(p >= 0, q, NULL_ID)
-                out.append(np.asarray(q))
-        return out
-    lp = planes(left, left_on, l_remap)
-    rp = planes(right, right_on, r_remap)
-    if len(lp) == 1:
-        # Single-plane keys compare directly — no densification pass.
-        return (lp[0].astype(np.int64, copy=False),
-                rp[0].astype(np.int64, copy=False))
-    stacked = np.stack(
-        [np.concatenate([a.astype(np.int64, copy=False),
-                         b.astype(np.int64, copy=False)])
-         for a, b in zip(lp, rp)],
-        axis=1,
-    )
-    _, inv = np.unique(stacked, axis=0, return_inverse=True)
-    inv = inv.astype(np.int64).reshape(-1)
-    return inv[: left.length], inv[left.length:]
-
-
-def _assemble_join_host(left, right, op, l_idx, r_idx) -> HostBatch:
-    """Row assembly for the host N:1 / N:M paths (r_idx=-1 -> null)."""
-    out_rel = left.relation.merge(
-        right.relation.select(
-            [c for c in right.relation.column_names if c not in op.right_on]
-        ),
-        suffix=op.suffix,
-    )
-    out_cols: dict = {}
-    out_dicts: dict = {}
-    names = iter(out_rel.column_names)
-    for c in left.relation.column_names:
-        n = next(names)
-        out_cols[n] = tuple(p[l_idx] for p in left.cols[c])
-        if c in left.dicts:
-            out_dicts[n] = left.dicts[c]
-    for c in right.relation.column_names:
-        if c in op.right_on:
-            continue
-        n = next(names)
-        planes = []
-        nullv = NULL_ID if right.relation.col_type(c) == DataType.STRING else 0
-        for p in right.cols[c]:
-            if len(p) == 0:  # empty build side: all-null fill
-                taken = np.full(len(l_idx), nullv, dtype=p.dtype)
-            else:
-                taken = p[np.clip(r_idx, 0, None)]
-                if op.how == "left":
-                    taken = np.where(r_idx >= 0, taken, nullv).astype(p.dtype)
-            planes.append(taken)
-        out_cols[n] = tuple(planes)
-        if c in right.dicts:
-            out_dicts[n] = right.dicts[c]
-    return HostBatch(
-        relation=out_rel, cols=out_cols, length=len(l_idx), dicts=out_dicts
-    )
-
-
-def _union_host(mats) -> HostBatch:
-    """Schema-aligned union with dictionary re-encoding.
-
-    When the schema carries a ``time_`` column the result is merged in
-    time order — the reference UnionNode's k-way ordered merge of
-    cross-PEM streams (``src/carnot/exec/union_node.cc``); a stable sort
-    over the concatenation is equivalent given each input is itself
-    time-ordered, and stays a single vectorized pass.
-    """
-    first = mats[0]
-    for m in mats[1:]:
-        if tuple(m.relation.column_names) != tuple(first.relation.column_names):
-            raise QueryError("union inputs must share a schema")
-    out_cols: dict = {}
-    out_dicts: dict = {}
-    for c, dt in first.relation.items():
-        if dt == DataType.STRING:
-            merged = StringDictionary()
-            planes = []
-            for m in mats:
-                d = m.dicts.get(c, StringDictionary())
-                # union preserves existing ids (append-only), so earlier
-                # planes stay valid as merged grows.
-                merged, _, remap = merged.union(d)
-                ids = m.cols[c][0]
-                planes.append(
-                    np.where(ids >= 0, remap[np.clip(ids, 0, None)], NULL_ID).astype(
-                        np.int32
-                    )
-                )
-            out_cols[c] = (np.concatenate(planes),)
-            out_dicts[c] = merged
-        else:
-            out_cols[c] = tuple(
-                np.concatenate([m.cols[c][i] for m in mats])
-                for i in range(len(first.cols[c]))
-            )
-    if first.relation.has_column("time_"):
-        order = np.argsort(out_cols["time_"][0], kind="stable")
-        if not np.array_equal(order, np.arange(len(order))):
-            out_cols = {
-                c: tuple(p[order] for p in ps) for c, ps in out_cols.items()
-            }
-    return HostBatch(
-        relation=first.relation,
-        cols=out_cols,
-        length=sum(m.length for m in mats),
-        dicts=out_dicts,
-    )

@@ -172,7 +172,25 @@ class MySQLStitcher:
                     return emitted
                 c.pending.append((cmd, body, ts))
             return emitted
-        for _seq, payload in c.resp.feed(data):
+        for seq, payload in c.resp.feed(data):
+            if seq is None:
+                # Oversized response packet: the framer's marker carries
+                # the head byte (or None) as ``payload`` — an int, which
+                # the state machine must never see. Normalize here: an
+                # oversized ERR at head position keeps its classification
+                # (huge error messages exist); everything else flows
+                # through the payload-None sentinel the handlers treat as
+                # "one packet of unknown body" (a row inside a resultset,
+                # a definition inside a prepare followup, unknown at head).
+                self.parse_errors += 1
+                if (
+                    c.rs is None and c.prep_skip is None and c.pending
+                    and payload == 0xFF
+                ):
+                    emitted += self._finish(c, ts, RESP_ERR, "<oversized>")
+                else:
+                    emitted += self._response_packet(c, None, ts)
+                continue
             emitted += self._response_packet(c, payload, ts)
         return emitted
 

@@ -672,10 +672,20 @@ def fuse_consecutive_maps(plan: Plan) -> None:
             # into multiple sites (the reference rule's copyability
             # guard) — an expensive expr referenced twice must not run
             # twice in the fused fragment.
+            # Count reference SITES, not referencing expressions: a
+            # single outer expr using an inner column twice (a*a) still
+            # inlines the definition twice.
             refs: dict = {}
+
+            def count_sites(e):
+                if isinstance(e, ColumnRef):
+                    refs[e.name] = refs.get(e.name, 0) + 1
+                elif isinstance(e, FuncCall):
+                    for a in e.args:
+                        count_sites(a)
+
             for _n, e in node.op.exprs:
-                for c in _expr_columns(e, set()):
-                    refs[c] = refs.get(c, 0) + 1
+                count_sites(e)
             if any(
                 refs.get(name, 0) > 1
                 and not isinstance(e, (ColumnRef, Literal))

@@ -523,10 +523,16 @@ class Agent:
                     for (bid, _aid), p in st["latest"].items():
                         if p is not None:
                             by_bridge.setdefault(bid, []).append(p)
-                    seq = st["seq"]
-                    st["seq"] += 1
                 if by_bridge:
                     with st["merge_lock"]:
+                        # seq is claimed INSIDE merge_lock (same order as
+                        # _stream_emit_rows) so publish order always
+                        # matches seq order — claiming it earlier let a
+                        # lower-seq 'replace' land after a higher-seq
+                        # update and be wrongly superseded by clients.
+                        with self._lock:
+                            seq = st["seq"]
+                            st["seq"] += 1
                         try:
                             outputs = self.engine.execute_plan(
                                 plan, bridge_inputs=by_bridge

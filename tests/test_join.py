@@ -81,7 +81,7 @@ class TestDeviceJoinKernel:
 
     @pytest.fixture(autouse=True)
     def force_device(self, monkeypatch):
-        import pixie_tpu.exec.engine as eng_mod
+        import pixie_tpu.exec.joins as eng_mod
 
         monkeypatch.setattr(eng_mod, "DEVICE_JOIN_MIN_ROWS", 0)
 
@@ -172,7 +172,7 @@ class TestJoinRouting:
         sorts make the device kernel a regression there)."""
         import jax
 
-        import pixie_tpu.exec.engine as eng_mod
+        import pixie_tpu.exec.joins as eng_mod
 
         monkeypatch.setattr(eng_mod, "DEVICE_JOIN_MIN_ROWS", 4)
         expected = (
@@ -260,7 +260,7 @@ class TestHostNMJoinMultiKey:
         the host N:M join on the CPU backend."""
         import jax
         import numpy as np
-        import pixie_tpu.exec.engine as eng_mod
+        import pixie_tpu.exec.joins as eng_mod
         from pixie_tpu.exec.engine import Engine
 
         if jax.default_backend() == "tpu":  # host path is CPU-only

@@ -380,6 +380,48 @@ class TestNewRules:
         assert all(c == 50 for c in out["n"])
 
 
+class TestFuseMapsRefCounting:
+    """r4 advisor: the duplicate-work guard must count reference SITES —
+    one outer expr using an inner definition twice (a*a) duplicates it."""
+
+    def _plan(self, outer_exprs):
+        from pixie_tpu.exec.plan import (
+            ColumnRef, FuncCall, MapOp, MemorySourceOp, Plan, ResultSinkOp,
+        )
+
+        plan = Plan()
+        src = plan.add(MemorySourceOp(table="t"))
+        inner = plan.add(
+            MapOp(exprs=(("x", FuncCall("log", (ColumnRef("v"),))),)),
+            [src],
+        )
+        outer = plan.add(MapOp(exprs=tuple(outer_exprs)), [inner])
+        plan.add(ResultSinkOp(name="output"), [outer])
+        return plan
+
+    def test_double_ref_in_one_expr_blocks_fusion(self):
+        from pixie_tpu.exec.plan import ColumnRef, FuncCall, MapOp
+        from pixie_tpu.planner.rules import fuse_consecutive_maps
+
+        plan = self._plan(
+            [("y", FuncCall("multiply", (ColumnRef("x"), ColumnRef("x"))))]
+        )
+        fuse_consecutive_maps(plan)
+        maps = [n for n in plan.nodes.values() if isinstance(n.op, MapOp)]
+        assert len(maps) == 2, "expensive def inlined twice"
+
+    def test_single_ref_fuses(self):
+        from pixie_tpu.exec.plan import ColumnRef, FuncCall, MapOp
+        from pixie_tpu.planner.rules import fuse_consecutive_maps
+
+        plan = self._plan(
+            [("y", FuncCall("multiply", (ColumnRef("x"), ColumnRef("v"))))]
+        )
+        fuse_consecutive_maps(plan)
+        maps = [n for n in plan.nodes.values() if isinstance(n.op, MapOp)]
+        assert len(maps) == 1
+
+
 class TestMergeNodesRule:
     def _state(self):
         from pixie_tpu.udf.registry import default_registry

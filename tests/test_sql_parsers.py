@@ -319,6 +319,44 @@ class TestParserHardening:
         assert recs[1]["latency_ns"] == 20
         assert st.parse_errors >= 1
 
+    def test_mysql_oversized_response_row_keeps_pairing(self):
+        # A multi-MB resultset row must count as one row, not crash the
+        # stitcher (r4 advisor: int marker heads reached the response
+        # state machine).
+        st = MySQLStitcher()
+        st.feed(1, my_query("SELECT blob"), True, ts_ns=10)
+        resp = my_pkt(1, b"\x01") + my_pkt(2, b"\x03defc0") + my_eof(3)
+        st.feed(1, resp, False, ts_ns=12)
+        big_row = b"\x0abbbb" + b"y" * (2 << 20)
+        pkt = len(big_row).to_bytes(3, "little") + b"\x04" + big_row
+        for off in range(0, len(pkt), 1 << 16):
+            st.feed(1, pkt[off:off + (1 << 16)], False, ts_ns=14)
+        st.feed(1, my_pkt(5, b"\x01a") + my_eof(6), False, ts_ns=20)
+        st.feed(1, my_query("SELECT 1"), True, ts_ns=30)
+        st.feed(1, my_ok(), False, ts_ns=37)
+        recs = st.drain()
+        assert len(recs) == 2
+        assert recs[0]["resp_body"] == "Resultset rows=2"
+        assert recs[1]["latency_ns"] == 7
+        assert st.parse_errors >= 1
+
+    def test_mysql_oversized_err_response_classified(self):
+        # An oversized packet at response-head position whose head byte
+        # is 0xFF finishes the command as an ERR, keeping pairing.
+        st = MySQLStitcher()
+        st.feed(1, my_query("BAD"), True, ts_ns=10)
+        big_err = b"\xff" + b"e" * (2 << 20)
+        pkt = len(big_err).to_bytes(3, "little") + b"\x01" + big_err
+        for off in range(0, len(pkt), 1 << 16):
+            st.feed(1, pkt[off:off + (1 << 16)], False, ts_ns=15)
+        st.feed(1, my_query("SELECT 1"), True, ts_ns=20)
+        st.feed(1, my_ok(), False, ts_ns=28)
+        recs = st.drain()
+        assert len(recs) == 2
+        assert recs[0]["resp_status"] == RESP_ERR
+        assert recs[0]["resp_body"] == "<oversized>"
+        assert recs[1]["resp_status"] == RESP_OK
+
     def test_mysql_prepare_definitions_consumed(self):
         # Prepare-OK with 1 param + 1 column: the four definition/EOF
         # packets must not bleed into the next command's response.
