@@ -45,11 +45,12 @@ from .bridge import (  # noqa: F401  (re-exported)
 )
 from .fragment import compile_fragment_cached as compile_fragment
 from .joins import (  # noqa: F401  (re-exported)
-    DEVICE_JOIN_MIN_ROWS,
     _join_dispatch,
     _union_host,
     try_fused_join,
 )
+# NOTE: DEVICE_JOIN_MIN_ROWS deliberately NOT re-exported — patching a
+# re-exported copy would be a silent no-op; joins.py is the patch point.
 from .plan import (
     AggOp,
     TableSinkOp,

@@ -580,7 +580,7 @@ def _dense_agg_build(engine, right_stream, op, l_dt, left_dicts, lc, rc):
     # arithmetic pairs probe keys with SLOT indices, so a post map
     # that rewrites the key would silently mispair every row.
     for o in right_stream.chain[agg_i + 1:]:
-        if isinstance(o, _MapOp):
+        if isinstance(o, MapOp):
             key_expr = dict(o.exprs).get(rc)
             if key_expr != _col(rc):
                 return None

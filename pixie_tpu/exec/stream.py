@@ -123,7 +123,8 @@ def _double_agg_groups(stream: "_Stream") -> "_Stream":
     if not doubled:
         raise AssertionError("no AggOp in overflowing chain")
     return _Stream(
-        stream.relation, stream.dicts, chain, stream.source, stream.source_op
+        stream.relation, stream.dicts, chain, stream.source,
+        stream.source_op, dict(stream.side),  # keep lookup-join side tables
     )
 
 

@@ -115,6 +115,48 @@ NETWORK_STATS_RELATION = Relation(
     ]
 )
 
+# redis_table.h kRedisTable (subset; +service context).
+REDIS_EVENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("req_cmd", DataType.STRING),
+        ("req_args", DataType.STRING),
+        ("resp", DataType.STRING),
+        ("latency_ns", DataType.INT64),
+        ("service", DataType.STRING),
+    ]
+)
+
+# kafka_table.h kKafkaTable ("kafka_events.beta" in the reference;
+# req_cmd is the APIKey enum value).
+KAFKA_EVENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("req_cmd", DataType.INT64),
+        ("client_id", DataType.STRING),
+        ("req_body", DataType.STRING),
+        ("resp", DataType.STRING),
+        ("latency_ns", DataType.INT64),
+        ("service", DataType.STRING),
+    ]
+)
+
+# cass_table.h kCQLTable (subset; req_op/resp_op are protocol opcodes).
+CQL_EVENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("req_op", DataType.INT64),
+        ("req_body", DataType.STRING),
+        ("resp_op", DataType.INT64),
+        ("resp_body", DataType.STRING),
+        ("latency_ns", DataType.INT64),
+        ("service", DataType.STRING),
+    ]
+)
+
 # dns_table.h kDNSTable (subset).
 DNS_EVENTS_RELATION = Relation(
     [
@@ -136,6 +178,9 @@ CANONICAL_SCHEMAS: dict[str, Relation] = {
     "stack_traces.beta": STACK_TRACES_RELATION,
     "mysql_events": MYSQL_EVENTS_RELATION,
     "pgsql_events": PGSQL_EVENTS_RELATION,
+    "redis_events": REDIS_EVENTS_RELATION,
+    "kafka_events.beta": KAFKA_EVENTS_RELATION,
+    "cql_events": CQL_EVENTS_RELATION,
     "process_stats": PROCESS_STATS_RELATION,
     "network_stats": NETWORK_STATS_RELATION,
     "dns_events": DNS_EVENTS_RELATION,

@@ -20,15 +20,21 @@ from typing import Iterable, Optional
 
 from ..types.dtypes import DataType
 from .core import SourceConnector
+from .cql_parser import CQLStitcher
 from .dns_parser import DNSStitcher
 from .http_parser import HTTPStitcher
+from .kafka_parser import KafkaStitcher
 from .mysql_parser import MySQLStitcher
 from .pgsql_parser import PgSQLStitcher
+from .redis_parser import RedisStitcher
 from .schemas import (
+    CQL_EVENTS_RELATION,
     DNS_EVENTS_RELATION,
     HTTP_EVENTS_RELATION,
+    KAFKA_EVENTS_RELATION,
     MYSQL_EVENTS_RELATION,
     PGSQL_EVENTS_RELATION,
+    REDIS_EVENTS_RELATION,
 )
 
 
@@ -41,6 +47,9 @@ class CaptureTapConnector(SourceConnector):
         ("dns_events", DNS_EVENTS_RELATION),
         ("mysql_events", MYSQL_EVENTS_RELATION),
         ("pgsql_events", PGSQL_EVENTS_RELATION),
+        ("redis_events", REDIS_EVENTS_RELATION),
+        ("kafka_events.beta", KAFKA_EVENTS_RELATION),
+        ("cql_events", CQL_EVENTS_RELATION),
     ]
 
     def __init__(self, feed: Optional[Iterable] = None, path: str = "",
@@ -53,6 +62,9 @@ class CaptureTapConnector(SourceConnector):
         self.dns = DNSStitcher(pod=pod)
         self.mysql = MySQLStitcher(service=service, pod=pod)
         self.pgsql = PgSQLStitcher(service=service, pod=pod)
+        self.redis = RedisStitcher(service=service, pod=pod)
+        self.kafka = KafkaStitcher(service=service, pod=pod)
+        self.cql = CQLStitcher(service=service, pod=pod)
         self.upid_value = 0
 
     def init(self) -> None:
@@ -88,8 +100,8 @@ class CaptureTapConnector(SourceConnector):
             proto = ev.get("proto", "http")
             if proto == "dns":
                 self.dns.feed(data, ts_ns=ev.get("ts"))
-            elif proto in ("mysql", "pgsql"):
-                stitcher = self.mysql if proto == "mysql" else self.pgsql
+            elif proto in ("mysql", "pgsql", "redis", "kafka", "cql"):
+                stitcher = getattr(self, proto)
                 stitcher.feed(
                     ev.get("conn", 0), data,
                     is_request=(ev.get("dir", "req") == "req"),
@@ -121,6 +133,9 @@ class CaptureTapConnector(SourceConnector):
             ("dns_events", DNS_EVENTS_RELATION, self.dns.drain()),
             ("mysql_events", MYSQL_EVENTS_RELATION, self.mysql.drain()),
             ("pgsql_events", PGSQL_EVENTS_RELATION, self.pgsql.drain()),
+            ("redis_events", REDIS_EVENTS_RELATION, self.redis.drain()),
+            ("kafka_events.beta", KAFKA_EVENTS_RELATION, self.kafka.drain()),
+            ("cql_events", CQL_EVENTS_RELATION, self.cql.drain()),
         ):
             if not recs:
                 continue

@@ -304,3 +304,30 @@ px.display(out)
     order = np.argsort(got["k"])
     assert np.array_equal(np.asarray(got["k"])[order], uk)
     assert np.array_equal(np.asarray(got["n"])[order], cnt)
+
+
+def test_dense_agg_build_with_post_agg_map():
+    """Build side = dense aggregate + post-agg Map: the key-untouched
+    guard in joins._dense_agg_build must inspect the map (r5 regression:
+    a rename typo made this path raise NameError)."""
+    eng = Engine(window_rows=1 << 13)
+    lk, lb, rk, rv, n_keys = _two_tables(eng, n=8_000, n_keys=500)
+    q = """
+import px
+r = px.DataFrame(table='R')
+ra = r.groupby('k').agg(cnt=('v', px.count))
+ra.cnt2 = ra.cnt * 2
+l = px.DataFrame(table='L')
+g = l.merge(ra, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+out = g.groupby('b').agg(n=('cnt2', px.sum))
+px.display(out)
+"""
+    got = eng.execute_query(q)["output"].to_pydict()
+    import collections
+
+    cnt = collections.Counter(rk.tolist())
+    want = collections.Counter()
+    for k, b in zip(lk.tolist(), lb.tolist()):
+        want[b] += 2 * cnt.get(k, 0)
+    got_map = dict(zip((int(b) for b in got["b"]), (int(v) for v in got["n"])))
+    assert got_map == {b: v for b, v in want.items() if v}

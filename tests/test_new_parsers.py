@@ -1,0 +1,368 @@
+"""Redis / Kafka / CQL protocol parser tests.
+
+Mirrors the reference's protocol test strategy (recorded-bytes fixtures
+through incremental parsers, e.g. ``protocols/redis/parse_test.cc``,
+``protocols/kafka``, ``protocols/cass``): framing across partial feeds,
+pairing discipline (positional / correlation id / stream id), push
+events, oversized payloads, and the tap-to-PxL integration path.
+"""
+
+import base64
+
+import numpy as np
+
+from pixie_tpu.ingest.cql_parser import (
+    CQLStitcher,
+    OP_ERROR,
+    OP_EVENT,
+    OP_QUERY,
+    OP_RESULT,
+)
+from pixie_tpu.ingest.kafka_parser import KafkaStitcher
+from pixie_tpu.ingest.redis_parser import RedisStitcher
+
+
+# -- fixture builders ---------------------------------------------------------
+def resp_array(*words: str) -> bytes:
+    out = f"*{len(words)}\r\n".encode()
+    for w in words:
+        b = w.encode()
+        out += b"$" + str(len(b)).encode() + b"\r\n" + b + b"\r\n"
+    return out
+
+
+def kafka_req(api_key: int, ver: int, cid: int, client: str = "app",
+              extra: bytes = b"") -> bytes:
+    body = (
+        api_key.to_bytes(2, "big") + ver.to_bytes(2, "big")
+        + cid.to_bytes(4, "big")
+        + len(client).to_bytes(2, "big") + client.encode() + extra
+    )
+    return len(body).to_bytes(4, "big") + body
+
+
+def kafka_resp(cid: int, extra: bytes = b"\x00" * 8) -> bytes:
+    body = cid.to_bytes(4, "big") + extra
+    return len(body).to_bytes(4, "big") + body
+
+
+def cql_frame(opcode: int, stream: int, body: bytes,
+              response: bool = False, ver: int = 4, flags: int = 0) -> bytes:
+    v = ver | (0x80 if response else 0)
+    return (
+        bytes([v, flags]) + stream.to_bytes(2, "big", signed=True)
+        + bytes([opcode]) + len(body).to_bytes(4, "big") + body
+    )
+
+
+def cql_query(sql: str) -> bytes:
+    q = sql.encode()
+    return len(q).to_bytes(4, "big") + q + b"\x00\x01\x00"  # consistency
+
+
+def cql_rows(ncols: int) -> bytes:
+    return (
+        (2).to_bytes(4, "big")          # kind=Rows
+        + (1).to_bytes(4, "big")        # metadata flags
+        + ncols.to_bytes(4, "big")      # column count
+    )
+
+
+class TestRedisStitcher:
+    def test_get_set_pairing(self):
+        st = RedisStitcher(service="cache")
+        st.feed(1, resp_array("SET", "k", "v"), True, ts_ns=100)
+        st.feed(1, b"+OK\r\n", False, ts_ns=130)
+        st.feed(1, resp_array("GET", "k"), True, ts_ns=200)
+        st.feed(1, b"$1\r\nv\r\n", False, ts_ns=260)
+        recs = st.drain()
+        assert [r["req_cmd"] for r in recs] == ["SET", "GET"]
+        assert recs[0]["req_args"] == "k v"
+        assert recs[0]["resp"] == "OK"
+        assert recs[0]["latency_ns"] == 30
+        assert recs[1]["resp"] == "v"
+        assert all(r["service"] == "cache" for r in recs)
+
+    def test_pipelined_and_partial_feeds(self):
+        st = RedisStitcher()
+        reqs = resp_array("INCR", "a") + resp_array("INCR", "a")
+        st.feed(2, reqs[:9], True, ts_ns=10)
+        st.feed(2, reqs[9:], True, ts_ns=11)
+        resp = b":1\r\n:2\r\n"
+        st.feed(2, resp[:3], False, ts_ns=30)
+        st.feed(2, resp[3:], False, ts_ns=31)
+        recs = st.drain()
+        assert [r["resp"] for r in recs] == ["1", "2"]
+
+    def test_two_word_commands_and_errors(self):
+        st = RedisStitcher()
+        st.feed(3, resp_array("CONFIG", "GET", "maxmemory"), True, ts_ns=5)
+        st.feed(3, resp_array("maxmemory", "0"), False, ts_ns=9)
+        st.feed(3, resp_array("HGETALL"), True, ts_ns=20)
+        st.feed(3, b"-ERR wrong number of arguments\r\n", False, ts_ns=28)
+        recs = st.drain()
+        assert recs[0]["req_cmd"] == "CONFIG GET"
+        assert recs[0]["req_args"] == "maxmemory"
+        assert recs[0]["resp"] == "[maxmemory, 0]"
+        assert recs[1]["resp"].startswith("-ERR")
+
+    def test_nested_arrays_and_nulls(self):
+        st = RedisStitcher()
+        st.feed(4, resp_array("XRANGE", "s", "-", "+"), True, ts_ns=1)
+        resp = b"*1\r\n*2\r\n$3\r\n1-1\r\n*2\r\n$1\r\nf\r\n$1\r\nv\r\n"
+        st.feed(4, resp, False, ts_ns=2)
+        st.feed(4, resp_array("GET", "missing"), True, ts_ns=10)
+        st.feed(4, b"$-1\r\n", False, ts_ns=11)
+        recs = st.drain()
+        assert recs[0]["resp"] == "[[1-1, [f, v]]]"
+        assert recs[1]["resp"] == "<null>"
+
+    def test_pubsub_push_without_request(self):
+        st = RedisStitcher()
+        st.feed(5, resp_array("SUBSCRIBE", "ch"), True, ts_ns=1)
+        sub_ack = b"*3\r\n$9\r\nsubscribe\r\n$2\r\nch\r\n:1\r\n"
+        st.feed(5, sub_ack, False, ts_ns=2)
+        push = resp_array("message", "ch", "hello")
+        st.feed(5, push, False, ts_ns=50)
+        recs = st.drain()
+        assert recs[0]["req_cmd"] == "SUBSCRIBE"
+        assert recs[1]["req_cmd"] == "PUSH"
+        assert "hello" in recs[1]["resp"]
+
+    def test_resp3_types_and_push_frame(self):
+        st = RedisStitcher()
+        st.feed(6, resp_array("CLIENT", "INFO"), True, ts_ns=1)
+        st.feed(6, b"#t\r\n", False, ts_ns=2)
+        st.feed(6, b">2\r\n$7\r\nmessage\r\n$2\r\nhi\r\n", False, ts_ns=9)
+        recs = st.drain()
+        assert recs[0]["req_cmd"] == "CLIENT INFO"
+        assert recs[0]["resp"] == "true"
+        assert recs[1]["req_cmd"] == "PUSH"
+
+    def test_oversized_bulk_keeps_pairing(self):
+        st = RedisStitcher()
+        st.feed(7, resp_array("GET", "big"), True, ts_ns=10)
+        payload = b"x" * (2 << 20)
+        big = b"$" + str(len(payload)).encode() + b"\r\n" + payload + b"\r\n"
+        for off in range(0, len(big), 1 << 16):
+            st.feed(7, big[off:off + (1 << 16)], False, ts_ns=12)
+        st.feed(7, resp_array("GET", "small"), True, ts_ns=20)
+        st.feed(7, b"$2\r\nok\r\n", False, ts_ns=26)
+        recs = st.drain()
+        assert len(recs) == 2
+        assert recs[0]["resp"] == "<oversized>"
+        assert recs[1]["resp"] == "ok"
+        assert recs[1]["latency_ns"] == 6
+
+    def test_inline_command(self):
+        st = RedisStitcher()
+        st.feed(8, b"PING\r\n", True, ts_ns=1)
+        st.feed(8, b"+PONG\r\n", False, ts_ns=3)
+        (rec,) = st.drain()
+        assert rec["req_cmd"] == "PING"
+        assert rec["resp"] == "PONG"
+
+
+class TestKafkaStitcher:
+    def test_correlation_id_pairing_out_of_order(self):
+        st = KafkaStitcher(service="bus")
+        st.feed(1, kafka_req(0, 9, 100), True, ts_ns=10)   # Produce
+        st.feed(1, kafka_req(1, 13, 101), True, ts_ns=20)  # Fetch
+        # Fetch long-poll answers AFTER the produce, out of order.
+        st.feed(1, kafka_resp(101), False, ts_ns=500)
+        st.feed(1, kafka_resp(100), False, ts_ns=520)
+        recs = st.drain()
+        assert [r["req_body"].split()[0] for r in recs] == ["Fetch", "Produce"]
+        assert recs[0]["latency_ns"] == 480
+        assert recs[1]["latency_ns"] == 510
+        assert all(r["client_id"] == "app" for r in recs)
+        assert all(r["service"] == "bus" for r in recs)
+
+    def test_partial_frames_and_api_names(self):
+        st = KafkaStitcher()
+        req = kafka_req(3, 12, 7, client="admin")  # Metadata
+        st.feed(2, req[:6], True, ts_ns=10)
+        st.feed(2, req[6:], True, ts_ns=11)
+        resp = kafka_resp(7)
+        st.feed(2, resp[:5], False, ts_ns=40)
+        st.feed(2, resp[5:], False, ts_ns=41)
+        (rec,) = st.drain()
+        assert rec["req_body"] == "Metadata v12"
+        assert rec["req_cmd"] == 3
+        assert rec["client_id"] == "admin"
+
+    def test_unknown_api_key_rejected(self):
+        st = KafkaStitcher()
+        st.feed(3, kafka_req(999, 0, 1), True, ts_ns=1)
+        assert st.parse_errors == 1
+        st.feed(3, kafka_resp(1), False, ts_ns=2)
+        assert st.drain() == []
+
+    def test_oversized_produce_keeps_pairing(self):
+        st = KafkaStitcher()
+        big = kafka_req(0, 9, 55, extra=b"z" * (9 << 20))
+        for off in range(0, len(big), 1 << 18):
+            st.feed(4, big[off:off + (1 << 18)], True, ts_ns=10)
+        st.feed(4, kafka_req(12, 4, 56), True, ts_ns=20)  # Heartbeat
+        st.feed(4, kafka_resp(55), False, ts_ns=100)
+        st.feed(4, kafka_resp(56), False, ts_ns=110)
+        recs = st.drain()
+        assert len(recs) == 2
+        assert recs[0]["req_body"] == "Produce v9 <truncated>"
+        assert recs[1]["req_body"] == "Heartbeat v4"
+
+    def test_unanswered_requests_evict_oldest(self):
+        st = KafkaStitcher()
+        for i in range(st.PENDING_PER_CONN + 10):
+            st.feed(5, kafka_req(1, 13, i), True, ts_ns=i)
+        # The newest correlation ids still pair.
+        st.feed(5, kafka_resp(st.PENDING_PER_CONN + 9), False, ts_ns=9999)
+        recs = st.drain()
+        assert len(recs) == 1
+        assert st.parse_errors >= 10
+
+
+class TestCQLStitcher:
+    def test_query_result_pairing_by_stream(self):
+        st = CQLStitcher(service="cass")
+        st.feed(1, cql_frame(OP_QUERY, 1, cql_query("SELECT * FROM ks.t")),
+                True, ts_ns=100)
+        st.feed(1, cql_frame(OP_QUERY, 2, cql_query("SELECT now()")),
+                True, ts_ns=110)
+        # Stream 2 answers first.
+        st.feed(1, cql_frame(OP_RESULT, 2, cql_rows(1), response=True),
+                False, ts_ns=150)
+        st.feed(1, cql_frame(OP_RESULT, 1, cql_rows(3), response=True),
+                False, ts_ns=180)
+        recs = st.drain()
+        assert [r["req_body"] for r in recs] == [
+            "SELECT now()", "SELECT * FROM ks.t"
+        ]
+        assert recs[0]["latency_ns"] == 40
+        assert recs[1]["latency_ns"] == 80
+        assert recs[1]["resp_body"] == "Rows cols=3"
+        assert all(r["req_op"] == OP_QUERY for r in recs)
+        assert all(r["resp_op"] == OP_RESULT for r in recs)
+
+    def test_error_response(self):
+        st = CQLStitcher()
+        st.feed(2, cql_frame(OP_QUERY, 5, cql_query("SELEC 1")), True,
+                ts_ns=10)
+        msg = b"line 1: syntax error"
+        body = (0x2000).to_bytes(4, "big") + len(msg).to_bytes(2, "big") + msg
+        st.feed(2, cql_frame(OP_ERROR, 5, body, response=True), False,
+                ts_ns=30)
+        (rec,) = st.drain()
+        assert rec["resp_op"] == OP_ERROR
+        assert "syntax error" in rec["resp_body"]
+        assert "0x2000" in rec["resp_body"]
+
+    def test_partial_frames_across_feeds(self):
+        st = CQLStitcher()
+        f = cql_frame(OP_QUERY, 9, cql_query("SELECT 1"))
+        st.feed(3, f[:4], True, ts_ns=10)
+        st.feed(3, f[4:], True, ts_ns=11)
+        r = cql_frame(OP_RESULT, 9, (1).to_bytes(4, "big"), response=True)
+        st.feed(3, r[:10], False, ts_ns=40)
+        st.feed(3, r[10:], False, ts_ns=41)
+        (rec,) = st.drain()
+        assert rec["req_body"] == "SELECT 1"
+        assert rec["resp_body"] == "Void"
+
+    def test_event_push_without_request(self):
+        st = CQLStitcher()
+        st.feed(4, cql_frame(OP_EVENT, -1, b"", response=True), False,
+                ts_ns=77)
+        (rec,) = st.drain()
+        assert rec["req_op"] == OP_EVENT
+        assert rec["latency_ns"] == 0
+
+    def test_oversized_body_keeps_pairing(self):
+        st = CQLStitcher()
+        st.feed(5, cql_frame(OP_QUERY, 1, cql_query("SELECT blob")), True,
+                ts_ns=10)
+        big = cql_frame(OP_RESULT, 1, b"r" * (5 << 20), response=True)
+        for off in range(0, len(big), 1 << 18):
+            st.feed(5, big[off:off + (1 << 18)], False, ts_ns=20)
+        st.feed(5, cql_frame(OP_QUERY, 2, cql_query("SELECT 1")), True,
+                ts_ns=30)
+        st.feed(5, cql_frame(
+            OP_RESULT, 2, (1).to_bytes(4, "big"), response=True,
+        ), False, ts_ns=38)
+        recs = st.drain()
+        assert len(recs) == 2
+        assert recs[0]["resp_body"] == "<oversized>"
+        assert recs[1]["latency_ns"] == 8
+
+
+class TestTapIntegration:
+    def test_capture_to_pxl_query(self):
+        """Recorded redis+kafka+cql capture -> tap -> tables -> PxL."""
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.ingest.collector import Collector
+        from pixie_tpu.ingest.tap import CaptureTapConnector
+
+        def ev(conn, direction, data, ts, proto):
+            return {
+                "conn": conn, "dir": direction, "ts": ts, "proto": proto,
+                "data_b64": base64.b64encode(data).decode(),
+            }
+
+        feed = []
+        for i in range(30):
+            cmd = "GET" if i % 3 else "SET"
+            feed.append(ev(1, "req", resp_array(cmd, f"k{i}"), 1000 + i * 10,
+                           "redis"))
+            feed.append(ev(1, "resp", b"+OK\r\n", 1004 + i * 10, "redis"))
+        for i in range(20):
+            feed.append(ev(2, "req", kafka_req(i % 2, 9, i), 2000 + i * 10,
+                           "kafka"))
+            feed.append(ev(2, "resp", kafka_resp(i), 2007 + i * 10, "kafka"))
+        for i in range(10):
+            feed.append(ev(3, "req",
+                           cql_frame(OP_QUERY, i, cql_query("SELECT 1")),
+                           3000 + i * 10, "cql"))
+            feed.append(ev(
+                3, "resp", cql_frame(OP_RESULT, i, cql_rows(1), response=True),
+                3002 + i * 10, "cql",
+            ))
+
+        eng = Engine(window_rows=1 << 10)
+        tap = CaptureTapConnector(feed=feed, service="svc-a")
+        coll = Collector()
+        coll.wire_to(eng)
+        coll.register_source(tap)
+        tap.transfer_data(coll, coll._data_tables)
+        coll.flush()
+
+        got = eng.execute_query("""
+import px
+df = px.DataFrame(table='redis_events')
+out = df.groupby('req_cmd').agg(n=('latency_ns', px.count),
+                                mean_ns=('latency_ns', px.mean))
+px.display(out)
+""")["output"].to_pydict()
+        assert dict(zip(got["req_cmd"], got["n"].tolist())) == {
+            "GET": 20, "SET": 10
+        }
+        assert all(abs(v - 4.0) < 1e-6 for v in got["mean_ns"])
+
+        got2 = eng.execute_query("""
+import px
+df = px.DataFrame(table='kafka_events.beta')
+out = df.groupby('req_cmd').agg(n=('latency_ns', px.count))
+px.display(out)
+""")["output"].to_pydict()
+        assert dict(zip(got2["req_cmd"].tolist(), got2["n"].tolist())) == {
+            0: 10, 1: 10
+        }
+
+        got3 = eng.execute_query("""
+import px
+df = px.DataFrame(table='cql_events')
+out = df.groupby('req_op').agg(n=('latency_ns', px.count),
+                               p50=('latency_ns', px.quantiles))
+px.display(out)
+""")["output"].to_pydict()
+        assert got3["n"].tolist() == [10]
+        assert int(got3["req_op"][0]) == OP_QUERY

@@ -249,6 +249,34 @@ def _seed_all_tables(eng, n=3000, seed=11):
         "latency_ns": rng.integers(10**4, 10**8, n).astype(np.int64),
         "service": svcs,
     })
+    eng.append_data("redis_events", {
+        "time_": t, "upid": upid,
+        "req_cmd": [("GET", "SET", "HGETALL", "INCR")[i % 4]
+                    for i in range(n)],
+        "req_args": [f"key{i % 40}" for i in range(n)],
+        "resp": ["OK"] * n,
+        "latency_ns": rng.integers(10**3, 10**7, n).astype(np.int64),
+        "service": svcs,
+    })
+    eng.append_data("kafka_events.beta", {
+        "time_": t, "upid": upid,
+        "req_cmd": rng.choice([0, 1, 3, 12], n).astype(np.int64),
+        "client_id": [f"client-{i % 5}" for i in range(n)],
+        "req_body": ["Produce v9"] * n,
+        "resp": ["bytes=12"] * n,
+        "latency_ns": rng.integers(10**4, 10**8, n).astype(np.int64),
+        "service": svcs,
+    })
+    eng.append_data("cql_events", {
+        "time_": t, "upid": upid,
+        "req_op": rng.choice([7, 9, 10, 13], n).astype(np.int64),
+        "req_body": [f"SELECT * FROM ks.t WHERE id={i % 20}"
+                     for i in range(n)],
+        "resp_op": rng.choice([8, 8, 8, 0], n).astype(np.int64),
+        "resp_body": ["Rows cols=2"] * n,
+        "latency_ns": rng.integers(10**4, 10**8, n).astype(np.int64),
+        "service": svcs,
+    })
     eng.append_data("process_stats", {
         "time_": t, "upid": upid,
         "major_faults": rng.integers(0, 5, n),
