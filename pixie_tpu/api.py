@@ -144,6 +144,12 @@ class Client:
         return StreamSubscription(self, res["qid"], sub)
 
     def _request(self, topic: str, msg: dict, timeout_s: float = 10.0) -> dict:
+        from .config import get_flag
+
+        if get_flag("bus_secret") and "token" not in msg:
+            from .services.auth import sign_token
+
+            msg = {**msg, "token": sign_token(get_flag("bus_secret"), "api")}
         res = self._bus.request(topic, msg, timeout_s=timeout_s)
         if not res.get("ok"):
             raise ScriptExecutionError(res.get("error", "unknown error"))

@@ -220,9 +220,16 @@ def cmd_agents(args) -> int:
 
 
 def cmd_docs(args) -> int:
+    from .metadata.funcs import register_metadata_funcs
+    from .metadata.state import MetadataState
     from .udf.docgen import generate_markdown
+    from .udf.registry import default_registry
 
-    print(generate_markdown())
+    # Include the metadata family (bound to an empty state): `px docs >
+    # docs/FUNCTIONS.md` must regenerate the committed reference exactly.
+    reg = default_registry().clone("docs")
+    register_metadata_funcs(reg, MetadataState())
+    print(generate_markdown(reg))
     return 0
 
 

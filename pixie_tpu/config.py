@@ -121,3 +121,8 @@ define_flag("device_join_min_rows", 1 << 15,
             "Combined row count above which joins route to the device kernel.")
 define_flag("agent_heartbeat_s", 5.0, "Agent heartbeat period (seconds).")
 define_flag("agent_expiry_s", 60.0, "Tracker agent expiry after silence.")
+define_flag(
+    "bus_secret", "",
+    "Shared secret for netbus/broker bearer tokens; empty disables auth "
+    "(single-trust-domain deployments).",
+)

@@ -125,9 +125,15 @@ def _long_string(body: bytes) -> str:
     return body[4:4 + min(n, len(body) - 4)].decode("utf-8", "replace")
 
 
+_OVERSIZED = object()  # framer dropped the payload
+_COMPRESSED = object()  # flags & 0x01: body is lz4/snappy, not parsed
+
+
 def _req_summary(opcode: int, body) -> str:
-    if body is None:
+    if body is _OVERSIZED or body is None:
         return "<oversized>"
+    if body is _COMPRESSED:
+        return "<compressed>"
     if opcode in (OP_QUERY, OP_PREPARE):
         q = _long_string(body)
         return q if len(q) <= 1024 else q[:1024] + "..."
@@ -146,8 +152,10 @@ def _req_summary(opcode: int, body) -> str:
 
 
 def _resp_summary(opcode: int, body) -> str:
-    if body is None:
+    if body is _OVERSIZED or body is None:
         return "<oversized>"
+    if body is _COMPRESSED:
+        return "<compressed>"
     if opcode == OP_RESULT:
         if len(body) >= 4:
             kind = int.from_bytes(body[:4], "big")
@@ -203,8 +211,8 @@ class CQLStitcher:
                 if ver & 0x80:
                     self.parse_errors += 1  # response bits on req stream
                     continue
-                if flags & 0x01:
-                    body = None  # compressed: summary-only
+                if flags & 0x01 and body is not None:
+                    body = _COMPRESSED  # summary-only, distinct sentinel
                 while len(c.pending) >= self.PENDING_PER_CONN:
                     c.pending.popitem(last=False)
                     self.parse_errors += 1
@@ -214,8 +222,8 @@ class CQLStitcher:
             if not ver & 0x80:
                 self.parse_errors += 1
                 continue
-            if flags & 0x01:
-                body = None
+            if flags & 0x01 and body is not None:
+                body = _COMPRESSED
             if opcode == OP_EVENT:
                 # Server push (topology/status/schema change): no
                 # request to pair; stream id is -1 by spec.

@@ -86,9 +86,15 @@ class _Framer:
             if ln > self.MAX_FRAME:
                 # Keep the header bytes (they carry api key/correlation
                 # id) and discard the rest incrementally — pairing must
-                # survive giant produce batches.
+                # survive giant produce batches. Wait for the header to
+                # be fully buffered first: entering skip mode off a chunk
+                # boundary inside the first 8 body bytes would lose the
+                # correlation id.
+                head_n = min(ln, 64)
+                if len(self._buf) < 4 + head_n:
+                    break
                 self.oversized += 1
-                self._skip_head = self._buf[4:4 + 64]
+                self._skip_head = self._buf[4:4 + head_n]
                 drop = min(4 + ln, len(self._buf))
                 self._skip = 4 + ln - drop
                 self._buf = self._buf[drop:]
@@ -147,7 +153,6 @@ class KafkaStitcher:
                     cl = int.from_bytes(body[8:10], "big", signed=True)
                     if 0 <= cl <= len(body) - 10:
                         client_id = body[10:10 + cl].decode("utf-8", "replace")
-                name = API_KEYS.get(api_key, f"Unknown({api_key})")
                 if api_key not in API_KEYS:
                     self.parse_errors += 1
                     continue  # not kafka / corrupt: don't poison pending
@@ -158,7 +163,7 @@ class KafkaStitcher:
                     c.pending.popitem(last=False)
                     self.parse_errors += 1
                 body_note = "<truncated>" if truncated else ""
-                c.pending[cid] = (name, api_ver, client_id, ts, body_note)
+                c.pending[cid] = (api_key, api_ver, client_id, ts, body_note)
             return emitted
         for truncated, body in c.resp.feed(data):
             if len(body) < 4:
@@ -169,13 +174,13 @@ class KafkaStitcher:
             if req is None:
                 self.parse_errors += 1
                 continue
-            name, api_ver, client_id, req_ts, body_note = req
+            api_key, api_ver, client_id, req_ts, body_note = req
             resp = "<truncated>" if truncated else f"bytes={len(body)}"
             self.records.append({
                 "time_": req_ts,
-                "req_cmd": _api_id(name),
+                "req_cmd": api_key,
                 "client_id": client_id,
-                "req_body": f"{name} v{api_ver}"
+                "req_body": f"{API_KEYS[api_key]} v{api_ver}"
                             + (f" {body_note}" if body_note else ""),
                 "resp": resp,
                 "latency_ns": max(ts - req_ts, 0),
@@ -188,10 +193,3 @@ class KafkaStitcher:
     def drain(self) -> list[dict]:
         out, self.records = self.records, []
         return out
-
-
-_NAME_TO_ID = {v: k for k, v in API_KEYS.items()}
-
-
-def _api_id(name: str) -> int:
-    return _NAME_TO_ID.get(name, -1)

@@ -21,17 +21,19 @@ from ..types.strings import StringDictionary
 from ..udf.udf import Executor, STRING, UINT128
 from .state import MetadataState
 
-# upid_to_* attribute -> snapshot_entries key
+from ..types.semantic import SemanticType as ST
+
+# upid_to_* attribute -> (snapshot_entries key, semantic type of result)
 _UPID_ATTRS = {
-    "upid_to_pod_id": "pod_id",
-    "upid_to_pod_name": "pod_name",
-    "upid_to_namespace": "namespace",
-    "upid_to_node_name": "node_name",
-    "upid_to_service_id": "service_id",
-    "upid_to_service_name": "service_name",
-    "upid_to_container_id": "container_id",
-    "upid_to_container_name": "container_name",
-    "upid_to_cmdline": "cmdline",
+    "upid_to_pod_id": ("pod_id", ST.ST_NONE),
+    "upid_to_pod_name": ("pod_name", ST.ST_POD_NAME),
+    "upid_to_namespace": ("namespace", ST.ST_NAMESPACE_NAME),
+    "upid_to_node_name": ("node_name", ST.ST_NODE_NAME),
+    "upid_to_service_id": ("service_id", ST.ST_NONE),
+    "upid_to_service_name": ("service_name", ST.ST_SERVICE_NAME),
+    "upid_to_container_id": ("container_id", ST.ST_NONE),
+    "upid_to_container_name": ("container_name", ST.ST_CONTAINER_NAME),
+    "upid_to_cmdline": ("cmdline", ST.ST_NONE),
 }
 
 
@@ -62,7 +64,7 @@ def register_metadata_funcs(reg, state: MetadataState) -> None:
     # captured as jit constants poison axon-tunnel dispatch. device_lookup
     # converts the table planes inline during tracing.
 
-    for fname, attr in _UPID_ATTRS.items():
+    for fname, (attr, st) in _UPID_ATTRS.items():
         d = StringDictionary()
         ids = np.asarray(d.encode(snap[attr] + [""]))  # [n+1]; n = miss -> ""
 
@@ -75,6 +77,7 @@ def register_metadata_funcs(reg, state: MetadataState) -> None:
             fname, (UINT128,), STRING, fn, out_dict=d,
             doc=f"Resolve a UPID to its {attr.replace('_', ' ')} "
                 "(empty string when unknown).",
+            semantic_type=int(st),
         )
 
     # -- id/ip string translations (HOST_DICT: once per distinct value) ------

@@ -62,10 +62,21 @@ def _recv_exact(sock: socket.socket, n: int):
 
 
 class BusServer:
-    """Bridges a local MessageBus to remote RemoteBus clients."""
+    """Bridges a local MessageBus to remote RemoteBus clients.
 
-    def __init__(self, bus: MessageBus, host: str = "127.0.0.1", port: int = 0):
+    With ``secret`` set (or the ``bus_secret`` flag), a client's FIRST
+    frame must be ``{"op": "auth", "token": ...}`` carrying a valid
+    bearer token (``auth.sign_token``); anything else closes the
+    connection — the netbus trust boundary (the reference checks JWT
+    claims at every gRPC service edge, authcontext/context.go:38).
+    """
+
+    def __init__(self, bus: MessageBus, host: str = "127.0.0.1", port: int = 0,
+                 secret: str | None = None):
+        from ..config import get_flag
+
         self.bus = bus
+        self.secret = get_flag("bus_secret") if secret is None else secret
         self._srv = socket.create_server((host, port))
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
@@ -112,6 +123,7 @@ class _ClientConn:
         self.sock = sock
         self._send_lock = threading.Lock()
         self._subs: dict[int, object] = {}  # sid -> Subscription
+        self.auth_ctx = None  # AuthContext once authenticated
         self._thread = threading.Thread(
             target=self._read_loop, name="busserver-client", daemon=True
         )
@@ -120,12 +132,35 @@ class _ClientConn:
         self._thread.start()
 
     def _read_loop(self) -> None:
+        from .auth import ANONYMOUS, AuthError, verify_token
+
         try:
+            if self.server.secret:
+                # Authentication handshake gates EVERYTHING else.
+                frame = _recv_frame(self.sock)
+                if frame is None or frame.get("op") != "auth":
+                    self._send({"op": "auth_err", "error": "auth required"})
+                    return
+                try:
+                    self.auth_ctx = verify_token(
+                        self.server.secret, frame.get("token")
+                    )
+                except AuthError as e:
+                    self._send({"op": "auth_err", "error": str(e)})
+                    return
+                self._send({"op": "auth_ok", "sub": self.auth_ctx.subject})
+            else:
+                self.auth_ctx = ANONYMOUS
             while True:
                 frame = _recv_frame(self.sock)
                 if frame is None:
                     break
                 op = frame.get("op")
+                if op == "auth":
+                    # Token offered to a no-secret server (or re-auth):
+                    # acknowledge so the client handshake completes.
+                    self._send({"op": "auth_ok", "sub": ""})
+                    continue
                 if op == "pub":
                     self.server.bus.publish(frame["topic"], frame["msg"])
                 elif op == "sub":
@@ -203,13 +238,34 @@ class RemoteBus:
     """Client-side bus mirror: same subscribe/publish surface as
     MessageBus, carried over one TCP connection to a BusServer."""
 
-    def __init__(self, host: str, port: int, connect_timeout_s: float = 10.0):
+    def __init__(self, host: str, port: int, connect_timeout_s: float = 10.0,
+                 token: str | None = None):
+        from ..config import get_flag
+
         self.sock = socket.create_connection((host, port), connect_timeout_s)
         self._send_lock = threading.Lock()
         self._lock = threading.Lock()
         self._handlers: dict[int, object] = {}  # sid -> callable
         self._next_sid = 1
         self._closed = threading.Event()
+        # Mint a token from the shared secret when the caller brings
+        # none (deploy processes share the bus_secret flag/env).
+        if token is None and get_flag("bus_secret"):
+            from .auth import sign_token
+
+            token = sign_token(get_flag("bus_secret"), "remotebus")
+        if token:
+            # Handshake BEFORE the read loop owns the socket: the server
+            # answers auth_ok or auth_err+close, so a bad token fails
+            # loudly at connect instead of silently dropping frames.
+            self.sock.settimeout(connect_timeout_s)
+            _send_frame(self.sock, {"op": "auth", "token": token})
+            reply = _recv_frame(self.sock)
+            if not (isinstance(reply, dict) and reply.get("op") == "auth_ok"):
+                err = (reply or {}).get("error", "connection closed")
+                self.sock.close()
+                raise ConnectionError(f"netbus auth failed: {err}")
+            self.sock.settimeout(None)
         self._thread = threading.Thread(
             target=self._read_loop, name="remotebus", daemon=True
         )

@@ -119,9 +119,15 @@ class QueryBroker:
         bus: MessageBus,
         tracker: AgentTracker,
         registry: Registry | None = None,
+        secret: str | None = None,
     ):
+        from ..config import get_flag
+
         self.bus = bus
         self.tracker = tracker
+        # Bearer-token check on served API requests (authcontext analog);
+        # empty = auth disabled. Netbus connects are gated separately.
+        self.secret = get_flag("bus_secret") if secret is None else secret
         from .vizier_funcs import bind_service_registry
 
         self.registry = bind_service_registry(
@@ -341,6 +347,27 @@ class QueryBroker:
             if inbox:
                 self.bus.publish(inbox, payload)
 
+        def _auth(msg):
+            """Verify the request's bearer token; returns the AuthContext
+            (threaded into handlers the way the reference's authcontext
+            rides the gRPC metadata). No-op when auth is disabled."""
+            from .auth import verify_token
+
+            return verify_token(self.secret, msg.get("token"))
+
+        def _guarded(handler):
+            def wrapped(msg):
+                from .auth import AuthError
+
+                try:
+                    msg["_auth"] = _auth(msg)
+                except AuthError as e:
+                    _reply(msg, {"ok": False, "error": f"AuthError: {e}"})
+                    return
+                handler(msg)
+
+            return wrapped
+
         def _on_execute(msg):
             try:
                 res = self.execute_script(
@@ -408,10 +435,14 @@ class QueryBroker:
             _reply(msg, {"ok": True, "scripts": list_scripts()})
 
         self._serve_subs = [
-            self.bus.subscribe("broker.execute", _on_execute),
-            self.bus.subscribe("broker.execute_stream", _on_execute_stream),
-            self.bus.subscribe("broker.stream_cancel", _on_stream_cancel),
-            self.bus.subscribe("broker.schemas", _on_schemas),
-            self.bus.subscribe("broker.agents", _on_agents),
-            self.bus.subscribe("broker.scripts", _on_scripts),
+            self.bus.subscribe("broker.execute", _guarded(_on_execute)),
+            self.bus.subscribe(
+                "broker.execute_stream", _guarded(_on_execute_stream)
+            ),
+            self.bus.subscribe(
+                "broker.stream_cancel", _guarded(_on_stream_cancel)
+            ),
+            self.bus.subscribe("broker.schemas", _guarded(_on_schemas)),
+            self.bus.subscribe("broker.agents", _guarded(_on_agents)),
+            self.bus.subscribe("broker.scripts", _guarded(_on_scripts)),
         ]

@@ -31,6 +31,7 @@ def register(reg):
         finalize=lambda c: tdigest.digest_quantile(c, QUANTILE_POINTS),
         struct_fields=QUANTILE_FIELDS,
         doc="Approximate quantiles of the group via a mergeable t-digest.",
+        semantic_type=1000,  # SemanticType.ST_QUANTILES (types.proto:84)
     )
 
     # Direct single-quantile UDAs (not in the reference's registry, but the

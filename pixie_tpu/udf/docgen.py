@@ -10,9 +10,17 @@ into one markdown document.
 from __future__ import annotations
 
 
-def _sig(arg_types, ret) -> str:
+def _sig(arg_types, ret, semantic: int = 1) -> str:
+    from ..types.semantic import SemanticType
+
     args = ", ".join(t.name for t in arg_types)
-    return f"({args}) -> {ret.name}"
+    sig = f"({args}) -> {ret.name}"
+    if semantic not in (0, 1):  # UNSPECIFIED / NONE render nothing
+        try:
+            sig += f" [{SemanticType(semantic).name}]"
+        except ValueError:
+            sig += f" [semantic={semantic}]"  # user-defined value
+    return sig
 
 
 def generate_markdown(registry=None) -> str:
@@ -31,7 +39,9 @@ def generate_markdown(registry=None) -> str:
             lines.append(doc)
         lines.append("")
         for o in ovs:
-            lines.append(f"- `{name}{_sig(o.arg_types, o.return_type)}`")
+            lines.append(
+                f"- `{name}{_sig(o.arg_types, o.return_type, o.semantic_type)}`"
+            )
         lines.append("")
 
     lines += ["## Aggregate functions", ""]
@@ -43,7 +53,9 @@ def generate_markdown(registry=None) -> str:
             lines.append(doc)
         lines.append("")
         for o in ovs:
-            lines.append(f"- `{name}{_sig(o.arg_types, o.return_type)}`")
+            lines.append(
+                f"- `{name}{_sig(o.arg_types, o.return_type, o.semantic_type)}`"
+            )
         lines.append("")
 
     udtfs = sorted(reg.udtf_names())

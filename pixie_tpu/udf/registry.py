@@ -28,6 +28,7 @@ class Registry:
                     f"duplicate scalar UDF {udf.name!r} with arg types {udf.arg_types}"
                 )
         self._scalar[udf.name].append(udf)
+        self._ctx_funcs_cache = None  # metadata resolver derives from this
 
     def register_uda(self, uda: UDADef) -> None:
         for existing in self._uda.setdefault(uda.name, []):
@@ -47,6 +48,7 @@ class Registry:
         dict_arg: int = 0,
         out_dict=None,
         doc: str = "",
+        semantic_type: int = 1,
     ) -> ScalarUDFDef:
         udf = ScalarUDFDef(
             name=name,
@@ -57,6 +59,7 @@ class Registry:
             dict_arg=dict_arg,
             out_dict=out_dict,
             doc=doc,
+            semantic_type=semantic_type,
         )
         self.register_scalar(udf)
         return udf
@@ -73,6 +76,7 @@ class Registry:
         finalize: Callable,
         struct_fields: tuple[str, ...] | None = None,
         doc: str = "",
+        semantic_type: int = 1,
     ) -> UDADef:
         d = UDADef(
             name=name,
@@ -84,6 +88,7 @@ class Registry:
             finalize=finalize,
             struct_fields=struct_fields,
             doc=doc,
+            semantic_type=semantic_type,
         )
         self.register_uda(d)
         return d

@@ -68,6 +68,10 @@ class ScalarUDFDef:
     # remapping an input dictionary).
     out_dict: object = None
     doc: str = ""
+    # What the RETURN VALUE means (udf/type_inference.h analog): drives
+    # ctx-property resolution and docgen. 1 == SemanticType.ST_NONE
+    # (plain int default keeps the dataclass import-cycle-free).
+    semantic_type: int = 1
 
 
 @dataclass(frozen=True)
@@ -97,6 +101,9 @@ class UDADef:
     # planner may fuse pluck_float64(agg, field) to a direct plane read.
     struct_fields: tuple[str, ...] | None = None
     doc: str = ""
+    # Semantic type of the finalized value (ST_QUANTILES for sketches
+    # etc.); 1 == SemanticType.ST_NONE.
+    semantic_type: int = 1
 
 
 # -- overload resolution -----------------------------------------------------

@@ -165,6 +165,42 @@ class TestCLI:
         finally:
             server.close()
 
+    def test_secured_deploy_rejects_unauthenticated(self, served_cluster):
+        """With bus_secret set, the e2e netbus path requires the token:
+        no/wrong secret -> connection refused at auth; right secret ->
+        the CLI works unchanged (reference authcontext parity)."""
+        from pixie_tpu.config import set_flag
+        from pixie_tpu.services.netbus import BusServer, RemoteBus
+
+        bus, _t, broker = served_cluster
+        old_secret = broker.secret
+        server = BusServer(bus, secret="deploy-secret")
+        broker.secret = "deploy-secret"
+        try:
+            addr = f"127.0.0.1:{server.port}"
+            # Wrong secret: rejected at connect.
+            from pixie_tpu.services.auth import sign_token
+
+            with pytest.raises(ConnectionError, match="auth"):
+                RemoteBus("127.0.0.1", server.port,
+                          token=sign_token("wrong", "intruder"))
+            # No token at all: the server drops the connection before any
+            # op reaches the bus (request times out client-side).
+            rb = RemoteBus("127.0.0.1", server.port)
+            with pytest.raises((TimeoutError, ConnectionError)):
+                rb.request("broker.schemas", {}, timeout_s=0.5)
+            rb.close()
+            # CLI with the shared secret (flag/env path): works e2e.
+            set_flag("bus_secret", "deploy-secret")
+            out = _run_cli("tables", "--broker", addr)
+            assert "http_events" in out
+            out = _run_cli("run", "px/http_stats", "--broker", addr)
+            assert "output" in out
+        finally:
+            set_flag("bus_secret", "")
+            broker.secret = old_secret
+            server.close()
+
 
 class TestPlanDebug:
     def test_stats_annotation(self):

@@ -344,3 +344,90 @@ px.display(df, 'rows')
         rows = out["rows"].to_pydict()
         assert rows["clean"][0] == "id=? from <REDACTED_IPV4>".replace("?", "1")
         assert rows["proto"][2] == "MySQL"
+
+
+class TestSemanticTypes:
+    """Semantic-type annotations (reference udf/type_inference.h +
+    types.proto SemanticType): registry carries them, the metadata
+    resolver derives ctx keys from them, docgen publishes them."""
+
+    def test_ctx_resolution_driven_by_annotation(self):
+        import numpy as np
+
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.types.dtypes import DataType
+        from pixie_tpu.types.semantic import SemanticType
+        from pixie_tpu.types.strings import StringDictionary
+        from pixie_tpu.udf.registry import default_registry
+
+        eng = Engine()
+        reg = default_registry().clone("sem-test")
+        d = StringDictionary()
+        d.encode(["zone-a", "zone-b"])
+
+        def upid_to_zone(upid):
+            import jax.numpy as jnp
+
+            hi, lo = upid
+            return (lo % 2).astype(jnp.int32)
+
+        # A CUSTOM metadata function: annotating it ST_NODE_NAME makes
+        # ctx['node'] resolve to it with no resolver changes.
+        reg.scalar(
+            "upid_to_zone", (DataType.UINT128,), DataType.STRING,
+            upid_to_zone, out_dict=d,
+            semantic_type=int(SemanticType.ST_NODE_NAME),
+        )
+        eng.registry = reg
+        n = 64
+        eng.append_data("t", {
+            "time_": np.arange(n, dtype=np.int64),
+            "upid": np.stack([
+                np.full(n, 1, np.uint64),
+                np.arange(n, dtype=np.uint64),
+            ], axis=1),
+            "v": np.ones(n, dtype=np.int64),
+        })
+        out = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df.node = df.ctx['node']\n"
+            "s = df.groupby('node').agg(n=('v', px.count))\npx.display(s)"
+        )["output"].to_pydict()
+        assert sorted(zip(out["node"], out["n"].tolist())) == [
+            ("zone-a", 32), ("zone-b", 32)
+        ]
+
+    def test_docgen_renders_semantic_types(self):
+        from pixie_tpu.metadata.funcs import register_metadata_funcs
+        from pixie_tpu.metadata.state import MetadataState
+        from pixie_tpu.udf.docgen import generate_markdown
+        from pixie_tpu.udf.registry import default_registry
+
+        reg = default_registry().clone("docs-test")
+        register_metadata_funcs(reg, MetadataState())
+        md = generate_markdown(reg)
+        assert "[ST_SERVICE_NAME]" in md
+        assert "[ST_POD_NAME]" in md
+        assert "[ST_QUANTILES]" in md
+
+    def test_unknown_ctx_key_lists_semantic_keys(self):
+        import pytest as _pytest
+
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.metadata.state import MetadataState
+        from pixie_tpu.planner.objects import PxLError
+
+        eng = Engine()
+        eng.set_metadata_state(MetadataState())
+        import numpy as np
+
+        eng.append_data("t", {
+            "time_": np.arange(4, dtype=np.int64),
+            "upid": np.stack([np.ones(4, np.uint64),
+                              np.arange(4, dtype=np.uint64)], axis=1),
+        })
+        with _pytest.raises(PxLError, match="service"):
+            eng.execute_query(
+                "import px\ndf = px.DataFrame(table='t')\n"
+                "df.x = df.ctx['nope']\npx.display(df)"
+            )
