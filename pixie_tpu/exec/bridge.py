@@ -47,9 +47,11 @@ class AggStatePayload:
     # Dense-domain states ship no key planes (slot index IS the packed
     # key); the producing fragment's domains let the merge side expand
     # them back to explicit keys (dictionaries may differ per agent).
-    # ``dense_offsets`` shifts stats-derived integer codes back to values.
+    # ``dense_offsets`` shifts stats-derived integer codes back to values;
+    # ``dense_strides`` scales step-indexed codes (binned time keys).
     dense_domains: tuple = ()
     dense_offsets: tuple = ()
+    dense_strides: tuple = ()
 
 
 @dataclass
@@ -87,10 +89,11 @@ def _expand_dense_payload(p, group_rel, key_plane_index):
         [group_rel.col_type(c) for c, _i in key_plane_index],
         np,
         offsets=getattr(p, "dense_offsets", ()),
+        strides=getattr(p, "dense_strides", ()),
     )
     return dataclasses.replace(
         p, state={**p.state, "keys": tuple(keys)}, dense_domains=(),
-        dense_offsets=(),
+        dense_offsets=(), dense_strides=(),
     )
 
 
@@ -158,6 +161,7 @@ def bridge_payload(engine, res):
             state=jax.tree_util.tree_map(np.asarray, state),
             dense_domains=frag.dense_domains,
             dense_offsets=frag.dense_offsets,
+            dense_strides=frag.dense_strides,
         )
     return RowsPayload(batch=engine._materialize(res))
 

@@ -455,6 +455,19 @@ class Engine:
         import jax
 
         init_state, agg_step, _ = self._compile_steps(frag)
+        if (
+            self.cpu_parallel_fold
+            and jax.default_backend() == "cpu"
+            and frag.native_fold is not None
+            and get_flag("cpu_fold_threads") != 1
+        ):
+            # CPU backend: XLA executes scatters single-threaded, capping
+            # bincount-class aggregations at one core. Route the scatter
+            # passes through the native multi-core kernel instead (XLA
+            # still runs the elementwise pre-stage + slot packing).
+            state = self._fold_agg_state_native(stream, frag, stats)
+            if state is not None:
+                return state
         state = init_state()
         # Scan-folding exists to amortize the TPU tunnel's ~70ms/dispatch
         # round trip; on the CPU backend dispatches are cheap and the
@@ -509,6 +522,110 @@ class Engine:
             _block_if(stats, state)
         return state
 
+    def _fold_agg_state_native(self, stream: "_Stream", frag, stats=None):
+        """Fold via the native multi-core segmented-fold kernel.
+
+        Per window, XLA produces (slot ids, per-agg value columns) —
+        elementwise work it handles well — and ``native/seg_fold.cc``
+        does the scatter passes with one table per core. Output tables
+        accumulate across windows IN PLACE (the carries are associative),
+        so there is no per-window state or merge at all. Returns None to
+        fall back when the kernel is unavailable or a dtype is exotic.
+        """
+        import jax
+
+        import jax.numpy as jnp
+
+        from ..native import seg_fold_call
+
+        plan = frag.native_fold["plan"]
+        inputs_jit = frag.native_fold["inputs_jit"]
+        g = len(np.asarray(frag.init_state()["valid"]))
+        # One output table per flattened carry leaf, (g+1) rows (slot g
+        # is the masked-row trash), pre-filled with the UDA's neutral
+        # (init carries are uniform fills by construction).
+        _OP = {"count": 0, "sum": 1, "min": 2, "max": 3}
+        specs = []  # (op, dtype, arg_index | None) per leaf
+        outs = []
+        treedefs = []  # (out_name, treedef, n_leaves)
+        for j, (out_name, uda_name, init) in enumerate(plan):
+            leaves, treedef = jax.tree_util.tree_flatten(init(1))
+            treedefs.append((out_name, treedef, len(leaves)))
+            for li, leaf in enumerate(leaves):
+                leaf = np.asarray(leaf)
+                if uda_name == "mean":
+                    # (sum, count) carry: leaf 0 sums the arg, leaf 1
+                    # counts rows.
+                    op, arg_i = (1, j) if li == 0 else (0, None)
+                elif uda_name == "count":
+                    op, arg_i = 0, None
+                else:
+                    op, arg_i = _OP[uda_name], j
+                specs.append((op, leaf.dtype, arg_i))
+                outs.append(np.full(g + 1, leaf.reshape(-1)[0], dtype=leaf.dtype))
+        if not any(op == 0 for op, _dt, _a in specs):
+            # Validity needs a row count; add a hidden one.
+            specs.append((0, np.dtype(np.int64), None))
+            outs.append(np.zeros(g + 1, dtype=np.int64))
+
+        from ..native import np_view, seg_fold_raw_call
+
+        raw = frag.native_fold.get("raw")
+        oob_any = False
+        for cols, valid in self._staged_windows(stream, stats):
+            with _timed(stats, "compute"):
+                if raw is not None and isinstance(valid, tuple):
+                    # Zero-device-work path: the kernel reads the staged
+                    # planes directly (keys packed in-kernel; np_view
+                    # shares the buffers, no copies).
+                    planes = [
+                        np_view(cols[c][0]) for c in raw["key_cols"]
+                    ]
+                    vals = [
+                        None if a is None
+                        else np_view(cols[raw["arg_cols"][a]][0])
+                        for _op, _dt, a in specs
+                    ]
+                    oob_n = seg_fold_raw_call(
+                        planes, raw["key_specs"], int(valid[0]),
+                        int(valid[1]), g, specs, vals, outs,
+                    )
+                    if oob_n is not None:
+                        oob_any = oob_any or oob_n > 0
+                        if stats is not None:
+                            stats.windows += 1
+                        continue
+                    # Unsupported dtype combo: fall through to the jit
+                    # form for this (and subsequent) windows.
+                # NOTE: keep gids_dev/args referenced while the kernel
+                # reads their zero-copy views (np_view aliases buffers).
+                gids_dev, args, oob = inputs_jit(cols, valid)
+                gids = np_view(gids_dev)
+                vals = [
+                    None if a is None else np_view(args[a])
+                    for _op, _dt, a in specs
+                ]
+                if not seg_fold_call(gids, g, specs, vals, outs):
+                    return None  # exotic dtype combo: XLA fallback
+                oob_any = oob_any or bool(np.asarray(oob))
+            if stats is not None:
+                stats.windows += 1
+        carries = {}
+        k = 0
+        for out_name, treedef, n_leaves in treedefs:
+            leaves = [jnp.asarray(outs[k + i][:g]) for i in range(n_leaves)]
+            carries[out_name] = jax.tree_util.tree_unflatten(treedef, leaves)
+            k += n_leaves
+        count_out = next(
+            o for (op, _dt, _a), o in zip(specs, outs) if op == 0
+        )
+        return {
+            "keys": (),
+            "valid": jnp.asarray(count_out[:g] > 0),
+            "carries": carries,
+            "overflow": jnp.asarray(oob_any),
+        }
+
     # -- internals -----------------------------------------------------------
     def _as_stream(self, res) -> _Stream:
         if isinstance(res, _Stream):
@@ -557,6 +674,9 @@ class Engine:
     # (joins.try_fused_join); DistributedEngine gates this on mesh
     # side-table replication.
     fused_lookup_join = True
+    # CPU-backend thread-parallel window folding; DistributedEngine turns
+    # it off (its fold steps run inside shard_map over the mesh).
+    cpu_parallel_fold = True
 
     def _window_capacity(self, length: int) -> int:
         return max(bucket_capacity(self.window_rows), bucket_capacity(length))

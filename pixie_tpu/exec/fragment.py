@@ -37,7 +37,16 @@ from ..types.relation import Relation
 from ..udf.registry import Registry
 from ..udf.udf import UDADef, apply_cast
 from .expr import BindError, BoundExpr, bind_expr
-from .plan import AggOp, ColumnRef, FilterOp, LimitOp, LookupJoinOp, MapOp
+from .plan import (
+    AggOp,
+    ColumnRef,
+    FilterOp,
+    FuncCall,
+    LimitOp,
+    Literal,
+    LookupJoinOp,
+    MapOp,
+)
 
 # Integer-typed key columns that qualify for stats-derived dense domains.
 _INT_KEY_TYPES = (DataType.INT64, DataType.TIME64NS)
@@ -67,6 +76,13 @@ class CompiledFragment:
     # partial-agg path, ``pixie_tpu.parallel``):
     window_state: object = None  # (cols, valid) -> per-window group state
     merge_states: object = None  # (state_a, state_b) -> merged state
+    # Dense fragments whose aggregates are all count/sum/mean/min/max
+    # expose the native-fold seam: {"inputs_jit": (cols, valid) ->
+    # (gids, per-agg args, oob), "plan": ((out_name, uda_name, init),...)}.
+    # The engine's CPU backend runs the scatter passes in the native
+    # multi-core kernel (native/seg_fold.cc) — XLA:CPU scatters are
+    # single-threaded. None = not eligible.
+    native_fold: object = None
     apply_rows: object = None  # (cols, valid) -> (cols, valid), non-agg chain
     # (col, plane_i) per entry of state["keys"], and the post-pre-stage
     # relation the group columns are typed against (agg only) — consumed by
@@ -84,6 +100,9 @@ class CompiledFragment:
     # (0 for dictionary/bool columns).
     dense_domains: tuple = ()
     dense_offsets: tuple = ()
+    # Per-key value stride (1 except binned/affine integer keys, where
+    # slot codes count stride steps: value = code * stride + offset).
+    dense_strides: tuple = ()
 
 
 _FRAGMENT_CACHE: dict = {}
@@ -299,19 +318,76 @@ def _split_chain(ops):
     return pre, agg, post, limit
 
 
+def _expr_stats(e, stats):
+    """(min, max, stride) bounds of an integer expression, or None.
+
+    Interval + stride arithmetic over the affine expressions the planner
+    emits for time windowing: ``bin(t, d)`` yields multiples of ``d``,
+    and +/-/*-by-literal keep the lattice. The invariant maintained is
+    "every value ≡ min (mod stride)", which is exactly what the dense
+    packing needs: code = (v - min) // stride is exact. Constants carry
+    stride 0 (gcd identity)."""
+    import math
+
+    if isinstance(e, ColumnRef):
+        s = stats.get(e.name)
+        if s is None:
+            return None
+        return (int(s[0]), int(s[1]), int(s[2]) if len(s) > 2 else 1)
+    if isinstance(e, Literal):
+        v = e.value
+        if isinstance(v, bool) or not isinstance(v, int):
+            return None
+        return (v, v, 0)
+    if not isinstance(e, FuncCall):
+        return None
+    args = [_expr_stats(a, stats) for a in e.args]
+    if any(a is None for a in args):
+        return None
+    if e.name == "bin" and len(args) == 2 and args[1][0] == args[1][1]:
+        d = args[1][0]
+        lo, hi, _s = args[0]
+        if d <= 0 or lo < 0:
+            # jnp's floor-mod and this arithmetic agree for non-negative
+            # values; negative time bases don't occur, so just decline.
+            return None
+        return (lo - lo % d, hi - hi % d, d)
+    if e.name in ("add", "subtract") and len(args) == 2:
+        (la, ha, sa), (lb, hb, sb) = args
+        st = math.gcd(sa, sb)
+        if e.name == "add":
+            return (la + lb, ha + hb, st)
+        return (la - hb, ha - lb, st)
+    if e.name == "multiply" and len(args) == 2:
+        (la, ha, sa), (lb, hb, sb) = args
+        const = None
+        var = None
+        if lb == hb:
+            const, var = lb, (la, ha, sa)
+        elif la == ha:
+            const, var = la, (lb, hb, sb)
+        if const is None or const <= 0:
+            return None
+        lo, hi, st = var
+        return (lo * const, hi * const, st * const)
+    return None
+
+
 def _propagate_stats(ops, stats):
-    """Carry input-column (min, max) bounds through leading Map/Filter
-    ops: a map output keeps its source column's bounds only when it is a
-    pure pass-through ColumnRef; filters narrow, so bounds stay valid."""
+    """Carry input-column (min, max[, stride]) bounds through leading
+    Map/Filter ops. Pass-through ColumnRefs keep their source bounds;
+    affine integer expressions (time binning) get derived strided
+    bounds via ``_expr_stats``; filters narrow, so bounds stay valid."""
     if not stats:
         return stats
     for op in ops:
         if isinstance(op, MapOp):
-            stats = {
-                name: stats[e.name]
-                for name, e in op.exprs
-                if isinstance(e, ColumnRef) and e.name in stats
-            }
+            nxt = {}
+            for name, e in op.exprs:
+                s = _expr_stats(e, stats)
+                if s is not None and s[2] != 0:
+                    nxt[name] = s
+            stats = nxt
     return stats
 
 
@@ -339,31 +415,34 @@ def compile_fragment(ops, input_relation, input_dicts, registry: Registry,
     return _compile_agg(
         agg, post, limit, apply_pre, rel1, dicts1, registry,
         allow_dense=allow_dense, col_stats=_propagate_stats(pre, col_stats),
+        pre_ops=pre,
     )
 
 
-def unpack_dense_slots(iota, doms, col_types, xp, offsets=None):
+def unpack_dense_slots(iota, doms, col_types, xp, offsets=None, strides=None):
     """Dense slot indices -> per-group-col key planes.
 
     The single source of the unpack arithmetic, shared by the traced
     finalize (xp=jnp) and the bridge-payload expansion (xp=np) so the
     packing order / NULL encoding can never diverge between them.
-    ``offsets`` shifts stats-derived integer codes back to their values.
+    ``offsets`` shifts stats-derived integer codes back to their values;
+    ``strides`` scales step-indexed codes (binned time keys) back.
     """
     import numpy as np
 
     planes = []
-    stride = 1
+    pack = 1
     for d in doms:
-        stride *= d
+        pack *= d
     offsets = offsets or (0,) * len(doms)
-    for dt, dom, off in zip(col_types, doms, offsets):
-        stride //= dom
-        code = (iota // stride) % dom
+    strides = strides or (1,) * len(doms)
+    for dt, dom, off, st in zip(col_types, doms, offsets, strides):
+        pack //= dom
+        code = (iota // pack) % dom
         if dt == DataType.BOOLEAN:
             planes.append(code.astype(np.bool_))
         elif dt in _INT_KEY_TYPES:
-            planes.append((code + off).astype(np.int64))
+            planes.append((code * st + off).astype(np.int64))
         else:  # STRING: last sub-slot decodes back to NULL_ID (-1)
             planes.append(
                 xp.where(code == dom - 1, -1, code).astype(np.int32)
@@ -377,13 +456,20 @@ def unpack_dense_slots(iota, doms, col_types, xp, offsets=None):
 _STATS_Q = 4096
 
 
-def _round_stat_bounds(lo: int, hi: int) -> tuple:
-    return (lo - lo % _STATS_Q, hi - hi % _STATS_Q + _STATS_Q - 1)
+def _round_stat_bounds(lo: int, hi: int, stride: int = 1) -> tuple:
+    """Round bounds outward to the _STATS_Q grain IN STRIDE STEPS, so the
+    rounded lo keeps the values' residue class (the dense packing divides
+    by the stride exactly)."""
+    if stride <= 1:
+        return (lo - lo % _STATS_Q, hi - hi % _STATS_Q + _STATS_Q - 1, 1)
+    lo_r = lo - ((lo // stride) % _STATS_Q) * stride
+    hi_r = hi + (_STATS_Q - 1 - (hi // stride) % _STATS_Q) * stride
+    return (lo_r, hi_r, stride)
 
 
 def _static_key_domains(rel1, dicts1, group_cols, col_stats=None):
-    """Per-column (domain size, value offset) pairs, or None when any
-    column's domain is not known at compile time.
+    """Per-column (domain size, value offset, value stride) triples, or
+    None when any column's domain is not known at compile time.
 
     Dictionary-encoded STRING columns have exactly ``len(dict) + 1``
     possible device codes (ids 0..len-1 plus NULL_ID), BOOLEANs two.
@@ -398,25 +484,45 @@ def _static_key_domains(rel1, dicts1, group_cols, col_stats=None):
     for c in group_cols:
         dt = rel1.col_type(c)
         if dt == DataType.STRING and dicts1.get(c) is not None:
-            doms.append((len(dicts1[c]) + 1, 0))  # last slot = NULL_ID
+            doms.append((len(dicts1[c]) + 1, 0, 1))  # last slot = NULL_ID
         elif dt == DataType.BOOLEAN:
-            doms.append((2, 0))
+            doms.append((2, 0, 1))
         elif (
             dt in (DataType.INT64, DataType.TIME64NS)
             and col_stats
             and c in col_stats
         ):
-            lo, hi = _round_stat_bounds(*col_stats[c])
+            lo, hi, stride = _round_stat_bounds(*col_stats[c])
             if hi - lo + 1 <= 0:
                 return None
-            doms.append((hi - lo + 1, lo))
+            doms.append(((hi - lo) // stride + 1, lo, stride))
         else:
             return None
     return doms
 
 
+def _pure_select_map(pre):
+    """out col -> source table col when the pre-stage is only pure
+    column-select/rename Maps (the shape column pruning emits); None when
+    any real computation or filtering happens before the aggregate."""
+    mapping = None  # None = identity so far
+    for op in pre:
+        if not isinstance(op, MapOp) or not all(
+            isinstance(e, ColumnRef) for _n, e in op.exprs
+        ):
+            return None
+        new = {}
+        for n2, e in op.exprs:
+            src = e.name if mapping is None else mapping.get(e.name)
+            if src is None:
+                return None
+            new[n2] = src
+        mapping = new
+    return mapping if mapping is not None else {}
+
+
 def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
-                 allow_dense=True, col_stats=None):
+                 allow_dense=True, col_stats=None, pre_ops=()):
     g = agg.max_groups
     for c in agg.group_cols:
         if not rel1.has_column(c):
@@ -434,16 +540,17 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
     # multi-key packing blowup the base limit protects against.
     dense_domains = None
     dense_offsets = None
+    dense_strides = None
     if allow_dense and agg.group_cols:
         doms = _static_key_domains(
             rel1, dicts1, list(agg.group_cols), col_stats
         )
         if doms is not None:
             total = 1
-            for d, _off in doms:
+            for d, _off, _st in doms:
                 total *= d
             has_int = any(off or rel1.col_type(c) in _INT_KEY_TYPES
-                          for (_d, off), c in zip(doms, agg.group_cols))
+                          for (_d, off, _st), c in zip(doms, agg.group_cols))
             # The larger int budget is justified only for a SINGLE int
             # key (no multi-key packing blowup); mixed/multi-key domains
             # stay under the base limit.
@@ -453,8 +560,9 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
                 else get_flag("dense_domain_limit")
             )
             if total <= limit_slots:
-                dense_domains = tuple(d for d, _off in doms)
-                dense_offsets = tuple(off for _d, off in doms)
+                dense_domains = tuple(d for d, _off, _st in doms)
+                dense_offsets = tuple(off for _d, off, _st in doms)
+                dense_strides = tuple(st for _d, _off, st in doms)
                 g = total
 
     # Bind aggregate input expressions and resolve UDAs.
@@ -503,13 +611,20 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
         """
         slot = None
         oob = None
-        for (c, _i), dom, off in zip(
-            key_plane_index, dense_domains, dense_offsets
+        for (c, _i), dom, off, st in zip(
+            key_plane_index, dense_domains, dense_offsets, dense_strides
         ):
             p = cols[c][0]
             if rel1.col_type(c) in _INT_KEY_TYPES:
                 raw = p - off
-                out = (raw < 0) | (raw >= dom)
+                if st > 1:
+                    # Strided domain (binned time keys): the slot is the
+                    # step index; off-grid values (appends racing the
+                    # stats) are out-of-domain, not silently misbinned.
+                    out = (raw < 0) | (raw >= dom * st) | (raw % st != 0)
+                    raw = raw // st
+                else:
+                    out = (raw < 0) | (raw >= dom)
                 oob = out if oob is None else (oob | out)
                 code = jnp.clip(raw, 0, dom - 1).astype(jnp.int32)
             else:
@@ -536,6 +651,7 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
             [rel1.col_type(c) for c, _i in key_plane_index],
             jnp,
             offsets=dense_offsets,
+            strides=dense_strides,
         )
 
     # NOTE: merge_states materializes neutral carries by calling uda.init(g)
@@ -766,6 +882,84 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
                 (ae.out_name, tuple(_expr_columns(ae.args)))
             )
 
+    # Native-fold seam: dense-domain fragments whose aggregates all have
+    # associative scalar carries hand the scatter passes to the CPU
+    # multi-core kernel; XLA keeps the elementwise pre-stage + slot-id
+    # packing (engine._fold_agg_state_native).
+    native_fold = None
+    if dense_domains is not None and all(
+        ae.uda_name in ("count", "sum", "mean", "min", "max")
+        and len(arg_bound) == 1
+        for ae, _uda, arg_bound, _casts in aggs_bound
+    ):
+        def fold_inputs(cols, valid):
+            valid = _range_valid(cols, valid)
+            cols2, valid2 = apply_pre(cols, valid)
+            gids, oob = dense_slot_ids(cols2, valid2)
+            args = []
+            for ae, _uda, arg_bound, casts in aggs_bound:
+                if ae.uda_name == "count":
+                    args.append(None)  # count reads no value column
+                    continue
+                b, (have, want) = arg_bound[0], casts[0]
+                a = apply_cast(b.fn(cols2), have, want)
+                args.append(jnp.broadcast_to(a, valid2.shape))
+            return gids, tuple(args), oob
+
+        # Raw mode: when the pre-stage is a pure column select and every
+        # key/arg is a direct table column, the kernel reads the STAGED
+        # PLANES themselves — zero device work in the fold path.
+        raw = None
+        sel = _pure_select_map(pre_ops)
+        if sel is not None:
+            def _src(c):
+                return c if not sel else sel.get(c)
+
+            key_specs, key_srcs = [], []
+            for (c, pi), dom, off, st in zip(
+                key_plane_index, dense_domains, dense_offsets, dense_strides
+            ):
+                dt = rel1.col_type(c)
+                src = _src(c)
+                if src is None or pi != 0 or len(device_dtypes(dt)) != 1:
+                    key_srcs = None
+                    break
+                if dt == DataType.STRING:
+                    kind = 0
+                elif dt == DataType.BOOLEAN:
+                    kind = 1
+                else:
+                    kind = 2
+                key_specs.append((kind, dom, off, st))
+                key_srcs.append(src)
+            arg_srcs = []
+            if key_srcs is not None:
+                for ae, _uda, _b, _c in aggs_bound:
+                    if ae.uda_name == "count":
+                        arg_srcs.append(None)
+                        continue
+                    e = ae.args[0] if ae.args else None
+                    src = _src(e.name) if isinstance(e, ColumnRef) else None
+                    if src is None or len(device_dtypes(rel1.col_type(e.name))) != 1:
+                        arg_srcs = None
+                        break
+                    arg_srcs.append(src)
+            if key_srcs is not None and arg_srcs is not None:
+                raw = {
+                    "key_cols": tuple(key_srcs),
+                    "key_specs": tuple(key_specs),
+                    "arg_cols": tuple(arg_srcs),
+                }
+
+        native_fold = {
+            "inputs_jit": jax.jit(fold_inputs),
+            "plan": tuple(
+                (ae.out_name, ae.uda_name, uda.init)
+                for ae, uda, _b, _c in aggs_bound
+            ),
+            "raw": raw,
+        }
+
     return CompiledFragment(
         relation=out_rel,
         out_meta=final_meta,
@@ -777,12 +971,14 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
         limit=limit,
         window_state=window_state,
         merge_states=merge_states,
+        native_fold=native_fold,
         apply_rows=apply_pre,
         key_plane_index=tuple(key_plane_index),
         group_relation=rel1,
         string_carry_sources=tuple(string_carry_sources),
         dense_domains=dense_domains or (),
         dense_offsets=dense_offsets or (),
+        dense_strides=dense_strides or (),
     )
 
 

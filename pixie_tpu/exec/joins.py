@@ -565,8 +565,11 @@ def _dense_agg_build(engine, right_stream, op, l_dt, left_dicts, lc, rc):
     if (
         not frag_probe.is_agg
         or len(frag_probe.dense_domains) != 1
+        or frag_probe.dense_strides not in ((), (1,))
         or frag_probe.limit is not None
     ):
+        # (strided domains step-index their slots; the LookupJoinOp
+        # gather arithmetic assumes stride 1.)
         return None
     # The dense slot space must be the probe key's own code space.
     agg_i = next(

@@ -325,6 +325,7 @@ class StreamingQuery:
                 state=jax.tree_util.tree_map(np.asarray, self._state),
                 dense_domains=frag.dense_domains,
                 dense_offsets=frag.dense_offsets,
+                dense_strides=frag.dense_strides,
             )
             self.emit(StreamUpdate(
                 table=None, batch=payload, seq=self.seq, mode="state",

@@ -125,12 +125,12 @@ def _long_string(body: bytes) -> str:
     return body[4:4 + min(n, len(body) - 4)].decode("utf-8", "replace")
 
 
-_OVERSIZED = object()  # framer dropped the payload
 _COMPRESSED = object()  # flags & 0x01: body is lz4/snappy, not parsed
+# (oversized frames keep the framer's body=None convention)
 
 
 def _req_summary(opcode: int, body) -> str:
-    if body is _OVERSIZED or body is None:
+    if body is None:
         return "<oversized>"
     if body is _COMPRESSED:
         return "<compressed>"
@@ -152,7 +152,7 @@ def _req_summary(opcode: int, body) -> str:
 
 
 def _resp_summary(opcode: int, body) -> str:
-    if body is _OVERSIZED or body is None:
+    if body is None:
         return "<oversized>"
     if body is _COMPRESSED:
         return "<compressed>"

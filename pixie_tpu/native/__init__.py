@@ -13,6 +13,8 @@ import os
 import subprocess
 import threading
 
+import numpy as np
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LOCK = threading.Lock()
 _LIBS: dict[str, object] = {}
@@ -23,7 +25,10 @@ def _build(name: str) -> str:
     out = os.path.join(_DIR, f"lib{name}.so")
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", out, src]
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", out, src,
+    ]
     subprocess.run(cmd, check=True, capture_output=True)
     return out
 
@@ -43,3 +48,136 @@ def load(name: str):
             lib = None
         _LIBS[name] = lib
         return lib
+
+
+# -- seg_fold: multi-core segmented fold (engine CPU-backend scatters) -------
+
+#: numpy dtype -> seg_fold value-type code (0 none, 1 i64, 2 f64, 3 f32,
+#: 4 u8/bool, 5 i32).
+_VAL_TY = {"int64": 1, "float64": 2, "float32": 3, "bool": 4, "uint8": 4,
+           "int32": 5}
+#: numpy dtype -> output-table type code (tables are i64/f64/f32 only).
+_OUT_TY = {"int64": 1, "float64": 2, "float32": 3}
+
+#: (op, out_ty, val_ty) combos implemented by the kernel (fold_one).
+_SUPPORTED = frozenset(
+    [(0, 1, 0), (0, 2, 0)]  # count
+    + [(1, 1, 1), (1, 1, 4), (1, 1, 5), (1, 2, 2), (1, 2, 3), (1, 2, 1),
+       (1, 3, 3)]  # sum
+    + [(op, ot, vt) for op in (2, 3)
+       for ot, vt in ((1, 1), (2, 2), (2, 3), (3, 3))]  # min/max
+)
+
+
+def np_view(a) -> np.ndarray:
+    """Zero-copy numpy view of a CPU jax array.
+
+    Both ``np.asarray`` and jax's dlpack export COPY the buffer
+    (~9ms per 16MB plane on this class of host); the raw buffer pointer
+    shares it. SAFETY: the view aliases the jax buffer — callers must
+    keep the source array referenced for the view's (short) lifetime and
+    only READ through it, which the fold kernel guarantees.
+    """
+    if isinstance(a, np.ndarray):
+        return a
+    try:
+        # jax dispatch is async: fence before aliasing the buffer, or the
+        # kernel races XLA still writing it (garbage slot ids -> OOB).
+        a.block_until_ready()
+        ptr = a.unsafe_buffer_pointer()
+        dt = np.dtype(str(a.dtype))
+        buf = (ctypes.c_char * (a.size * dt.itemsize)).from_address(ptr)
+        return np.frombuffer(buf, dtype=dt).reshape(a.shape)
+    except Exception:
+        return np.ascontiguousarray(np.asarray(a))
+
+
+def seg_fold_threads() -> int:
+    import os as _os
+
+    from ..config import get_flag
+
+    t = get_flag("cpu_fold_threads")
+    return t if t > 0 else min(_os.cpu_count() or 1, 16)
+
+
+def seg_fold_call(gids, g: int, specs, vals, outs) -> bool:
+    """Accumulate one window into the output tables.
+
+    ``specs`` is [(op, out_dtype, arg_index|None)] per output; ``vals``
+    the per-output contiguous value arrays (None for count); ``outs``
+    the (g+1)-row tables accumulated in place. Returns False when the
+    kernel is unavailable or a dtype combo is unsupported (caller falls
+    back to the XLA fold).
+    """
+    lib = load("seg_fold")
+    if lib is None:
+        return False
+    n_out = len(specs)
+    ops = (ctypes.c_uint8 * n_out)()
+    vts = (ctypes.c_uint8 * n_out)()
+    ots = (ctypes.c_uint8 * n_out)()
+    vptrs = (ctypes.c_void_p * n_out)()
+    optrs = (ctypes.c_void_p * n_out)()
+    for k, ((op, dt, _a), v, o) in enumerate(zip(specs, vals, outs)):
+        ot = _OUT_TY.get(str(np.dtype(dt)))
+        vt = 0 if v is None else _VAL_TY.get(str(v.dtype))
+        if ot is None or vt is None or (op, ot, vt) not in _SUPPORTED:
+            return False
+        ops[k], vts[k], ots[k] = op, vt, ot
+        vptrs[k] = 0 if v is None else v.ctypes.data
+        optrs[k] = o.ctypes.data
+    lib.seg_fold(
+        gids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_longlong(len(gids)), ctypes.c_longlong(g),
+        ctypes.c_int(n_out), ops, vts, ots, vptrs, optrs,
+        ctypes.c_int(seg_fold_threads()),
+    )
+    return True
+
+
+def seg_fold_raw_call(key_planes, key_specs, lo: int, hi: int, g: int,
+                      specs, vals, outs):
+    """Raw-plane fold: slot ids computed in-kernel from the staged key
+    planes. ``key_specs`` is [(kind, dom, off, stride)] per key (kind 0
+    i32 dict codes, 1 bool, 2 strided i64). Returns the out-of-domain
+    row count, or None when unsupported (caller falls back)."""
+    lib = load("seg_fold")
+    if lib is None:
+        return None
+    nk = len(key_specs)
+    kptrs = (ctypes.c_void_p * nk)()
+    kinds = (ctypes.c_uint8 * nk)()
+    doms = (ctypes.c_longlong * nk)()
+    offs = (ctypes.c_longlong * nk)()
+    strides = (ctypes.c_longlong * nk)()
+    for k, (plane, (kind, dom, off, stride)) in enumerate(
+        zip(key_planes, key_specs)
+    ):
+        want = {0: "int32", 1: "bool", 2: "int64"}[kind]
+        if str(plane.dtype) != want and not (kind == 1 and str(plane.dtype) == "uint8"):
+            return None
+        kptrs[k] = plane.ctypes.data
+        kinds[k], doms[k], offs[k], strides[k] = kind, dom, off, stride
+    n_out = len(specs)
+    ops = (ctypes.c_uint8 * n_out)()
+    vts = (ctypes.c_uint8 * n_out)()
+    ots = (ctypes.c_uint8 * n_out)()
+    vptrs = (ctypes.c_void_p * n_out)()
+    optrs = (ctypes.c_void_p * n_out)()
+    for k, ((op, dt, _a), v, o) in enumerate(zip(specs, vals, outs)):
+        ot = _OUT_TY.get(str(np.dtype(dt)))
+        vt = 0 if v is None else _VAL_TY.get(str(v.dtype))
+        if ot is None or vt is None or (op, ot, vt) not in _SUPPORTED:
+            return None
+        ops[k], vts[k], ots[k] = op, vt, ot
+        vptrs[k] = 0 if v is None else v.ctypes.data
+        optrs[k] = o.ctypes.data
+    oob = ctypes.c_longlong(0)
+    lib.seg_fold_raw(
+        kptrs, kinds, doms, offs, strides, ctypes.c_int(nk),
+        ctypes.c_longlong(lo), ctypes.c_longlong(hi), ctypes.c_longlong(g),
+        ctypes.c_int(n_out), ops, vts, ots, vptrs, optrs,
+        ctypes.byref(oob), ctypes.c_int(seg_fold_threads()),
+    )
+    return int(oob.value)

@@ -1,0 +1,412 @@
+// Multi-core segmented fold: the CPU-backend scatter accelerator.
+//
+// Reference parity: Carnot's blocking aggregate hot loop
+// (src/carnot/exec/agg_node.cc / blocking_agg_benchmark.cc) is C++ over
+// a hash table; here the dense-domain fragment already reduced group
+// keys to int32 slot ids on the XLA side (elementwise, cheap), and this
+// kernel does the bandwidth-bound scatter passes with one local table
+// per thread + an associative reduction — XLA:CPU executes scatters
+// single-threaded, which caps bincount-class aggregations at one core.
+//
+// C ABI (ctypes):
+//   seg_fold(gids, n, g, n_out, ops, val_ty, out_ty, vals, outs, threads)
+// - gids: int32[n], values in [0, g]; slot g is the trash slot for
+//   masked rows (still accumulated, dropped by the caller).
+// - per output k: ops[k] in {0 count, 1 sum, 2 min, 3 max};
+//   val_ty[k] in {0 none, 1 i64, 2 f64, 3 f32, 4 u8/bool, 5 i32};
+//   out_ty[k] in {1 i64, 2 f64, 3 f32};
+//   vals[k] points at the value column (nullptr for count);
+//   outs[k] points at a (g+1)-entry table PRE-INITIALIZED to the op's
+//   neutral value (the caller hands the UDA's init carry) — results
+//   accumulate in place so multiple windows chain without merging.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { kCount = 0, kSum = 1, kMin = 2, kMax = 3 };
+enum Ty : uint8_t { kNone = 0, kI64 = 1, kF64 = 2, kF32 = 3, kU8 = 4, kI32 = 5 };
+
+template <typename OutT>
+void count_rows(const int32_t* g, int64_t lo, int64_t hi, OutT* t) {
+  for (int64_t i = lo; i < hi; ++i) t[g[i]] += OutT(1);
+}
+
+template <typename OutT, typename ValT>
+void sum_rows(const int32_t* g, int64_t lo, int64_t hi, const void* v,
+              OutT* t) {
+  const ValT* vv = static_cast<const ValT*>(v);
+  for (int64_t i = lo; i < hi; ++i) t[g[i]] += static_cast<OutT>(vv[i]);
+}
+
+// Float min/max must PROPAGATE NaN (jnp.minimum semantics — the XLA
+// fold this kernel replaces); std::min would discard it and the same
+// query would answer differently per backend. The `x != x` test sets
+// NaN; an accumulated NaN then survives because no comparison beats it.
+template <typename OutT, typename ValT>
+void min_rows(const int32_t* g, int64_t lo, int64_t hi, const void* v,
+              OutT* t) {
+  const ValT* vv = static_cast<const ValT*>(v);
+  for (int64_t i = lo; i < hi; ++i) {
+    OutT x = static_cast<OutT>(vv[i]);
+    if (x < t[g[i]] || x != x) t[g[i]] = x;
+  }
+}
+
+template <typename OutT, typename ValT>
+void max_rows(const int32_t* g, int64_t lo, int64_t hi, const void* v,
+              OutT* t) {
+  const ValT* vv = static_cast<const ValT*>(v);
+  for (int64_t i = lo; i < hi; ++i) {
+    OutT x = static_cast<OutT>(vv[i]);
+    if (x > t[g[i]] || x != x) t[g[i]] = x;
+  }
+}
+
+// One output's fold over [lo, hi) into table t (type-erased).
+void fold_one(uint8_t op, uint8_t vt, uint8_t ot, const int32_t* gids,
+              int64_t lo, int64_t hi, const void* val, void* out) {
+  switch (op) {
+    case kCount:
+      if (ot == kI64) count_rows(gids, lo, hi, static_cast<int64_t*>(out));
+      else if (ot == kF64) count_rows(gids, lo, hi, static_cast<double*>(out));
+      return;
+    case kSum:
+      if (ot == kI64) {
+        if (vt == kI64) sum_rows<int64_t, int64_t>(gids, lo, hi, val, static_cast<int64_t*>(out));
+        else if (vt == kU8) sum_rows<int64_t, uint8_t>(gids, lo, hi, val, static_cast<int64_t*>(out));
+        else if (vt == kI32) sum_rows<int64_t, int32_t>(gids, lo, hi, val, static_cast<int64_t*>(out));
+      } else if (ot == kF64) {
+        if (vt == kF64) sum_rows<double, double>(gids, lo, hi, val, static_cast<double*>(out));
+        else if (vt == kF32) sum_rows<double, float>(gids, lo, hi, val, static_cast<double*>(out));
+        else if (vt == kI64) sum_rows<double, int64_t>(gids, lo, hi, val, static_cast<double*>(out));
+      } else if (ot == kF32 && vt == kF32) {
+        sum_rows<float, float>(gids, lo, hi, val, static_cast<float*>(out));
+      }
+      return;
+    case kMin:
+      if (ot == kI64 && vt == kI64) min_rows<int64_t, int64_t>(gids, lo, hi, val, static_cast<int64_t*>(out));
+      else if (ot == kF64 && vt == kF64) min_rows<double, double>(gids, lo, hi, val, static_cast<double*>(out));
+      else if (ot == kF64 && vt == kF32) min_rows<double, float>(gids, lo, hi, val, static_cast<double*>(out));
+      else if (ot == kF32 && vt == kF32) min_rows<float, float>(gids, lo, hi, val, static_cast<float*>(out));
+      return;
+    case kMax:
+      if (ot == kI64 && vt == kI64) max_rows<int64_t, int64_t>(gids, lo, hi, val, static_cast<int64_t*>(out));
+      else if (ot == kF64 && vt == kF64) max_rows<double, double>(gids, lo, hi, val, static_cast<double*>(out));
+      else if (ot == kF64 && vt == kF32) max_rows<double, float>(gids, lo, hi, val, static_cast<double*>(out));
+      else if (ot == kF32 && vt == kF32) max_rows<float, float>(gids, lo, hi, val, static_cast<float*>(out));
+      return;
+  }
+}
+
+size_t ty_size(uint8_t ot) { return ot == kF32 ? 4 : 8; }
+
+// Merge a thread-local table into the shared output with the op's
+// associative combine.
+void reduce_one(uint8_t op, uint8_t ot, int64_t rows, const void* local,
+                void* out) {
+  if (op == kSum || op == kCount) {
+    if (ot == kI64) {
+      auto* o = static_cast<int64_t*>(out);
+      auto* l = static_cast<const int64_t*>(local);
+      for (int64_t i = 0; i < rows; ++i) o[i] += l[i];
+    } else if (ot == kF64) {
+      auto* o = static_cast<double*>(out);
+      auto* l = static_cast<const double*>(local);
+      for (int64_t i = 0; i < rows; ++i) o[i] += l[i];
+    } else {
+      auto* o = static_cast<float*>(out);
+      auto* l = static_cast<const float*>(local);
+      for (int64_t i = 0; i < rows; ++i) o[i] += l[i];
+    }
+  } else if (op == kMin) {
+    if (ot == kI64) {
+      auto* o = static_cast<int64_t*>(out);
+      auto* l = static_cast<const int64_t*>(local);
+      for (int64_t i = 0; i < rows; ++i) o[i] = std::min(o[i], l[i]);
+    } else if (ot == kF64) {
+      auto* o = static_cast<double*>(out);
+      auto* l = static_cast<const double*>(local);
+      for (int64_t i = 0; i < rows; ++i)
+        if (l[i] < o[i] || l[i] != l[i]) o[i] = l[i];  // NaN-propagating
+    } else {
+      auto* o = static_cast<float*>(out);
+      auto* l = static_cast<const float*>(local);
+      for (int64_t i = 0; i < rows; ++i)
+        if (l[i] < o[i] || l[i] != l[i]) o[i] = l[i];
+    }
+  } else {
+    if (ot == kI64) {
+      auto* o = static_cast<int64_t*>(out);
+      auto* l = static_cast<const int64_t*>(local);
+      for (int64_t i = 0; i < rows; ++i) o[i] = std::max(o[i], l[i]);
+    } else if (ot == kF64) {
+      auto* o = static_cast<double*>(out);
+      auto* l = static_cast<const double*>(local);
+      for (int64_t i = 0; i < rows; ++i)
+        if (l[i] > o[i] || l[i] != l[i]) o[i] = l[i];
+    } else {
+      auto* o = static_cast<float*>(out);
+      auto* l = static_cast<const float*>(local);
+      for (int64_t i = 0; i < rows; ++i)
+        if (l[i] > o[i] || l[i] != l[i]) o[i] = l[i];
+    }
+  }
+}
+
+// The op's neutral element for a fresh thread-local table comes from the
+// caller's pre-initialized out table? No — outs accumulate across
+// windows, so locals need their own neutral. Sum/count: 0. Min/max: copy
+// the neutral the caller seeded is NOT recoverable after window 1, so
+// min/max locals seed from extreme limits instead.
+void seed_local(uint8_t op, uint8_t ot, int64_t rows, void* local) {
+  if (op == kSum || op == kCount) {
+    std::memset(local, 0, rows * ty_size(ot));
+    return;
+  }
+  if (ot == kI64) {
+    auto* l = static_cast<int64_t*>(local);
+    int64_t v = (op == kMin) ? INT64_MAX : INT64_MIN;
+    std::fill(l, l + rows, v);
+  } else if (ot == kF64) {
+    auto* l = static_cast<double*>(local);
+    double v = (op == kMin) ? 1.7976931348623157e308 : -1.7976931348623157e308;
+    std::fill(l, l + rows, v);
+  } else {
+    auto* l = static_cast<float*>(local);
+    float v = (op == kMin) ? 3.4028235e38f : -3.4028235e38f;
+    std::fill(l, l + rows, v);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void seg_fold(const int32_t* gids, long long n, long long g, int n_out,
+              const uint8_t* ops, const uint8_t* val_ty,
+              const uint8_t* out_ty, const void** vals, void** outs,
+              int n_threads) {
+  const int64_t rows = g + 1;  // incl. trash slot
+  if (n_threads < 1) n_threads = 1;
+  // Local-table memory guard: big domains fall back to fewer threads.
+  while (n_threads > 1 &&
+         int64_t(n_threads - 1) * n_out * rows * 8 > (int64_t(512) << 20)) {
+    n_threads /= 2;
+  }
+  if (n_threads == 1 || n < (int64_t(1) << 16)) {
+    for (int k = 0; k < n_out; ++k) {
+      fold_one(ops[k], val_ty[k], out_ty[k], gids, 0, n, vals[k], outs[k]);
+    }
+    return;
+  }
+  // Thread 0 folds into the shared outs directly (they carry prior
+  // windows' partials); threads 1..T-1 fold into fresh locals.
+  std::vector<std::vector<uint8_t>> locals;
+  locals.reserve(size_t(n_threads - 1) * n_out);
+  for (int t = 1; t < n_threads; ++t) {
+    for (int k = 0; k < n_out; ++k) {
+      locals.emplace_back(rows * ty_size(out_ty[k]));
+      seed_local(ops[k], out_ty[k], rows, locals.back().data());
+    }
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = int64_t(t) * chunk;
+    int64_t hi = std::min<int64_t>(lo + chunk, n);
+    if (lo >= hi) break;
+    threads.emplace_back([&, t, lo, hi]() {
+      for (int k = 0; k < n_out; ++k) {
+        void* out = (t == 0)
+                        ? outs[k]
+                        : static_cast<void*>(locals[size_t(t - 1) * n_out + k].data());
+        fold_one(ops[k], val_ty[k], out_ty[k], gids, lo, hi, vals[k], out);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  for (int t = 1; t < n_threads; ++t) {
+    for (int k = 0; k < n_out; ++k) {
+      reduce_one(ops[k], out_ty[k], rows,
+                 locals[size_t(t - 1) * n_out + k].data(), outs[k]);
+    }
+  }
+}
+
+// Raw-plane fold: computes slot ids from the staged key planes in the
+// same pass (dict codes / bool / strided int keys), so the common dense
+// group-by needs NO device program at all. Rows outside [lo, hi) are
+// skipped; out-of-domain integer keys (appends racing the compile-time
+// stats) go to the trash slot and count into *oob_out so the engine's
+// rebucket retry fires.
+//
+// key_kind: 0 = int32 dictionary codes (NULL -1 -> dom-1, the string
+// sub-slot encoding); 1 = bool/u8; 2 = int64 with offset/stride.
+
+void seg_fold_raw(const void** keys, const uint8_t* key_kind,
+                  const long long* key_dom, const long long* key_off,
+                  const long long* key_stride, int n_keys, long long lo,
+                  long long hi, long long g, int n_out, const uint8_t* ops,
+                  const uint8_t* val_ty, const uint8_t* out_ty,
+                  const void** vals, void** outs, long long* oob_out,
+                  int n_threads) {
+  const int64_t rows = g + 1;
+  const int64_t n = hi - lo;
+  if (n <= 0) {
+    *oob_out = 0;
+    return;
+  }
+  if (n_threads < 1) n_threads = 1;
+  while (n_threads > 1 &&
+         int64_t(n_threads - 1) * n_out * rows * 8 > (int64_t(512) << 20)) {
+    n_threads /= 2;
+  }
+  if (n < (int64_t(1) << 16)) n_threads = 1;
+  std::vector<std::vector<uint8_t>> locals;
+  locals.reserve(size_t(n_threads > 1 ? n_threads - 1 : 0) * n_out);
+  for (int t = 1; t < n_threads; ++t) {
+    for (int k = 0; k < n_out; ++k) {
+      locals.emplace_back(rows * ty_size(out_ty[k]));
+      seed_local(ops[k], out_ty[k], rows, locals.back().data());
+    }
+  }
+  std::vector<int64_t> oobs(n_threads, 0);
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  // Monomorphic fused loops for the dominant shapes (single dict-code
+  // key): no gid scratch, no dispatch — one pass at memory speed. These
+  // matter most on low-core hosts where thread parallelism can't hide
+  // the extra scratch traffic of the generic two-pass form.
+  const bool k1_dict = (n_keys == 1 && key_kind[0] == 0);
+  auto tag_of = [&](int k) {
+    return (uint32_t(ops[k]) << 8) | (uint32_t(out_ty[k]) << 4) |
+           uint32_t(val_ty[k]);
+  };
+  const uint32_t kSumI64 = (1u << 8) | (1u << 4) | 1u;
+  const uint32_t kSumF64fromI64 = (1u << 8) | (2u << 4) | 1u;
+  const uint32_t kCountI64 = (0u << 8) | (1u << 4) | 0u;
+  auto run_fused = [&](int t, int64_t clo, int64_t chi) -> bool {
+    if (!k1_dict) return false;
+    const int32_t* kc = static_cast<const int32_t*>(keys[0]);
+    const int64_t dom = key_dom[0];
+    auto out_at = [&](int k) {
+      return (t == 0 || n_threads == 1)
+                 ? outs[k]
+                 : static_cast<void*>(locals[size_t(t - 1) * n_out + k].data());
+    };
+    if (n_out == 2 && tag_of(0) == kSumI64 && tag_of(1) == kCountI64) {
+      const int64_t* v = static_cast<const int64_t*>(vals[0]);
+      int64_t* sum_t = static_cast<int64_t*>(out_at(0));
+      int64_t* cnt_t = static_cast<int64_t*>(out_at(1));
+      for (int64_t i = clo; i < chi; ++i) {
+        int32_t c = kc[i];
+        int64_t s = (c < 0 || c >= dom) ? dom - 1 : c;
+        sum_t[s] += v[i];
+        cnt_t[s] += 1;
+      }
+      return true;
+    }
+    if (n_out == 2 && tag_of(0) == kSumF64fromI64 && tag_of(1) == kCountI64) {
+      const int64_t* v = static_cast<const int64_t*>(vals[0]);
+      double* sum_t = static_cast<double*>(out_at(0));
+      int64_t* cnt_t = static_cast<int64_t*>(out_at(1));
+      for (int64_t i = clo; i < chi; ++i) {
+        int32_t c = kc[i];
+        int64_t s = (c < 0 || c >= dom) ? dom - 1 : c;
+        sum_t[s] += static_cast<double>(v[i]);
+        cnt_t[s] += 1;
+      }
+      return true;
+    }
+    if (n_out == 1 && tag_of(0) == kCountI64) {
+      int64_t* cnt_t = static_cast<int64_t*>(out_at(0));
+      for (int64_t i = clo; i < chi; ++i) {
+        int32_t c = kc[i];
+        cnt_t[(c < 0 || c >= dom) ? dom - 1 : c] += 1;
+      }
+      return true;
+    }
+    return false;
+  };
+  auto run = [&](int t, int64_t clo, int64_t chi) {
+    if (run_fused(t, clo, chi)) return;
+    // Two passes over a per-thread chunk: slot ids into an L2-resident
+    // scratch, then one tight monomorphic loop per output (fold_one).
+    // A fused per-row dispatch was measured SLOWER — the compiler
+    // optimizes the typed loops far better than a per-row switch, and
+    // the chunk-sized scratch re-reads stay in cache.
+    std::vector<int32_t> gids(chi - clo);
+    int64_t bad = 0;
+    for (int64_t i = clo; i < chi; ++i) {
+      int64_t slot = 0;
+      bool oob_row = false;
+      for (int k = 0; k < n_keys; ++k) {
+        int64_t dom = key_dom[k];
+        int64_t code;
+        if (key_kind[k] == 0) {
+          int32_t c = static_cast<const int32_t*>(keys[k])[i];
+          code = (c < 0 || c >= dom) ? dom - 1 : c;
+        } else if (key_kind[k] == 1) {
+          code = static_cast<const uint8_t*>(keys[k])[i] ? 1 : 0;
+        } else {
+          int64_t raw = static_cast<const int64_t*>(keys[k])[i] - key_off[k];
+          int64_t st = key_stride[k];
+          if (raw < 0 || raw >= dom * st || (st > 1 && raw % st != 0)) {
+            oob_row = true;
+            code = 0;
+          } else {
+            code = st > 1 ? raw / st : raw;
+          }
+        }
+        slot = slot * dom + code;
+      }
+      if (oob_row) {
+        ++bad;
+        slot = g;
+      }
+      gids[i - clo] = static_cast<int32_t>(slot);
+    }
+    oobs[t] = bad;
+    for (int k = 0; k < n_out; ++k) {
+      void* out = (t == 0 || n_threads == 1)
+                      ? outs[k]
+                      : static_cast<void*>(
+                            locals[size_t(t - 1) * n_out + k].data());
+      const void* val = vals[k];
+      if (val != nullptr) {
+        const char* base = static_cast<const char*>(val);
+        size_t vsz = val_ty[k] == 3 ? 4 : (val_ty[k] == 5 ? 4 : (val_ty[k] == 4 ? 1 : 8));
+        val = base + size_t(clo) * vsz;
+      }
+      fold_one(ops[k], val_ty[k], out_ty[k], gids.data(), 0, chi - clo, val,
+               out);
+    }
+  };
+  if (n_threads == 1) {
+    run(0, lo, hi);
+  } else {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < n_threads; ++t) {
+      int64_t clo = lo + int64_t(t) * chunk;
+      int64_t chi = std::min<int64_t>(clo + chunk, hi);
+      if (clo >= chi) break;
+      threads.emplace_back(run, t, clo, chi);
+    }
+    for (auto& th : threads) th.join();
+    for (int t = 1; t < n_threads; ++t) {
+      for (int k = 0; k < n_out; ++k) {
+        reduce_one(ops[k], out_ty[k], rows,
+                   locals[size_t(t - 1) * n_out + k].data(), outs[k]);
+      }
+    }
+  }
+  int64_t total = 0;
+  for (int64_t b : oobs) total += b;
+  *oob_out = total;
+}
+
+}  // extern "C"

@@ -110,6 +110,9 @@ class DistributedEngine(Engine):
     # Fused lookup joins need replicated side-table shardings through the
     # shard_map specs — not wired yet; joins materialize on host here.
     fused_lookup_join = False
+    # Folding happens INSIDE shard_map over the mesh; the single-device
+    # CPU thread-parallel fold must not bypass the distributed steps.
+    cpu_parallel_fold = False
 
     def __init__(self, registry=None, window_rows: int | None = None,
                  mesh: Mesh | None = None, n_agents: int | None = None,

@@ -22,7 +22,7 @@ import struct
 
 import numpy as np
 
-WIRE_VERSION = 3  # v3: AggStatePayload.dense_offsets (v2: .dense_domains)
+WIRE_VERSION = 4  # v4: AggStatePayload.dense_strides (v3: .dense_offsets)
 
 _U32 = struct.Struct("<I")
 _I64 = struct.Struct("<q")

@@ -129,13 +129,20 @@ class TestBrokerAuth:
         tracker = AgentTracker(bus)
         broker = QueryBroker(bus, tracker, secret=secret)
         pem = PEMAgent(bus, agent_id="pem-0")
+        pem.start()
         pem.engine.append_data("t", {
             "time_": np.arange(100, dtype=np.int64),
             "v": np.arange(100, dtype=np.int64) % 5,
         })
-        pem.start()
+        # Re-register post-ingest and wait for the tracker to see the
+        # schema (the sibling cluster fixtures' sequencing) — serving
+        # before then races query planning against registration.
+        pem._register()
         kelvin = KelvinAgent(bus, agent_id="kelvin-0")
         kelvin.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and len(tracker.schemas()) < 1:
+            time.sleep(0.01)
         broker.serve()
         return bus, broker
 
