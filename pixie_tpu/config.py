@@ -122,6 +122,16 @@ define_flag("device_join_min_rows", 1 << 15,
 define_flag("agent_heartbeat_s", 5.0, "Agent heartbeat period (seconds).")
 define_flag("agent_expiry_s", 60.0, "Tracker agent expiry after silence.")
 define_flag(
+    "pallas_dense_fold", "auto",
+    "Pallas MXU dense-fold kernel routing: 'auto' (TPU backend only), "
+    "'interpret' (any backend, interpreter mode — tests), 'off'.",
+)
+define_flag(
+    "pallas_tdigest", "auto",
+    "Pallas t-digest histogram kernel routing: 'auto' (TPU backend, "
+    "small slot counts), 'interpret' (tests), 'off'.",
+)
+define_flag(
     "cpu_fold_threads", 0,
     "CPU-backend parallel window fold: thread count (0 = auto from cores, "
     "1 = disable and fold sequentially).",

@@ -170,6 +170,8 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry,
             ),
             id(registry),
             get_flag("groupby_impl"),
+            get_flag("pallas_dense_fold"),
+            get_flag("pallas_tdigest"),
             get_flag("dense_domain_limit") if allow_dense else -1,
             get_flag("int_dense_domain_limit") if allow_dense else -1,
             _stats_cache_key(ops, col_stats),
@@ -670,6 +672,85 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
         dense_group_ids_hash if impl == "hash" else dense_group_ids
     )
 
+    # Pallas dense fold (TPU): count/sum/mean/max over FLOAT64 planes
+    # route through the hand-scheduled MXU kernel — one-hot contractions
+    # with VMEM-resident [G] accumulators replace per-UDA HBM scatters
+    # (ops/pallas_groupby.py). 'auto' engages on the TPU backend;
+    # 'interpret' runs the kernel in interpreter mode on any backend
+    # (the equivalence tests); 'off' disables.
+    _pallas_mode = get_flag("pallas_dense_fold")
+    pallas_fold = (
+        dense_domains is not None
+        and _pallas_mode in ("auto", "interpret")
+        and (_pallas_mode == "interpret" or jax.default_backend() == "tpu")
+        and g <= 2048  # [chunk, G] one-hot must fit VMEM
+        and all(
+            ae.uda_name == "count"
+            or (
+                ae.uda_name in ("sum", "mean", "max")
+                and len(arg_bound) == 1
+                and casts[0][1] == DataType.FLOAT64
+            )
+            for ae, _uda, arg_bound, casts in aggs_bound
+        )
+    )
+
+    def _pallas_window_carries(gids, cols, valid):
+        """Per-agg carries via dense_group_fold; returns (carries, valid_w)."""
+        from ..ops.pallas_groupby import dense_group_fold
+
+        interpret = _pallas_mode == "interpret"
+        g_pad = -(-g // 128) * 128
+        n = valid.shape[0]
+        chunk = min(2048, n, max(128, (1 << 20) // g_pad))
+        while n % chunk:
+            chunk //= 2
+        # Trash rows must match NO kernel column, incl. the pad range.
+        gids_p = jnp.where(gids >= g, jnp.int32(g_pad), gids)
+        # One kernel pass per distinct ARG EXPRESSION (sum+mean+max over
+        # the same column share a single sweep — the kernel returns all
+        # three statistics anyway).
+        folds: dict = {}
+
+        def fold_for(a):
+            cnt, s, mx = dense_group_fold(
+                gids_p, a, g_pad, chunk=chunk, interpret=interpret
+            )
+            return cnt[:g], s[:g], mx[:g]
+
+        carries_w = {}
+        cnt_shared = None
+        for ae, uda, arg_bound, casts in aggs_bound:
+            if ae.uda_name == "count":
+                continue
+            fkey = (_struct_key(ae.args), casts[0])
+            if fkey not in folds:
+                a = apply_cast(arg_bound[0].fn(cols), *casts[0])
+                folds[fkey] = fold_for(jnp.broadcast_to(a, valid.shape))
+            cnt, s, mx = folds[fkey]
+            cnt_shared = cnt
+            init_leaf = uda.init(g)
+            if ae.uda_name == "sum":
+                carries_w[ae.out_name] = s.astype(init_leaf.dtype)
+            elif ae.uda_name == "mean":
+                carries_w[ae.out_name] = (
+                    s.astype(init_leaf[0].dtype),
+                    cnt.astype(init_leaf[1].dtype),
+                )
+            else:  # max: empty slots keep the UDA's neutral fill
+                carries_w[ae.out_name] = jnp.where(
+                    cnt > 0, mx.astype(init_leaf.dtype), init_leaf
+                )
+        if cnt_shared is None:
+            # count-only aggregation: one kernel pass over a zero column.
+            cnt_shared, _s, _m = fold_for(jnp.zeros(n, dtype=jnp.float32))
+        for ae, uda, _b, _c in aggs_bound:
+            if ae.uda_name == "count":
+                carries_w[ae.out_name] = cnt_shared.astype(
+                    uda.init(g).dtype
+                )
+        return carries_w, cnt_shared > 0
+
     def window_state(cols, valid):
         """Fold one window of rows into a fresh [G]-slot group state.
 
@@ -685,6 +766,14 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
             # domains overflow only when a row's key escapes the
             # compile-time bounds (oob flags it for the rebucket retry).
             n_w = jnp.where(oob, g + 1, 0).astype(jnp.int32)
+            if pallas_fold:
+                carries_w, valid_w = _pallas_window_carries(gids, cols, valid)
+                return {
+                    "keys": (),
+                    "valid": valid_w,
+                    "carries": carries_w,
+                    "overflow": n_w > g,
+                }
         else:
             key_planes = [cols[c][i] for c, i in key_plane_index]
             gids, keys_w, valid_w, n_w = window_group_ids(key_planes, valid, g)

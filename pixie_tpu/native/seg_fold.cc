@@ -21,6 +21,7 @@
 //   accumulate in place so multiple windows chain without merging.
 
 #include <algorithm>
+#include <limits>
 #include <cstdint>
 #include <cstring>
 #include <thread>
@@ -174,11 +175,15 @@ void seed_local(uint8_t op, uint8_t ot, int64_t rows, void* local) {
     std::fill(l, l + rows, v);
   } else if (ot == kF64) {
     auto* l = static_cast<double*>(local);
-    double v = (op == kMin) ? 1.7976931348623157e308 : -1.7976931348623157e308;
+    // ±infinity, not DBL_MAX: an input of +inf must survive a min fold
+    // (inf < DBL_MAX seed is false -> would be lost in the reduction).
+    double v = (op == kMin) ? std::numeric_limits<double>::infinity()
+                            : -std::numeric_limits<double>::infinity();
     std::fill(l, l + rows, v);
   } else {
     auto* l = static_cast<float*>(local);
-    float v = (op == kMin) ? 3.4028235e38f : -3.4028235e38f;
+    float v = (op == kMin) ? std::numeric_limits<float>::infinity()
+                           : -std::numeric_limits<float>::infinity();
     std::fill(l, l + rows, v);
   }
 }

@@ -49,9 +49,13 @@ def _fold_kernel(slot_ref, val_ref, cnt_ref, sum_ref, max_ref, *, g: int):
         slots[:, None]
         == jax.lax.broadcasted_iota(jnp.int32, (slots.shape[0], g), 1)
     ).astype(jnp.float32)
-    # MXU: [1, C] @ [C, G] contractions.
+    # MXU: [1, C] @ [C, G] contractions. NaN values must be zeroed for
+    # the contraction (NaN * 0.0 = NaN would poison EVERY group's sum,
+    # not just the NaN row's own group); the masked max below still sees
+    # the raw values, so a NaN group surfaces as max=NaN and the caller
+    # restores NaN into that group's sum only.
     cnt_ref[:] += jnp.sum(onehot, axis=0)
-    sum_ref[:] += vals @ onehot
+    sum_ref[:] += jnp.where(jnp.isnan(vals), 0.0, vals) @ onehot
     masked = jnp.where(onehot > 0, vals[:, None], _NEG)  # [C, G] VPU
     max_ref[:] = jnp.maximum(max_ref[:], jnp.max(masked, axis=0))
 
@@ -90,4 +94,8 @@ def dense_group_fold(slots, values, g: int, chunk: int = 2048,
         interpret=interpret,
     )(slots.astype(jnp.int32), values.astype(jnp.float32))
     cnt, s, m = out
+    # A NaN row propagated into its group's max (jnp.maximum semantics);
+    # restore it into that group's SUM too — matching the XLA
+    # scatter-add, where the NaN lands only in its own group.
+    s = jnp.where((cnt > 0) & jnp.isnan(m), jnp.nan, s)
     return cnt, s, jnp.where(cnt > 0, m, jnp.nan)

@@ -109,6 +109,10 @@ def batch_to_digest(values, group_ids, mask, num_groups: int, k: int = DEFAULT_K
     cumsum + segment-sum (``_compress(ordered=True)``).
     """
     values = values.astype(jnp.float32)
+    # The sketch is defined over FINITE values on both fold paths: a NaN
+    # would poison the Pallas contraction across all bins, and ±inf has
+    # no meaningful quantile position either way.
+    mask = mask & jnp.isfinite(values)
     gids = jnp.where(mask, group_ids.astype(jnp.int32), num_groups)
     b = _hist_bins(num_groups)
     shift = jnp.uint32(32 - b.bit_length() + 1)  # top log2(B) bits
@@ -117,15 +121,44 @@ def batch_to_digest(values, group_ids, mask, num_groups: int, k: int = DEFAULT_K
     vb = jnp.where(values < 0, ~vb, vb | jnp.uint32(0x80000000))
     bins = (vb >> shift).astype(jnp.int32)
 
-    flat = jnp.where(
-        mask & (gids < num_groups), gids * b + bins, num_groups * b
+    from ..config import get_flag
+
+    n_slots = num_groups * b
+    mode = get_flag("pallas_tdigest")
+    use_pallas = (
+        mode in ("auto", "interpret")
+        and (mode == "interpret" or jax.default_backend() == "tpu")
+        and n_slots <= (1 << 15)  # MXU dense sweep beats scatters here
+        and values.shape[0] >= 128
     )
-    w = jax.ops.segment_sum(
-        mask.astype(jnp.float32), flat, num_segments=num_groups * b + 1
-    )[:-1].reshape(num_groups, b)
-    mw = jax.ops.segment_sum(
-        jnp.where(mask, values, 0.0), flat, num_segments=num_groups * b + 1
-    )[:-1].reshape(num_groups, b)
+    if use_pallas:
+        # Pallas kernel: both histograms in one VMEM-resident sweep
+        # (pallas_tdigest.py); trash rows get an id past the kernel's
+        # padded slot range so they match no tile column.
+        from .pallas_tdigest import hist_fold, _TILE
+
+        n = values.shape[0]
+        chunk = min(2048, n)
+        while n % chunk:
+            chunk //= 2
+        pad = -(-n_slots // _TILE) * _TILE
+        flat = jnp.where(mask & (gids < num_groups), gids * b + bins, pad)
+        w_f, mw_f = hist_fold(
+            flat, jnp.where(mask, values, 0.0), n_slots, chunk=chunk,
+            interpret=(mode == "interpret"),
+        )
+        w = w_f.reshape(num_groups, b)
+        mw = mw_f.reshape(num_groups, b)
+    else:
+        flat = jnp.where(
+            mask & (gids < num_groups), gids * b + bins, n_slots
+        )
+        w = jax.ops.segment_sum(
+            mask.astype(jnp.float32), flat, num_segments=n_slots + 1
+        )[:-1].reshape(num_groups, b)
+        mw = jax.ops.segment_sum(
+            jnp.where(mask, values, 0.0), flat, num_segments=n_slots + 1
+        )[:-1].reshape(num_groups, b)
     means = jnp.where(w > 0, mw / jnp.maximum(w, 1e-30), 0.0)
     return _compress(means, w, k, ordered=True)
 

@@ -125,9 +125,7 @@ px.display(out)
         }
         assert got_keys == keys
 
-    def test_stride_oob_rebuckets_on_offgrid_value(self):
-        """A value off the stride grid (append racing the stats) must
-        flag overflow and rebucket, not silently misbin."""
+    def test_expr_stats_interval_arithmetic(self):
         from pixie_tpu.exec.fragment import _expr_stats
         from pixie_tpu.exec.plan import ColumnRef, FuncCall, Literal
         from pixie_tpu.types.dtypes import DataType
@@ -151,6 +149,55 @@ px.display(out)
             {"t": (0, 100, 10)},
         )
         assert s3 == (0, 300, 30)
+
+    def test_stride_offgrid_value_flags_overflow(self):
+        """A value off the stride grid (possible only when appends race
+        the compile-time stats) must flag overflow for the rebucket
+        retry — in BOTH fold engines — never silently misbin."""
+        import jax.numpy as jnp
+
+        from pixie_tpu.exec.fragment import compile_fragment
+        from pixie_tpu.exec.plan import AggExpr, AggOp, ColumnRef
+        from pixie_tpu.udf.registry import default_registry
+
+        rel = Relation([("w", DataType.INT64), ("v", DataType.INT64)])
+        chain = [AggOp(group_cols=("w",),
+                       aggs=(AggExpr("n", "count", (ColumnRef("v"),)),),
+                       max_groups=64)]
+        frag = compile_fragment(
+            chain, rel, {}, default_registry(),
+            col_stats={"w": (0, 64_000, 1000)},  # stride-1000 domain
+        )
+        assert frag.dense_strides and frag.dense_strides[0] == 1000
+
+        def run(vals_w):
+            n = 128
+            cols = {
+                "w": (jnp.asarray(vals_w),),
+                "v": (jnp.ones(n, dtype=jnp.int64),),
+            }
+            state = frag.update(
+                frag.init_state(), cols, jnp.ones(n, dtype=bool)
+            )
+            return bool(np.asarray(state["overflow"]))
+
+        on_grid = np.repeat(np.arange(16, dtype=np.int64) * 1000, 8)
+        assert run(on_grid) is False
+        off = on_grid.copy()
+        off[5] = 1500  # not a multiple of the stride
+        assert run(off) is True
+
+        # Native raw kernel: same contract via the oob row count.
+        from pixie_tpu.native import seg_fold_raw_call
+
+        specs = [(0, np.dtype(np.int64), None)]
+        outs = [np.zeros(66, np.int64)]
+        oob = seg_fold_raw_call(
+            [off], [(2, 65, 0, 1000)], 0, len(off), 65, specs,
+            [None], outs,
+        )
+        assert oob == 1
+        assert outs[0][:16].sum() == len(off) - 1
 
 
 class TestNativeFoldEdgeCases:

@@ -37,3 +37,98 @@ class TestDenseGroupFold:
         assert float(np.asarray(cnt).sum()) == 0.0
         assert float(np.asarray(s).sum()) == 0.0
         assert np.isnan(np.asarray(mx)).all()
+
+
+class TestHistFold:
+    def test_matches_segment_sum(self):
+        from pixie_tpu.ops.pallas_tdigest import hist_fold
+
+        rng = np.random.default_rng(4)
+        n, n_slots = 8192, 3000  # non-tile-multiple slot count
+        bins = rng.integers(0, n_slots, n).astype(np.int32)
+        bins[::5] = 4096  # trash (>= padded range)
+        vals = (rng.random(n).astype(np.float32) - 0.5) * 50
+        w, mw = hist_fold(bins, vals, n_slots, chunk=1024, interpret=True)
+        live = bins < n_slots
+        ref_w = np.bincount(bins[live], minlength=n_slots)
+        ref_mw = np.bincount(bins[live], weights=vals[live].astype(np.float64),
+                             minlength=n_slots)
+        np.testing.assert_array_equal(np.asarray(w), ref_w)
+        np.testing.assert_allclose(np.asarray(mw), ref_mw, rtol=1e-4,
+                                   atol=1e-3)
+
+
+class TestEnginePallasRouting:
+    """Interpret-mode engine equivalence: the Pallas fold and the XLA
+    fold must produce identical query results (VERDICT r5 item 2)."""
+
+    QUERY = """
+import px
+df = px.DataFrame(table='t')
+out = df.groupby('svc').agg(
+    n=('v', px.count), s=('v', px.sum), mean=('v', px.mean),
+    mx=('v', px.max))
+px.display(out)
+"""
+
+    def _engine(self):
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.types.batch import HostBatch
+        from pixie_tpu.types.dtypes import DataType
+        from pixie_tpu.types.relation import Relation
+        from pixie_tpu.types.strings import StringDictionary
+
+        rng = np.random.default_rng(9)
+        n = 8192
+        svcs = [f"s{i}" for i in range(23)]
+        d = StringDictionary(svcs)
+        rel = Relation([("time_", DataType.TIME64NS),
+                        ("svc", DataType.STRING),
+                        ("v", DataType.FLOAT64)])
+        eng = Engine(window_rows=4096)
+        eng.append_data("t", HostBatch(relation=rel, cols={
+            "time_": (np.arange(n, dtype=np.int64),),
+            "svc": (rng.integers(0, len(svcs), n).astype(np.int32),),
+            "v": (rng.random(n) * 100,),
+        }, length=n, dicts={"svc": d}))
+        return eng
+
+    def test_pallas_engine_path_matches_xla(self):
+        from pixie_tpu.config import set_flag
+
+        eng = self._engine()
+        set_flag("cpu_fold_threads", 1)  # isolate the XLA/Pallas paths
+        try:
+            xla = eng.execute_query(self.QUERY)["output"].to_pydict()
+            set_flag("pallas_dense_fold", "interpret")
+            pallas = eng.execute_query(self.QUERY)["output"].to_pydict()
+        finally:
+            set_flag("pallas_dense_fold", "auto")
+            set_flag("cpu_fold_threads", 0)
+        ox = np.argsort(xla["svc"])
+        op = np.argsort(pallas["svc"])
+        assert list(np.array(xla["svc"])[ox]) == list(np.array(pallas["svc"])[op])
+        np.testing.assert_array_equal(xla["n"][ox], pallas["n"][op])
+        np.testing.assert_allclose(xla["s"][ox], pallas["s"][op], rtol=1e-5)
+        np.testing.assert_allclose(xla["mean"][ox], pallas["mean"][op],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(xla["mx"][ox], pallas["mx"][op], rtol=1e-6)
+
+    def test_tdigest_pallas_quantiles_close(self):
+        from pixie_tpu.config import set_flag
+
+        eng = self._engine()
+        q = ("import px\ndf = px.DataFrame(table='t')\n"
+             "out = df.groupby('svc').agg(p=('v', px.quantiles))\n"
+             "out.p50 = px.pluck_float64(out.p, 'p50')\n"
+             "out = out[['svc', 'p50']]\npx.display(out)")
+        set_flag("cpu_fold_threads", 1)
+        try:
+            xla = eng.execute_query(q)["output"].to_pydict()
+            set_flag("pallas_tdigest", "interpret")
+            pal = eng.execute_query(q)["output"].to_pydict()
+        finally:
+            set_flag("pallas_tdigest", "auto")
+            set_flag("cpu_fold_threads", 0)
+        ox, op = np.argsort(xla["svc"]), np.argsort(pal["svc"])
+        np.testing.assert_allclose(xla["p50"][ox], pal["p50"][op], rtol=0.05)

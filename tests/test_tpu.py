@@ -211,3 +211,75 @@ def test_dense_domain_groupby_on_tpu(tpu):
     ok = status < 400
     for s, cnt in zip(out["service"], out["n"]):
         assert cnt == (ok & (svc == s)).sum()
+
+
+def test_pallas_engine_fold_matches_xla_on_tpu(tpu):
+    """r5: the production agg path routes FLOAT64 dense folds through
+    the Pallas kernel on TPU ('auto'); results must match the XLA fold
+    on the same chip (VERDICT r5 item 2 hardware equivalence)."""
+    from pixie_tpu.config import set_flag
+    from pixie_tpu.exec.engine import Engine
+    from pixie_tpu.types.batch import HostBatch
+    from pixie_tpu.types.dtypes import DataType
+    from pixie_tpu.types.relation import Relation
+    from pixie_tpu.types.strings import StringDictionary
+
+    rng = np.random.default_rng(11)
+    n = 1 << 17
+    svcs = [f"s{i}" for i in range(31)]
+    d = StringDictionary(svcs)
+    rel = Relation([("time_", DataType.TIME64NS),
+                    ("svc", DataType.STRING),
+                    ("v", DataType.FLOAT64)])
+    q = ("import px\ndf = px.DataFrame(table='t')\n"
+         "out = df.groupby('svc').agg(n=('v', px.count), s=('v', px.sum),"
+         " mx=('v', px.max))\npx.display(out)")
+
+    def run(mode):
+        set_flag("pallas_dense_fold", mode)
+        try:
+            eng = Engine(window_rows=1 << 15)
+            eng.append_data("t", HostBatch(relation=rel, cols={
+                "time_": (np.arange(n, dtype=np.int64),),
+                "svc": (rng_codes,),
+                "v": (vals,),
+            }, length=n, dicts={"svc": d}))
+            t0 = time.perf_counter()
+            out = eng.execute_query(q)["output"].to_pydict()
+            return out, time.perf_counter() - t0
+        finally:
+            set_flag("pallas_dense_fold", "auto")
+
+    rng_codes = rng.integers(0, len(svcs), n).astype(np.int32)
+    vals = rng.random(n) * 1000
+    pallas, dt_p = run("auto")  # TPU backend: auto engages the kernel
+    xla, dt_x = run("off")
+    op, ox = np.argsort(pallas["svc"]), np.argsort(xla["svc"])
+    assert list(np.array(pallas["svc"])[op]) == list(np.array(xla["svc"])[ox])
+    np.testing.assert_array_equal(pallas["n"][op], xla["n"][ox])
+    np.testing.assert_allclose(pallas["s"][op], xla["s"][ox], rtol=1e-4)
+    np.testing.assert_allclose(pallas["mx"][op], xla["mx"][ox], rtol=1e-6)
+    print(f"pallas engine fold: {dt_p*1e3:.0f} ms vs xla {dt_x*1e3:.0f} ms")
+
+
+def test_pallas_tdigest_hist_on_tpu(tpu):
+    """The t-digest histogram kernel matches the XLA segment-sum path on
+    the chip (within sketch tolerance)."""
+    from pixie_tpu.config import set_flag
+    from pixie_tpu.ops.tdigest import batch_to_digest, digest_quantile
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(13)
+    n, g = 1 << 18, 4
+    vals = jnp.asarray(rng.lognormal(3.0, 1.0, n).astype(np.float32))
+    gids = jnp.asarray(rng.integers(0, g, n).astype(np.int32))
+    mask = jnp.ones(n, dtype=bool)
+
+    set_flag("pallas_tdigest", "auto")
+    pal = digest_quantile(batch_to_digest(vals, gids, mask, g), (0.5, 0.99))
+    set_flag("pallas_tdigest", "off")
+    try:
+        ref = digest_quantile(batch_to_digest(vals, gids, mask, g), (0.5, 0.99))
+    finally:
+        set_flag("pallas_tdigest", "auto")
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref), rtol=0.05)

@@ -21,7 +21,7 @@ for s in http_stats service_stats net_flow_graph sql_stats perf_flamegraph devic
 done
 
 echo "== requires_tpu suite =="
-PIXIE_TPU_RUN_TPU_TESTS=1 timeout 1200 python -m pytest tests/test_tpu.py -v -s 2>&1 | tee TPU_TESTS_r04.txt | tail -5
+PIXIE_TPU_RUN_TPU_TESTS=1 timeout 1200 python -m pytest tests/test_tpu.py -v -s 2>&1 | tee TPU_TESTS_r05.txt | tail -5
 
 echo "== full bench =="
 PIXIE_TPU_BENCH_BUDGET="${BENCH_BUDGET:-900}" timeout 1000 python bench.py
