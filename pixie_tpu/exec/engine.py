@@ -472,9 +472,12 @@ class Engine:
         # Scan-folding exists to amortize the TPU tunnel's ~70ms/dispatch
         # round trip; on the CPU backend dispatches are cheap and the
         # jnp.stack of window planes is a pure memory-bandwidth loss.
+        # (DistributedEngine turns it off: update_all is a single-logical-
+        # device jit and would bypass the shard_map distributed steps.)
         chunk_w = (
             get_flag("fold_scan_windows")
-            if frag.update_all and jax.default_backend() == "tpu"
+            if frag.update_all and self.scan_fold
+            and jax.default_backend() == "tpu"
             else 0
         )
         pend_cols, pend_lo, pend_hi = [], [], []
@@ -677,6 +680,9 @@ class Engine:
     # CPU-backend thread-parallel window folding; DistributedEngine turns
     # it off (its fold steps run inside shard_map over the mesh).
     cpu_parallel_fold = True
+    # TPU scan-fold window batching (update_all); DistributedEngine turns
+    # it off for the same reason — update_all is not a distributed step.
+    scan_fold = True
 
     def _window_capacity(self, length: int) -> int:
         return max(bucket_capacity(self.window_rows), bucket_capacity(length))
@@ -706,10 +712,15 @@ class Engine:
             return
         yield from self._staged_windows_inner(stream, stats)
 
-    def _staged_windows_with_side(self, stream: "_Stream", stats=None):
+    def _put_side(self, v):
+        """Stage one fused-join side table (DistributedEngine replicates
+        over its mesh instead)."""
         import jax
 
-        side = {k: jax.device_put(v) for k, v in stream.side.items()}
+        return jax.device_put(v)
+
+    def _staged_windows_with_side(self, stream: "_Stream", stats=None):
+        side = {k: self._put_side(v) for k, v in stream.side.items()}
         for cols, valid in self._staged_windows_inner(stream, stats):
             yield {**cols, "__side__": side}, valid
 

@@ -687,7 +687,7 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
         and all(
             ae.uda_name == "count"
             or (
-                ae.uda_name in ("sum", "mean", "max")
+                ae.uda_name in ("sum", "mean", "max", "min")
                 and len(arg_bound) == 1
                 and casts[0][1] == DataType.FLOAT64
             )
@@ -713,10 +713,10 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
         folds: dict = {}
 
         def fold_for(a):
-            cnt, s, mx = dense_group_fold(
+            cnt, s, mx, mn = dense_group_fold(
                 gids_p, a, g_pad, chunk=chunk, interpret=interpret
             )
-            return cnt[:g], s[:g], mx[:g]
+            return cnt[:g], s[:g], mx[:g], mn[:g]
 
         carries_w = {}
         cnt_shared = None
@@ -727,7 +727,7 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
             if fkey not in folds:
                 a = apply_cast(arg_bound[0].fn(cols), *casts[0])
                 folds[fkey] = fold_for(jnp.broadcast_to(a, valid.shape))
-            cnt, s, mx = folds[fkey]
+            cnt, s, mx, mn = folds[fkey]
             cnt_shared = cnt
             init_leaf = uda.init(g)
             if ae.uda_name == "sum":
@@ -737,13 +737,14 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
                     s.astype(init_leaf[0].dtype),
                     cnt.astype(init_leaf[1].dtype),
                 )
-            else:  # max: empty slots keep the UDA's neutral fill
+            else:  # max/min: empty slots keep the UDA's neutral fill
+                ext = mx if ae.uda_name == "max" else mn
                 carries_w[ae.out_name] = jnp.where(
-                    cnt > 0, mx.astype(init_leaf.dtype), init_leaf
+                    cnt > 0, ext.astype(init_leaf.dtype), init_leaf
                 )
         if cnt_shared is None:
             # count-only aggregation: one kernel pass over a zero column.
-            cnt_shared, _s, _m = fold_for(jnp.zeros(n, dtype=jnp.float32))
+            cnt_shared = fold_for(jnp.zeros(n, dtype=jnp.float32))[0]
         for ae, uda, _b, _c in aggs_bound:
             if ae.uda_name == "count":
                 carries_w[ae.out_name] = cnt_shared.astype(

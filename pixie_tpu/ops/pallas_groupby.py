@@ -29,9 +29,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _NEG = -3.4e38  # f32 "-inf" stand-in (finite: keeps masked matmuls clean)
+_POS = 3.4e38
 
 
-def _fold_kernel(slot_ref, val_ref, cnt_ref, sum_ref, max_ref, *, g: int):
+def _fold_kernel(slot_ref, val_ref, cnt_ref, sum_ref, max_ref, min_ref,
+                 *, g: int):
     """One grid step: fold a [C]-row chunk into the [G] accumulators."""
     step = pl.program_id(0)
 
@@ -40,6 +42,7 @@ def _fold_kernel(slot_ref, val_ref, cnt_ref, sum_ref, max_ref, *, g: int):
         cnt_ref[:] = jnp.zeros_like(cnt_ref)
         sum_ref[:] = jnp.zeros_like(sum_ref)
         max_ref[:] = jnp.full_like(max_ref, _NEG)
+        min_ref[:] = jnp.full_like(min_ref, _POS)
 
     slots = slot_ref[:]  # [C] i32; trash rows carry an id >= g
     vals = val_ref[:]  # [C] f32
@@ -49,21 +52,24 @@ def _fold_kernel(slot_ref, val_ref, cnt_ref, sum_ref, max_ref, *, g: int):
         slots[:, None]
         == jax.lax.broadcasted_iota(jnp.int32, (slots.shape[0], g), 1)
     ).astype(jnp.float32)
-    # MXU: [1, C] @ [C, G] contractions. NaN values must be zeroed for
-    # the contraction (NaN * 0.0 = NaN would poison EVERY group's sum,
-    # not just the NaN row's own group); the masked max below still sees
-    # the raw values, so a NaN group surfaces as max=NaN and the caller
-    # restores NaN into that group's sum only.
+    # MXU: [1, C] @ [C, G] contractions. NON-FINITE values must be zeroed
+    # for the contraction (NaN * 0 = NaN and inf * 0 = NaN would poison
+    # EVERY group's sum, not just the row's own group); the masked
+    # max/min reductions below see the raw values, so a group containing
+    # NaN/+inf/-inf surfaces there and the caller restores the correct
+    # non-finite sum into that group alone.
     cnt_ref[:] += jnp.sum(onehot, axis=0)
-    sum_ref[:] += jnp.where(jnp.isnan(vals), 0.0, vals) @ onehot
-    masked = jnp.where(onehot > 0, vals[:, None], _NEG)  # [C, G] VPU
-    max_ref[:] = jnp.maximum(max_ref[:], jnp.max(masked, axis=0))
+    sum_ref[:] += jnp.where(jnp.isfinite(vals), vals, 0.0) @ onehot
+    masked_hi = jnp.where(onehot > 0, vals[:, None], _NEG)  # [C, G] VPU
+    max_ref[:] = jnp.maximum(max_ref[:], jnp.max(masked_hi, axis=0))
+    masked_lo = jnp.where(onehot > 0, vals[:, None], _POS)
+    min_ref[:] = jnp.minimum(min_ref[:], jnp.min(masked_lo, axis=0))
 
 
 @functools.partial(jax.jit, static_argnames=("g", "chunk", "interpret"))
 def dense_group_fold(slots, values, g: int, chunk: int = 2048,
                      interpret: bool = False):
-    """(count, sum, max) f32[g] over packed slot ids.
+    """(count, sum, max, min) f32[g] over packed slot ids.
 
     ``slots`` i32[n] in [0, g) for live rows, >= g for masked rows;
     ``values`` f32[n]. n must be a multiple of ``chunk`` (the engine's
@@ -85,17 +91,31 @@ def dense_group_fold(slots, values, g: int, chunk: int = 2048,
             pl.BlockSpec((g,), lambda i: (0,)),
             pl.BlockSpec((g,), lambda i: (0,)),
             pl.BlockSpec((g,), lambda i: (0,)),
+            pl.BlockSpec((g,), lambda i: (0,)),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((g,), jnp.float32),
             jax.ShapeDtypeStruct((g,), jnp.float32),
             jax.ShapeDtypeStruct((g,), jnp.float32),
             jax.ShapeDtypeStruct((g,), jnp.float32),
         ],
         interpret=interpret,
     )(slots.astype(jnp.int32), values.astype(jnp.float32))
-    cnt, s, m = out
-    # A NaN row propagated into its group's max (jnp.maximum semantics);
-    # restore it into that group's SUM too — matching the XLA
-    # scatter-add, where the NaN lands only in its own group.
-    s = jnp.where((cnt > 0) & jnp.isnan(m), jnp.nan, s)
-    return cnt, s, jnp.where(cnt > 0, m, jnp.nan)
+    cnt, s, m, mn = out
+    # Restore per-group non-finite sums from the max/min evidence (the
+    # contraction zeroed them so they could not leak across groups):
+    # NaN anywhere -> NaN; +inf and -inf together -> NaN; else +/-inf.
+    has_nan = jnp.isnan(m) | jnp.isnan(mn)
+    has_pos = m == jnp.inf
+    has_neg = mn == -jnp.inf
+    s = jnp.where(
+        has_nan | (has_pos & has_neg), jnp.nan,
+        jnp.where(has_pos, jnp.inf, jnp.where(has_neg, -jnp.inf, s)),
+    )
+    live = cnt > 0
+    return (
+        cnt,
+        jnp.where(live, s, 0.0),
+        jnp.where(live, m, jnp.nan),
+        jnp.where(live, mn, jnp.nan),
+    )

@@ -164,6 +164,12 @@ def stage_window(table, window_index: int, window_rows: int) -> DeviceWindow | N
     if n <= 0:
         return None
     cap = bucket_capacity(window_rows)
+    mult = getattr(table, "stage_capacity_multiple", 1)
+    if mult > 1:
+        from ..parallel.mesh import pad_to_multiple
+
+        cap = pad_to_multiple(cap, mult)
+    sharding = getattr(table, "stage_sharding", None)
     cols: dict = {}
     nbytes = 0
     for (cname, plane_i), p in zip(table._plane_layout, planes):
@@ -171,7 +177,14 @@ def stage_window(table, window_index: int, window_rows: int) -> DeviceWindow | N
         ddt = np.dtype(device_dtypes(dt)[plane_i])  # f64 -> f32 etc.
         padded = np.full(cap, pad_values(dt)[plane_i], dtype=ddt)
         padded[:n] = p
-        arr = jnp.asarray(padded)
+        if sharding is not None:
+            # Mesh residency: the window lives row-sharded across the
+            # engine's mesh — each virtual PEM holds its shard in HBM.
+            import jax
+
+            arr = jax.device_put(padded, sharding)
+        else:
+            arr = jnp.asarray(padded)
         cols.setdefault(cname, {})[plane_i] = arr
         nbytes += cap * ddt.itemsize
     cols = {

@@ -418,6 +418,11 @@ class Table:
         self._device_cache = None
         self._staged_through = 0
         self.device_window_rows = int(_get_flag("window_rows"))
+        # Mesh residency: when a DistributedEngine owns the table, staged
+        # windows device_put row-sharded over its mesh (None = single
+        # device), padded to a shard-count multiple.
+        self.stage_sharding = None
+        self.stage_capacity_multiple = 1
         # Per-column (min, max) over every row ever appended, for
         # single-plane integer columns. Conservative bounds (ring expiry
         # never widens them), maintained on the push path so the query

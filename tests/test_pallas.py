@@ -14,7 +14,7 @@ class TestDenseGroupFold:
         slots = rng.integers(0, g, n).astype(np.int32)
         slots[::7] = g  # masked rows land in the trash id
         vals = rng.random(n).astype(np.float32) * 100
-        cnt, s, mx = dense_group_fold(slots, vals, g, chunk=1024,
+        cnt, s, mx, mn = dense_group_fold(slots, vals, g, chunk=1024,
                                       interpret=True)
         live = slots < g
         ref_cnt = np.bincount(slots[live], minlength=g)
@@ -32,7 +32,7 @@ class TestDenseGroupFold:
     def test_empty_groups_are_nan_max_zero_count(self):
         slots = np.full(2048, 64, dtype=np.int32)  # everything masked
         vals = np.ones(2048, dtype=np.float32)
-        cnt, s, mx = dense_group_fold(slots, vals, 64, chunk=1024,
+        cnt, s, mx, mn = dense_group_fold(slots, vals, 64, chunk=1024,
                                       interpret=True)
         assert float(np.asarray(cnt).sum()) == 0.0
         assert float(np.asarray(s).sum()) == 0.0
@@ -132,3 +132,21 @@ px.display(out)
             set_flag("cpu_fold_threads", 0)
         ox, op = np.argsort(xla["svc"]), np.argsort(pal["svc"])
         np.testing.assert_allclose(xla["p50"][ox], pal["p50"][op], rtol=0.05)
+
+    def test_nonfinite_values_confined_to_their_group(self):
+        """NaN/inf rows must poison only their OWN group's sum — the
+        one-hot contraction zeroes them and the max/min evidence
+        restores them (r5 review finding)."""
+        slots = np.array([0, 0, 1, 1, 2, 2, 3, 3] * 16, dtype=np.int32)
+        vals = np.ones(128, dtype=np.float32)
+        vals[0] = np.nan        # group 0: NaN
+        vals[2] = np.inf        # group 1: +inf
+        vals[4] = -np.inf       # group 2: -inf
+        cnt, s, mx, mn = dense_group_fold(slots, vals, 128, chunk=64,
+                                          interpret=True)
+        s = np.asarray(s)
+        assert np.isnan(s[0])
+        assert s[1] == np.inf
+        assert s[2] == -np.inf
+        assert s[3] == 32.0  # the finite group is untouched
+        assert np.asarray(mn)[3] == 1.0

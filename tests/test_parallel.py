@@ -201,3 +201,104 @@ def test_mesh_uses_all_devices():
     mesh = agent_mesh(8)
     assert mesh.devices.size == 8
     assert mesh.axis_names == ("kelvin", "agents")
+
+
+def test_distributed_fused_lookup_join(engines):
+    """r5: fused N:1 lookup joins run ON the mesh — the build tables ride
+    the distributed steps' replicated side spec instead of forcing a
+    host materialize (VERDICT r4 item 5)."""
+    single, dist = engines
+    q = """
+import px
+l = px.DataFrame(table='http_events')
+r = px.DataFrame(table='http_events')
+ra = r.groupby('service').agg(total=('latency_ns', px.count))
+g = l.merge(ra, how='inner', left_on=['service'], right_on=['service'],
+            suffixes=['', '_r'])
+out = g.groupby('req_path').agg(n=('total', px.count),
+                                s=('total', px.sum))
+px.display(out)
+"""
+    r1 = _sorted_rows(single.execute_query(q)["output"], key="req_path")
+    r2 = _sorted_rows(dist.execute_query(q)["output"], key="req_path")
+    assert list(r1) == list(r2)
+    _assert_rows_close(r1, r2)
+
+
+def test_distributed_union(engines):
+    single, dist = engines
+    for e in (single, dist):
+        if "http_events_b" not in e.tables:
+            e.append_data("http_events_b", _http_events(4_000, seed=7))
+    q = """
+import px
+a = px.DataFrame(table='http_events')
+b = px.DataFrame(table='http_events_b')
+u = a.append(b)
+out = u.groupby('service').agg(n=('latency_ns', px.count),
+                               mx=('latency_ns', px.max))
+px.display(out)
+"""
+    r1 = _sorted_rows(single.execute_query(q)["output"])
+    r2 = _sorted_rows(dist.execute_query(q)["output"])
+    assert list(r1) == list(r2)
+    _assert_rows_close(r1, r2)
+
+
+def test_mesh_resident_windows(engines):
+    """r5 mesh residency: table windows stage row-sharded over the mesh
+    at append time, and the steady-state query consumes them from the
+    device cache (device_residency True on the base mesh)."""
+    _single, dist = engines
+    assert dist.device_residency is True
+    t = dist.tables["http_events"]
+    assert t.stage_sharding is not None
+    assert t.stage_capacity_multiple == 8
+    wins = list(t.device_scan(window_rows=4096))
+    assert wins, "no resident windows staged"
+    win, lo, hi = wins[0]
+    plane = win.cols["latency_ns"][0]
+    # The staged plane is actually laid out across all 8 devices.
+    assert len(plane.sharding.device_set) == 8
+    # Capacity is a shard-count multiple so shard_map divides evenly.
+    assert plane.shape[0] % 8 == 0
+    # And the query over the resident windows matches numpy.
+    out = dist.execute_query(
+        "import px\ndf = px.DataFrame(table='http_events')\n"
+        "s = df.groupby('service').agg(n=('latency_ns', px.count))\n"
+        "px.display(s)"
+    )["output"].to_pydict()
+    data = _http_events(10_000)
+    import collections
+
+    want = collections.Counter(data["service"].tolist())
+    got = dict(zip(out["service"], out["n"].tolist()))
+    assert got == dict(want)
+
+
+def test_degraded_mesh_agent_loss_mid_stream(engines):
+    """Agent loss: a query replanned onto a SUB-mesh (coordinator pruned
+    dead agents) still answers correctly — per-window staging replaces
+    the mesh-resident cache whose layout no longer matches."""
+    from pixie_tpu.planner.distributed.distributed_state import (
+        AgentInfo,
+        DistributedState,
+    )
+
+    single, _ = engines
+    # 3 live data agents out of 8 devices -> degraded (3, 1) mesh.
+    st = DistributedState(agents=[
+        AgentInfo(agent_id=f"pem-{i}", processes_data=True,
+                  tables=frozenset({"http_events"}))
+        for i in range(3)
+    ] + [AgentInfo(agent_id="kelvin-0", processes_data=False,
+                   accepts_remote_sources=True)])
+    dist = DistributedEngine(
+        window_rows=4096, mesh=agent_mesh(8), distributed_state=st
+    )
+    dist.append_data("http_events", _http_events(10_000))
+    plan = _http_stats_plan()
+    r1 = _sorted_rows(single.execute_plan(plan)["out"])
+    r2 = _sorted_rows(dist.execute_plan(plan)["out"])
+    assert dist.last_distributed_plan is not None
+    _assert_rows_close(r1, r2)
