@@ -217,6 +217,10 @@ def launcher() -> int:
         "value": head["rows_per_sec"],
         "unit": "rows/s",
         "vs_baseline": head["vs_baseline"],
+        # The denominator is an in-process numpy replay of the same
+        # query, NOT CPU Carnot — the reference engine cannot be built
+        # offline (BASELINE.md "CPU-Carnot measurement attempt").
+        "baseline": "in-process numpy replay (see BASELINE.md)",
         "device": device or "unknown",
         "shapes": shapes,
     }), flush=True)

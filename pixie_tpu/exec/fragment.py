@@ -712,11 +712,14 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
         # three statistics anyway).
         folds: dict = {}
 
+        need_min = any(ae.uda_name == "min" for ae, _u, _b, _c in aggs_bound)
+
         def fold_for(a):
             cnt, s, mx, mn = dense_group_fold(
-                gids_p, a, g_pad, chunk=chunk, interpret=interpret
+                gids_p, a, g_pad, chunk=chunk, interpret=interpret,
+                want_min=need_min,
             )
-            return cnt[:g], s[:g], mx[:g], mn[:g]
+            return cnt[:g], s[:g], mx[:g], mn[:g] if mn is not None else None
 
         carries_w = {}
         cnt_shared = None

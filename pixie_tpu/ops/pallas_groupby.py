@@ -28,21 +28,23 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-_NEG = -3.4e38  # f32 "-inf" stand-in (finite: keeps masked matmuls clean)
-_POS = 3.4e38
+def _fold_kernel(slot_ref, val_ref, cnt_ref, sum_ref, max_ref, aux_ref,
+                 *, g: int, want_min: bool):
+    """One grid step: fold a [C]-row chunk into the [G] accumulators.
 
-
-def _fold_kernel(slot_ref, val_ref, cnt_ref, sum_ref, max_ref, min_ref,
-                 *, g: int):
-    """One grid step: fold a [C]-row chunk into the [G] accumulators."""
+    ``aux_ref`` is the per-group MIN when ``want_min`` (full VPU masked
+    reduce) and otherwise a per-group count of -inf values (one extra
+    MXU contraction) — the cheap evidence the sum-restore logic needs,
+    since zeroed non-finite rows must resurface in their own group.
+    """
     step = pl.program_id(0)
 
     @pl.when(step == 0)
     def _init():
         cnt_ref[:] = jnp.zeros_like(cnt_ref)
         sum_ref[:] = jnp.zeros_like(sum_ref)
-        max_ref[:] = jnp.full_like(max_ref, _NEG)
-        min_ref[:] = jnp.full_like(min_ref, _POS)
+        max_ref[:] = jnp.full_like(max_ref, -jnp.inf)
+        aux_ref[:] = jnp.full_like(aux_ref, jnp.inf if want_min else 0.0)
 
     slots = slot_ref[:]  # [C] i32; trash rows carry an id >= g
     vals = val_ref[:]  # [C] f32
@@ -57,29 +59,39 @@ def _fold_kernel(slot_ref, val_ref, cnt_ref, sum_ref, max_ref, min_ref,
     # EVERY group's sum, not just the row's own group); the masked
     # max/min reductions below see the raw values, so a group containing
     # NaN/+inf/-inf surfaces there and the caller restores the correct
-    # non-finite sum into that group alone.
+    # non-finite sum into that group alone. The masked fills are ±inf —
+    # they feed only VPU reductions, never the matmul, so a group whose
+    # values are all +inf (f32 overflow of a huge f64) still reports the
+    # true extremum the XLA scatter path would.
     cnt_ref[:] += jnp.sum(onehot, axis=0)
     sum_ref[:] += jnp.where(jnp.isfinite(vals), vals, 0.0) @ onehot
-    masked_hi = jnp.where(onehot > 0, vals[:, None], _NEG)  # [C, G] VPU
+    masked_hi = jnp.where(onehot > 0, vals[:, None], -jnp.inf)  # [C, G] VPU
     max_ref[:] = jnp.maximum(max_ref[:], jnp.max(masked_hi, axis=0))
-    masked_lo = jnp.where(onehot > 0, vals[:, None], _POS)
-    min_ref[:] = jnp.minimum(min_ref[:], jnp.min(masked_lo, axis=0))
+    if want_min:
+        masked_lo = jnp.where(onehot > 0, vals[:, None], jnp.inf)
+        aux_ref[:] = jnp.minimum(aux_ref[:], jnp.min(masked_lo, axis=0))
+    else:
+        aux_ref[:] += (vals == -jnp.inf).astype(jnp.float32) @ onehot
 
 
-@functools.partial(jax.jit, static_argnames=("g", "chunk", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("g", "chunk", "interpret", "want_min")
+)
 def dense_group_fold(slots, values, g: int, chunk: int = 2048,
-                     interpret: bool = False):
-    """(count, sum, max, min) f32[g] over packed slot ids.
+                     interpret: bool = False, want_min: bool = False):
+    """(count, sum, max, min | None) f32[g] over packed slot ids.
 
     ``slots`` i32[n] in [0, g) for live rows, >= g for masked rows;
     ``values`` f32[n]. n must be a multiple of ``chunk`` (the engine's
     capacity bucketing guarantees powers of two); g should be a multiple
     of 128 for lane alignment (pad and slice at the caller).
+    ``want_min=False`` skips the min reduce (the 4th return is None) —
+    queries without a min aggregate don't pay its VPU pass.
     """
     n = slots.shape[0]
     grid = (n // chunk,)
     out = pl.pallas_call(
-        functools.partial(_fold_kernel, g=g),
+        functools.partial(_fold_kernel, g=g, want_min=want_min),
         grid=grid,
         in_specs=[
             pl.BlockSpec((chunk,), lambda i: (i,)),
@@ -101,13 +113,14 @@ def dense_group_fold(slots, values, g: int, chunk: int = 2048,
         ],
         interpret=interpret,
     )(slots.astype(jnp.int32), values.astype(jnp.float32))
-    cnt, s, m, mn = out
-    # Restore per-group non-finite sums from the max/min evidence (the
+    cnt, s, m, aux = out
+    # Restore per-group non-finite sums from the max/aux evidence (the
     # contraction zeroed them so they could not leak across groups):
     # NaN anywhere -> NaN; +inf and -inf together -> NaN; else +/-inf.
-    has_nan = jnp.isnan(m) | jnp.isnan(mn)
+    mn = aux if want_min else None
+    has_nan = jnp.isnan(m) | (jnp.isnan(aux) if want_min else False)
     has_pos = m == jnp.inf
-    has_neg = mn == -jnp.inf
+    has_neg = (aux == -jnp.inf) if want_min else (aux > 0)
     s = jnp.where(
         has_nan | (has_pos & has_neg), jnp.nan,
         jnp.where(has_pos, jnp.inf, jnp.where(has_neg, -jnp.inf, s)),
@@ -117,5 +130,5 @@ def dense_group_fold(slots, values, g: int, chunk: int = 2048,
         cnt,
         jnp.where(live, s, 0.0),
         jnp.where(live, m, jnp.nan),
-        jnp.where(live, mn, jnp.nan),
+        jnp.where(live, mn, jnp.nan) if want_min else None,
     )

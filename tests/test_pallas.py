@@ -15,7 +15,7 @@ class TestDenseGroupFold:
         slots[::7] = g  # masked rows land in the trash id
         vals = rng.random(n).astype(np.float32) * 100
         cnt, s, mx, mn = dense_group_fold(slots, vals, g, chunk=1024,
-                                      interpret=True)
+                                          interpret=True, want_min=True)
         live = slots < g
         ref_cnt = np.bincount(slots[live], minlength=g)
         ref_sum = np.bincount(slots[live], weights=vals[live].astype(np.float64),
@@ -33,7 +33,7 @@ class TestDenseGroupFold:
         slots = np.full(2048, 64, dtype=np.int32)  # everything masked
         vals = np.ones(2048, dtype=np.float32)
         cnt, s, mx, mn = dense_group_fold(slots, vals, 64, chunk=1024,
-                                      interpret=True)
+                                          interpret=True, want_min=True)
         assert float(np.asarray(cnt).sum()) == 0.0
         assert float(np.asarray(s).sum()) == 0.0
         assert np.isnan(np.asarray(mx)).all()
@@ -143,10 +143,23 @@ px.display(out)
         vals[2] = np.inf        # group 1: +inf
         vals[4] = -np.inf       # group 2: -inf
         cnt, s, mx, mn = dense_group_fold(slots, vals, 128, chunk=64,
-                                          interpret=True)
+                                          interpret=True, want_min=True)
         s = np.asarray(s)
         assert np.isnan(s[0])
         assert s[1] == np.inf
         assert s[2] == -np.inf
         assert s[3] == 32.0  # the finite group is untouched
         assert np.asarray(mn)[3] == 1.0
+
+    def test_neg_inf_restored_without_min_pass(self):
+        """want_min=False still restores a -inf group sum (the aux
+        output counts -inf rows via an MXU contraction instead)."""
+        slots = np.array([0, 0, 1, 1] * 32, dtype=np.int32)
+        vals = np.ones(128, dtype=np.float32)
+        vals[0] = -np.inf
+        cnt, s, mx, mn = dense_group_fold(slots, vals, 128, chunk=64,
+                                          interpret=True, want_min=False)
+        assert mn is None
+        s = np.asarray(s)
+        assert s[0] == -np.inf
+        assert s[1] == 64.0

@@ -180,7 +180,7 @@ def test_pallas_dense_group_fold_on_tpu(tpu):
     slots[::5] = g  # masked rows
     vals = (rng.random(n) * 1e6).astype(np.float32)
     t0 = time.perf_counter()
-    cnt, s, mx, mn = dense_group_fold(slots, vals, g, chunk=4096)
+    cnt, s, mx, mn = dense_group_fold(slots, vals, g, chunk=4096, want_min=True)
     import jax
 
     jax.block_until_ready((cnt, s, mx, mn))
