@@ -41,6 +41,9 @@ class ConnectionTable:
         """Drop a tracker whose stream can no longer be trusted."""
         self._conns.pop(conn_id, None)
 
+    def values(self):
+        return self._conns.values()
+
     def _evict(self, now_ns: int) -> None:
         cutoff = now_ns - self.IDLE_TTL_NS
         if len(self._conns) > self.SWEEP_MIN:

@@ -157,6 +157,44 @@ CQL_EVENTS_RELATION = Relation(
     ]
 )
 
+# nats_table.h kNATSTable ("nats_events.beta": cmd/body/resp).
+NATS_EVENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("cmd", DataType.STRING),
+        ("body", DataType.STRING),
+        ("resp", DataType.STRING),
+        ("latency_ns", DataType.INT64),
+        ("service", DataType.STRING),
+    ]
+)
+
+# mux_table.h kMuxTable (req_type enum + latency).
+MUX_EVENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("req_type", DataType.INT64),
+        ("latency_ns", DataType.INT64),
+        ("service", DataType.STRING),
+    ]
+)
+
+# AMQP method events (reference protocols/amqp is WIP — this is the
+# method-level shape its sibling tables share).
+AMQP_EVENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("channel", DataType.INT64),
+        ("method", DataType.STRING),
+        ("resp", DataType.STRING),
+        ("latency_ns", DataType.INT64),
+        ("service", DataType.STRING),
+    ]
+)
+
 # dns_table.h kDNSTable (subset).
 DNS_EVENTS_RELATION = Relation(
     [
@@ -181,6 +219,9 @@ CANONICAL_SCHEMAS: dict[str, Relation] = {
     "redis_events": REDIS_EVENTS_RELATION,
     "kafka_events.beta": KAFKA_EVENTS_RELATION,
     "cql_events": CQL_EVENTS_RELATION,
+    "nats_events.beta": NATS_EVENTS_RELATION,
+    "mux_events": MUX_EVENTS_RELATION,
+    "amqp_events": AMQP_EVENTS_RELATION,
     "process_stats": PROCESS_STATS_RELATION,
     "network_stats": NETWORK_STATS_RELATION,
     "dns_events": DNS_EVENTS_RELATION,

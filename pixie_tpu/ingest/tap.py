@@ -6,10 +6,11 @@ socket_trace_connector.cc`` TransferData: drain per-connection capture
 buffers through protocol parsers/stitchers into the protocol tables).
 The capture source here is a recorded tap — a JSONL file or an
 in-memory feed of ``{"conn": id, "dir": "req"|"resp", "ts": ns,
-"proto": "http"|"dns"|"mysql"|"pgsql", "data_b64": ...}`` events (what
-a sidecar proxy or pcap exporter produces) — pushed through the same
-incremental per-protocol parsers/stitchers into http_events,
-dns_events, mysql_events and pgsql_events.
+"proto": <protocol>, "data_b64": ...}`` events (what a sidecar proxy or
+pcap exporter produces) — pushed through the same incremental
+per-protocol parsers/stitchers into the canonical event tables. All 11
+reference protocols are covered: http (+http2 into the same
+http_events), dns, mysql, pgsql, redis, kafka, cql, nats, mux, amqp.
 """
 
 from __future__ import annotations
@@ -20,19 +21,26 @@ from typing import Iterable, Optional
 
 from ..types.dtypes import DataType
 from .core import SourceConnector
+from .amqp_parser import AMQPStitcher
 from .cql_parser import CQLStitcher
 from .dns_parser import DNSStitcher
+from .http2_parser import HTTP2Stitcher
 from .http_parser import HTTPStitcher
 from .kafka_parser import KafkaStitcher
+from .mux_parser import MuxStitcher
 from .mysql_parser import MySQLStitcher
+from .nats_parser import NATSStitcher
 from .pgsql_parser import PgSQLStitcher
 from .redis_parser import RedisStitcher
 from .schemas import (
+    AMQP_EVENTS_RELATION,
     CQL_EVENTS_RELATION,
     DNS_EVENTS_RELATION,
     HTTP_EVENTS_RELATION,
     KAFKA_EVENTS_RELATION,
+    MUX_EVENTS_RELATION,
     MYSQL_EVENTS_RELATION,
+    NATS_EVENTS_RELATION,
     PGSQL_EVENTS_RELATION,
     REDIS_EVENTS_RELATION,
 )
@@ -50,6 +58,9 @@ class CaptureTapConnector(SourceConnector):
         ("redis_events", REDIS_EVENTS_RELATION),
         ("kafka_events.beta", KAFKA_EVENTS_RELATION),
         ("cql_events", CQL_EVENTS_RELATION),
+        ("nats_events.beta", NATS_EVENTS_RELATION),
+        ("mux_events", MUX_EVENTS_RELATION),
+        ("amqp_events", AMQP_EVENTS_RELATION),
     ]
 
     def __init__(self, feed: Optional[Iterable] = None, path: str = "",
@@ -59,12 +70,16 @@ class CaptureTapConnector(SourceConnector):
         self._path = path
         self._fh = None
         self.http = HTTPStitcher(service=service, pod=pod)
+        self.http2 = HTTP2Stitcher(service=service, pod=pod)
         self.dns = DNSStitcher(pod=pod)
         self.mysql = MySQLStitcher(service=service, pod=pod)
         self.pgsql = PgSQLStitcher(service=service, pod=pod)
         self.redis = RedisStitcher(service=service, pod=pod)
         self.kafka = KafkaStitcher(service=service, pod=pod)
         self.cql = CQLStitcher(service=service, pod=pod)
+        self.nats = NATSStitcher(service=service, pod=pod)
+        self.mux = MuxStitcher(service=service, pod=pod)
+        self.amqp = AMQPStitcher(service=service, pod=pod)
         self.upid_value = 0
 
     def init(self) -> None:
@@ -100,7 +115,8 @@ class CaptureTapConnector(SourceConnector):
             proto = ev.get("proto", "http")
             if proto == "dns":
                 self.dns.feed(data, ts_ns=ev.get("ts"))
-            elif proto in ("mysql", "pgsql", "redis", "kafka", "cql"):
+            elif proto in ("mysql", "pgsql", "redis", "kafka", "cql",
+                           "nats", "mux", "amqp", "http2"):
                 stitcher = getattr(self, proto)
                 stitcher.feed(
                     ev.get("conn", 0), data,
@@ -113,7 +129,8 @@ class CaptureTapConnector(SourceConnector):
                     is_request=(ev.get("dir", "req") == "req"),
                     ts_ns=ev.get("ts"),
                 )
-        http_recs = self.http.drain()
+        # HTTP/1 and HTTP/2 land in the same canonical table.
+        http_recs = self.http.drain() + self.http2.drain()
         if http_recs:
             cols = {
                 k: [r[k] for r in http_recs]
@@ -136,6 +153,9 @@ class CaptureTapConnector(SourceConnector):
             ("redis_events", REDIS_EVENTS_RELATION, self.redis.drain()),
             ("kafka_events.beta", KAFKA_EVENTS_RELATION, self.kafka.drain()),
             ("cql_events", CQL_EVENTS_RELATION, self.cql.drain()),
+            ("nats_events.beta", NATS_EVENTS_RELATION, self.nats.drain()),
+            ("mux_events", MUX_EVENTS_RELATION, self.mux.drain()),
+            ("amqp_events", AMQP_EVENTS_RELATION, self.amqp.drain()),
         ):
             if not recs:
                 continue

@@ -366,3 +366,312 @@ px.display(out)
 """)["output"].to_pydict()
         assert got3["n"].tolist() == [10]
         assert int(got3["req_op"][0]) == OP_QUERY
+
+
+# -- fixture builders: nats / mux / amqp -------------------------------------
+def mux_msg(typ: int, tag: int, body: bytes = b"") -> bytes:
+    hdr = typ.to_bytes(1, "big", signed=True) + tag.to_bytes(3, "big")
+    return (len(hdr) + len(body)).to_bytes(4, "big") + hdr + body
+
+
+def amqp_method(channel: int, cid: int, mid: int, extra: bytes = b"") -> bytes:
+    payload = cid.to_bytes(2, "big") + mid.to_bytes(2, "big") + extra
+    return (b"\x01" + channel.to_bytes(2, "big")
+            + len(payload).to_bytes(4, "big") + payload + b"\xce")
+
+
+class TestNATSStitcher:
+    def test_pub_sub_msg_events(self):
+        from pixie_tpu.ingest.nats_parser import NATSStitcher
+
+        st = NATSStitcher(service="bus")
+        st.feed(1, b'CONNECT {"verbose":false}\r\n', True, ts_ns=1)
+        st.feed(1, b"SUB orders q1 7\r\n", True, ts_ns=10)
+        st.feed(1, b"PUB orders 5\r\nhello\r\n", True, ts_ns=20)
+        st.feed(1, b"MSG orders 7 5\r\nhello\r\n", False, ts_ns=30)
+        st.feed(1, b"PING\r\n", True, ts_ns=40)
+        recs = st.drain()
+        by_cmd = {r["cmd"]: r for r in recs}
+        assert set(by_cmd) == {"CONNECT", "SUB", "PUB", "MSG", "PING"}
+        import json as _json
+
+        pub = _json.loads(by_cmd["PUB"]["body"])
+        assert pub["subject"] == "orders" and pub["payload"] == "hello"
+        msg = _json.loads(by_cmd["MSG"]["body"])
+        assert msg["sid"] == "7"
+
+    def test_verbose_ok_pairs_with_command(self):
+        from pixie_tpu.ingest.nats_parser import NATSStitcher
+
+        st = NATSStitcher()
+        st.feed(2, b"PUB a 2\r\nhi\r\n", True, ts_ns=100)
+        st.feed(2, b"+OK\r\n", False, ts_ns=130)
+        st.feed(2, b"SUB b 1\r\n", True, ts_ns=200)
+        st.feed(2, b"-ERR 'permissions violation'\r\n", False, ts_ns=260)
+        recs = st.drain()
+        assert recs[0]["cmd"] == "PUB" and recs[0]["resp"] == "OK"
+        assert recs[0]["latency_ns"] == 30
+        assert recs[1]["cmd"] == "SUB"
+        assert recs[1]["resp"].startswith("ERR")
+        assert recs[1]["latency_ns"] == 60
+
+    def test_oversized_payload_and_partial_feeds(self):
+        from pixie_tpu.ingest.nats_parser import NATSStitcher
+
+        st = NATSStitcher()
+        st.feed(3, b'CONNECT {"verbose":false}\r\n', True, ts_ns=1)
+        big = b"PUB big " + str(2 << 20).encode() + b"\r\n"
+        st.feed(3, big, True, ts_ns=5)
+        payload = b"z" * ((2 << 20) + 2)
+        for off in range(0, len(payload), 1 << 16):
+            st.feed(3, payload[off:off + (1 << 16)], True, ts_ns=6)
+        st.feed(3, b"PING\r\n", True, ts_ns=10)
+        recs = st.drain()
+        import json as _json
+
+        by_cmd = {r["cmd"]: r for r in recs}
+        assert _json.loads(by_cmd["PUB"]["body"])["payload"] == "<oversized>"
+        assert "PING" in by_cmd
+
+
+class TestMuxStitcher:
+    def test_tag_pairing_out_of_order(self):
+        from pixie_tpu.ingest.mux_parser import MuxStitcher
+
+        st = MuxStitcher(service="rpc")
+        st.feed(1, mux_msg(2, 5, b"a"), True, ts_ns=10)   # Tdispatch
+        st.feed(1, mux_msg(2, 6, b"b"), True, ts_ns=20)
+        st.feed(1, mux_msg(-2, 6), False, ts_ns=50)       # Rdispatch tag 6
+        st.feed(1, mux_msg(-2, 5), False, ts_ns=90)
+        recs = st.drain()
+        assert [r["latency_ns"] for r in recs] == [30, 80]
+        assert all(r["req_type"] == 2 for r in recs)
+
+    def test_ping_and_partial_frames(self):
+        from pixie_tpu.ingest.mux_parser import MuxStitcher
+
+        st = MuxStitcher()
+        f = mux_msg(65, 1)  # Tping
+        st.feed(2, f[:3], True, ts_ns=10)
+        st.feed(2, f[3:], True, ts_ns=11)
+        r = mux_msg(-65, 1)
+        st.feed(2, r[:5], False, ts_ns=17)
+        st.feed(2, r[5:], False, ts_ns=18)
+        (rec,) = st.drain()
+        assert rec["req_type"] == 65
+        # Frames complete on their second feed (ts 11 -> ts 18).
+        assert rec["latency_ns"] == 7
+
+
+class TestAMQPStitcher:
+    def test_sync_method_latency_pairing(self):
+        from pixie_tpu.ingest.amqp_parser import AMQPStitcher
+
+        st = AMQPStitcher(service="mq")
+        st.feed(1, b"AMQP\x00\x00\x09\x01", True, ts_ns=1)
+        st.feed(1, amqp_method(1, 50, 10, b"queue-args"), True, ts_ns=10)
+        st.feed(1, amqp_method(1, 50, 11), False, ts_ns=45)
+        recs = st.drain()
+        (rec,) = recs
+        assert rec["method"] == "queue.declare"
+        assert rec["resp"] == "queue.declare-ok"
+        assert rec["latency_ns"] == 35
+
+    def test_publish_and_deliver_are_async_events(self):
+        from pixie_tpu.ingest.amqp_parser import AMQPStitcher
+
+        st = AMQPStitcher()
+        st.feed(2, b"AMQP\x00\x00\x09\x01", True, ts_ns=1)
+        st.feed(2, amqp_method(1, 60, 40), True, ts_ns=10)   # basic.publish
+        # header + body frames follow a publish; no events for them
+        st.feed(2, b"\x02\x00\x01\x00\x00\x00\x04abcd\xce", True, ts_ns=11)
+        st.feed(2, b"\x03\x00\x01\x00\x00\x00\x02hi\xce", True, ts_ns=12)
+        st.feed(2, amqp_method(1, 60, 60), False, ts_ns=30)  # basic.deliver
+        recs = st.drain()
+        assert [r["method"] for r in recs] == ["basic.publish",
+                                               "basic.deliver"]
+        assert all(r["latency_ns"] == 0 for r in recs)
+
+    def test_get_empty_answers_get(self):
+        from pixie_tpu.ingest.amqp_parser import AMQPStitcher
+
+        st = AMQPStitcher()
+        st.feed(3, amqp_method(2, 60, 70), True, ts_ns=10)   # basic.get
+        st.feed(3, amqp_method(2, 60, 72), False, ts_ns=22)  # get-empty
+        (rec,) = st.drain()
+        assert rec["method"] == "basic.get"
+        assert rec["resp"] == "basic.get-empty"
+        assert rec["latency_ns"] == 12
+
+
+# -- http2 fixtures -----------------------------------------------------------
+def h2_frame(ftype: int, flags: int, stream: int, payload: bytes) -> bytes:
+    return (len(payload).to_bytes(3, "big") + bytes([ftype, flags])
+            + stream.to_bytes(4, "big") + payload)
+
+
+def hpack_literal(name: str, value: str) -> bytes:
+    nb, vb = name.encode(), value.encode()
+    return (b"\x40" + len(nb).to_bytes(1, "big") + nb
+            + len(vb).to_bytes(1, "big") + vb)
+
+
+class TestHTTP2Stitcher:
+    PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+    def test_request_response_pairing_with_hpack(self):
+        from pixie_tpu.ingest.http2_parser import HTTP2Stitcher
+
+        st = HTTP2Stitcher(service="grpc")
+        # Indexed :method GET (static idx 2) + literal :path.
+        req_block = b"\x82" + hpack_literal(":path", "/api/users")
+        st.feed(1, self.PREFACE + h2_frame(1, 0x4 | 0x1, 1, req_block),
+                True, ts_ns=100)
+        resp_block = b"\x88"  # indexed :status 200
+        st.feed(1, h2_frame(1, 0x4, 1, resp_block), False, ts_ns=150)
+        st.feed(1, h2_frame(0, 0x1, 1, b"payload-bytes"), False, ts_ns=180)
+        (rec,) = st.drain()
+        assert rec["req_method"] == "GET"
+        assert rec["req_path"] == "/api/users"
+        assert rec["resp_status"] == 200
+        assert rec["resp_body_bytes"] == 13
+        assert rec["latency_ns"] == 80
+
+    def test_dynamic_table_reuse_across_requests(self):
+        from pixie_tpu.ingest.http2_parser import HTTP2Stitcher
+
+        st = HTTP2Stitcher()
+        st.feed(2, self.PREFACE, True, ts_ns=1)
+        # Request 1: literal-with-indexing path enters the dynamic table.
+        blk1 = b"\x82" + hpack_literal(":path", "/cached")
+        st.feed(2, h2_frame(1, 0x5, 1, blk1), True, ts_ns=10)
+        # Request 2 on stream 3 references it by dynamic index (62).
+        blk2 = b"\x82\xbe"
+        st.feed(2, h2_frame(1, 0x5, 3, blk2), True, ts_ns=20)
+        for sid, t in ((1, 30), (3, 40)):
+            st.feed(2, h2_frame(1, 0x5, sid, b"\x88"), False, ts_ns=t)
+        recs = st.drain()
+        assert [r["req_path"] for r in recs] == ["/cached", "/cached"]
+
+    def test_continuation_and_interleaved_streams(self):
+        from pixie_tpu.ingest.http2_parser import HTTP2Stitcher
+
+        st = HTTP2Stitcher()
+        st.feed(3, self.PREFACE, True, ts_ns=1)
+        block = b"\x82" + hpack_literal(":path", "/long")
+        st.feed(3, h2_frame(1, 0x1, 5, block[:3]), True, ts_ns=10)  # no EH
+        st.feed(3, h2_frame(9, 0x4, 5, block[3:]), True, ts_ns=11)  # CONT
+        st.feed(3, h2_frame(1, 0x5, 5, b"\x8d"), False, ts_ns=60)  # 404
+        (rec,) = st.drain()
+        assert rec["req_path"] == "/long"
+        assert rec["resp_status"] == 404
+
+    def test_huffman_literal_placeholder(self):
+        from pixie_tpu.ingest.http2_parser import HPACKDecoder
+
+        # name idx 4 (:path), Huffman-coded value (H bit set).
+        block = b"\x04" + bytes([0x80 | 3]) + b"\xff\xff\xff"
+        out = HPACKDecoder().decode(block)
+        assert out == [(":path", "<huffman>")]
+
+    def test_tap_routes_http2_into_http_events(self):
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.ingest.collector import Collector
+        from pixie_tpu.ingest.tap import CaptureTapConnector
+
+        def ev(conn, d, data, ts):
+            return {"conn": conn, "dir": d, "ts": ts, "proto": "http2",
+                    "data_b64": base64.b64encode(data).decode()}
+
+        feed = [ev(1, "req", self.PREFACE, 1)]
+        for i in range(20):
+            sid = 1 + 2 * i
+            blk = b"\x82" + hpack_literal(":path", f"/ep{i % 3}")
+            feed.append(ev(1, "req", h2_frame(1, 0x5, sid, blk), 100 + i))
+            feed.append(ev(1, "resp", h2_frame(1, 0x5, sid, b"\x88"),
+                           105 + i))
+        eng = Engine(window_rows=1 << 10)
+        tap = CaptureTapConnector(feed=feed, service="h2")
+        coll = Collector()
+        coll.wire_to(eng)
+        coll.register_source(tap)
+        tap.transfer_data(coll, coll._data_tables)
+        coll.flush()
+        got = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='http_events')\n"
+            "out = df.groupby('req_path').agg(n=('latency_ns', px.count))\n"
+            "px.display(out)"
+        )["output"].to_pydict()
+        assert dict(zip(got["req_path"], got["n"].tolist())) == {
+            "/ep0": 7, "/ep1": 7, "/ep2": 6
+        }
+
+
+class TestParserHardeningR5:
+    def test_nats_ok_across_drain_cycles(self):
+        """A verbose-mode +OK arriving in the NEXT capture batch still
+        pairs (pending survives drain; r5 review finding)."""
+        from pixie_tpu.ingest.nats_parser import NATSStitcher
+
+        st = NATSStitcher()
+        st.feed(1, b'CONNECT {"verbose":true}\r\n', True, ts_ns=10)
+        st.feed(1, b"+OK\r\n", False, ts_ns=12)
+        st.feed(1, b"PUB a 2\r\nhi\r\n", True, ts_ns=100)
+        assert all(r["cmd"] != "PUB" for r in st.drain())  # batch 1
+        st.feed(1, b"+OK\r\n", False, ts_ns=140)           # batch 2
+        recs = st.drain()
+        assert recs[0]["cmd"] == "PUB"
+        assert recs[0]["resp"] == "OK"
+        assert recs[0]["latency_ns"] == 40
+
+    def test_nats_hpub_sizes_not_reply_to(self):
+        import json as _json
+
+        from pixie_tpu.ingest.nats_parser import NATSStitcher
+
+        st = NATSStitcher()
+        st.feed(2, b'CONNECT {"verbose":false}\r\n', True, ts_ns=1)
+        # HPUB <subject> <#hdr> <#total>: the two trailing numbers are
+        # sizes, NOT a reply-to.
+        st.feed(2, b"HPUB orders 4 6\r\nNATS\r\nok\r\n", True, ts_ns=5)
+        recs = st.drain()
+        hpub = next(r for r in recs if r["cmd"] == "HPUB")
+        assert "reply_to" not in _json.loads(hpub["body"])
+
+    def test_mux_rerr_answers_tag(self):
+        from pixie_tpu.ingest.mux_parser import MuxStitcher
+
+        st = MuxStitcher()
+        st.feed(1, mux_msg(2, 9), True, ts_ns=10)
+        st.feed(1, mux_msg(-128, 9, b"boom"), False, ts_ns=35)  # Rerr
+        (rec,) = st.drain()
+        assert rec["req_type"] == 2
+        assert rec["latency_ns"] == 25
+
+    def test_amqp_preamble_split_across_feeds(self):
+        from pixie_tpu.ingest.amqp_parser import AMQPStitcher
+
+        st = AMQPStitcher()
+        st.feed(1, b"AM", True, ts_ns=1)
+        st.feed(1, b"QP\x00\x00\x09\x01" + amqp_method(1, 50, 10), True,
+                ts_ns=2)
+        st.feed(1, amqp_method(1, 50, 11), False, ts_ns=9)
+        (rec,) = st.drain()
+        assert rec["method"] == "queue.declare"
+        assert rec["latency_ns"] == 7
+
+    def test_http2_rst_stream_reaps_state(self):
+        from pixie_tpu.ingest.http2_parser import HTTP2Stitcher
+
+        st = HTTP2Stitcher()
+        st.feed(1, b"PR", True, ts_ns=1)  # split preface too
+        st.feed(1, b"I * HTTP/2.0\r\n\r\nSM\r\n\r\n", True, ts_ns=2)
+        blk = b"\x82" + hpack_literal(":path", "/x")
+        st.feed(1, h2_frame(1, 0x5, 1, blk), True, ts_ns=10)
+        st.feed(1, h2_frame(3, 0, 1, b"\x00\x00\x00\x08"), True, ts_ns=20)
+        # The cancelled stream's response never comes; a new stream works.
+        st.feed(1, h2_frame(1, 0x5, 3, blk), True, ts_ns=30)
+        st.feed(1, h2_frame(1, 0x5, 3, b"\x88"), False, ts_ns=42)
+        (rec,) = st.drain()
+        assert rec["latency_ns"] == 12
+        assert st.parse_errors == 0

@@ -277,6 +277,29 @@ def _seed_all_tables(eng, n=3000, seed=11):
         "latency_ns": rng.integers(10**4, 10**8, n).astype(np.int64),
         "service": svcs,
     })
+    eng.append_data("nats_events.beta", {
+        "time_": t, "upid": upid,
+        "cmd": [("PUB", "MSG", "SUB", "PING")[i % 4] for i in range(n)],
+        "body": ['{"subject": "orders"}'] * n,
+        "resp": [("OK", "")[i % 2] for i in range(n)],
+        "latency_ns": rng.integers(10**3, 10**6, n).astype(np.int64),
+        "service": svcs,
+    })
+    eng.append_data("mux_events", {
+        "time_": t, "upid": upid,
+        "req_type": rng.choice([1, 2, 65], n).astype(np.int64),
+        "latency_ns": rng.integers(10**4, 10**8, n).astype(np.int64),
+        "service": svcs,
+    })
+    eng.append_data("amqp_events", {
+        "time_": t, "upid": upid,
+        "channel": rng.integers(1, 8, n),
+        "method": [("basic.publish", "basic.deliver", "queue.declare")[i % 3]
+                   for i in range(n)],
+        "resp": [""] * n,
+        "latency_ns": rng.integers(0, 10**6, n).astype(np.int64),
+        "service": svcs,
+    })
     eng.append_data("process_stats", {
         "time_": t, "upid": upid,
         "major_faults": rng.integers(0, 5, n),
