@@ -137,6 +137,16 @@ define_flag(
     "1 = disable and fold sequentially).",
 )
 define_flag(
+    "table_store_data_limit_mb", 1024 + 256,
+    "Byte budget across ALL canonical ingest tables (reference "
+    "PL_TABLE_STORE_DATA_LIMIT_MB, default 1.25GB); <= 0 = unbounded.",
+)
+define_flag(
+    "table_store_http_events_percent", 40,
+    "Percent of the table-store budget devoted to http_events "
+    "(reference PL_TABLE_STORE_HTTP_EVENTS_PERCENT).",
+)
+define_flag(
     "bus_secret", "",
     "Shared secret for netbus/broker bearer tokens; empty disables auth "
     "(single-trust-domain deployments).",

@@ -228,8 +228,50 @@ CANONICAL_SCHEMAS: dict[str, Relation] = {
 }
 
 
-def init_schemas(target) -> None:
+def table_budgets(memory_limit_mb: int | None = None) -> dict:
+    """{table: max_bytes} budget map (+ ``"*"`` default for non-canonical
+    tables) — the ``pem_manager.cc:86-104`` split as data: http_events
+    takes its percent, the rest divide the remainder evenly. Installed
+    on a TableStore (``table_budgets``) it bounds lazily-created tables
+    without pinning schemas."""
+    from ..config import get_flag
+
+    limit_mb = (
+        memory_limit_mb if memory_limit_mb is not None
+        else get_flag("table_store_data_limit_mb")
+    )
+    if limit_mb <= 0:
+        return {}
+    memory_limit = limit_mb * 1024 * 1024
+    # Clamp: >= 100 would zero (or, negative, UNBOUND) every other table.
+    http_pct = min(max(get_flag("table_store_http_events_percent"), 0), 95)
+    http_bytes = http_pct * memory_limit // 100
+    other = (memory_limit - http_bytes) // max(len(CANONICAL_SCHEMAS) - 1, 1)
+    out = {name: other for name in CANONICAL_SCHEMAS}
+    out["http_events"] = http_bytes
+    out["*"] = other
+    return out
+
+
+def init_schemas(target, memory_limit_mb: int | None = None) -> None:
     """Create every canonical table on an engine/table-store-like target
-    (``pem_manager.cc:86-104`` InitSchemas analog)."""
+    with the reference's byte-budget split (``pem_manager.cc:86-104``
+    InitSchemas): the ``table_store_data_limit_mb`` budget bounds ALL
+    tables, http_events takes ``table_store_http_events_percent`` of it
+    and the rest divide the remainder evenly. Each table's ring expires
+    its own oldest rows at its budget, so one chatty protocol can never
+    evict another's history — the backpressure is per-table by
+    construction."""
+    from ..config import get_flag
+
+    limit_mb = (
+        memory_limit_mb if memory_limit_mb is not None
+        else get_flag("table_store_data_limit_mb")
+    )
+    budgets = table_budgets(memory_limit_mb)
+    if not budgets:
+        for name, rel in CANONICAL_SCHEMAS.items():
+            target.create_table(name, rel)
+        return
     for name, rel in CANONICAL_SCHEMAS.items():
-        target.create_table(name, rel)
+        target.create_table(name, rel, max_bytes=budgets[name])

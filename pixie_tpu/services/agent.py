@@ -565,6 +565,17 @@ class PEMAgent(Agent):
     processes_data = True
     accepts_remote_sources = False
 
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        # The PEM's ingest is bounded by the table-store byte budget
+        # from the first append (pem_manager.cc:86-104 InitSchemas) —
+        # installed as lazy per-table budgets so synthetic/partial
+        # schemas in tests and tools still shape tables from their
+        # first append.
+        from ..ingest.schemas import table_budgets
+
+        self.engine.table_store.table_budgets = table_budgets()
+
 
 class KelvinAgent(Agent):
     """Compute-only merge agent (``kelvin_manager.h:31``)."""

@@ -25,6 +25,16 @@ class TableStore:
         self._ids: dict[int, str] = {}
         self._names_to_ids: dict[str, int] = {}
         self._next_id = 1
+        # Lazy per-table byte budgets: {name: max_bytes, "*": default}.
+        # Applied when a table is created with no explicit max_bytes —
+        # the PEM installs the pem_manager.cc InitSchemas split here so
+        # ingest is bounded without pre-pinning schemas.
+        self.table_budgets: dict = {}
+
+    def _budget_for(self, name: str, max_bytes: int) -> int:
+        if max_bytes != -1 or not self.table_budgets:
+            return max_bytes
+        return self.table_budgets.get(name, self.table_budgets.get("*", -1))
 
     def add_table(
         self,
@@ -40,7 +50,7 @@ class TableStore:
             t = Table(
                 name,
                 relation,
-                max_bytes=max_bytes,
+                max_bytes=self._budget_for(name, max_bytes),
                 compacted_rows=compacted_rows,
                 dicts=base.dicts if base is not None else None,
             )
@@ -65,7 +75,7 @@ class TableStore:
             existing = next(iter(self._tables.get(name, {}).values()), None)
             if existing is not None:
                 return existing
-            t = Table(name, relation, max_bytes=max_bytes)
+            t = Table(name, relation, max_bytes=self._budget_for(name, max_bytes))
             if device_window_rows is not None:
                 t.device_window_rows = device_window_rows
             self._tables.setdefault(name, {})[DEFAULT_TABLET] = t

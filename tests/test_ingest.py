@@ -330,3 +330,80 @@ class TestCaptureTap:
             " lat=('latency_ns', px.max))\npx.display(s)"
         )["output"].to_pydict()
         assert list(dns["n"]) == [1] and list(dns["lat"]) == [90]
+
+
+class TestTableStoreBudget:
+    """pem_manager.cc:86-104 InitSchemas parity: the table-store byte
+    budget splits across canonical tables (http_events gets its percent)
+    and each ring expires ITS OWN oldest rows at its share — one chatty
+    protocol can't evict another's history."""
+
+    def test_budget_split_and_ring_bound(self):
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.ingest.schemas import CANONICAL_SCHEMAS, init_schemas
+
+        from pixie_tpu.config import set_flag
+
+        set_flag("table_store_http_events_percent", 40)  # hermetic vs env
+        eng = Engine(window_rows=1 << 10)
+        try:
+            init_schemas(eng, memory_limit_mb=2)  # tiny: force expiry
+        finally:
+            set_flag("table_store_http_events_percent", 40)
+        http = eng.tables["http_events"]
+        dns = eng.tables["dns_events"]
+        assert http.max_bytes == 40 * 2 * 1024 * 1024 // 100
+        other = (2 * 1024 * 1024 - http.max_bytes) // (
+            len(CANONICAL_SCHEMAS) - 1
+        )
+        assert dns.max_bytes == other
+        # Flood dns_events far past its share: its ring stays bounded
+        # and only ITS rows expire.
+        n = 4096
+        for _ in range(12):
+            eng.append_data("dns_events", {
+                "time_": np.arange(n, dtype=np.int64),
+                "upid": np.stack([np.ones(n, np.uint64),
+                                  np.ones(n, np.uint64)], axis=1),
+                "req_header": ["x" * 16] * n,
+                "req_body": ["y" * 32] * n,
+                "resp_header": [""] * n,
+                "resp_body": [""] * n,
+                "latency_ns": np.ones(n, dtype=np.int64),
+                "pod": ["p"] * n,
+            })
+        st = dns.stats()
+        # The ring keeps at least the newest batch even when that batch
+        # alone exceeds the share; everything older expires.
+        assert st.num_batches == 1
+        assert st.batches_expired == 11
+        assert http.stats().batches_expired == 0
+
+    def test_unbounded_when_disabled(self):
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.ingest.schemas import init_schemas
+
+        eng = Engine()
+        init_schemas(eng, memory_limit_mb=0)
+        assert eng.tables["http_events"].max_bytes == -1
+
+    def test_pem_agent_tables_are_budgeted(self):
+        """PEM engines bound ingest from the first append — lazy budgets
+        on the table store (r5 review: the CLI path alone bounding
+        tables left long-running agents unbounded)."""
+        from pixie_tpu.services.agent import PEMAgent
+        from pixie_tpu.services.msgbus import MessageBus
+
+        pem = PEMAgent(MessageBus(), agent_id="pem-b")
+        pem.engine.append_data("http_events", {
+            "time_": np.arange(10, dtype=np.int64),
+            "latency_ns": np.ones(10, dtype=np.int64),
+        })
+        t = pem.engine.tables["http_events"]
+        assert t.max_bytes > 0
+        # Non-canonical (dynamic-trace) tables get the default share.
+        pem.engine.append_data("custom_probe", {
+            "time_": np.arange(4, dtype=np.int64),
+            "v": np.ones(4, dtype=np.int64),
+        })
+        assert pem.engine.tables["custom_probe"].max_bytes > 0
