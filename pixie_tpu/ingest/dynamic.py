@@ -18,6 +18,7 @@ schema publication all apply unchanged.
 from __future__ import annotations
 
 import os
+import struct
 import threading
 import time
 from dataclasses import dataclass
@@ -32,6 +33,46 @@ from .core import SourceConnector
 
 class TraceError(Exception):
     pass
+
+
+def native_probe_plan(binary_path: str, function: str) -> dict:
+    """Capture plan for probing a native function: the dwarvifier step
+    (reference ``dynamic_tracer/.../dwarvifier.h`` — resolve a probed
+    function's argument names/types/sizes/frame offsets from DWARF so a
+    tracepoint knows what to read where). Raises TraceError when the
+    binary has no debug info or the function is unknown.
+
+    Returns ``{"function", "address", "args": {name: {"type", "size",
+    "frame_offset"}}}`` — what an instrumentation backend (or an
+    operator inspecting a probe target) needs.
+    """
+    from ..utils.dwarf import DwarfError, DwarfReader
+
+    try:
+        reader = DwarfReader(binary_path)
+    except DwarfError as e:
+        raise TraceError(str(e)) from None
+    except (OSError, ValueError, struct.error, IndexError) as e:
+        # Missing file / truncated or corrupt ELF: same contract.
+        raise TraceError(f"{binary_path}: {e}") from None
+    fn = reader.functions.get(function)
+    if fn is None:
+        raise TraceError(
+            f"no DWARF subprogram {function!r} in {binary_path} "
+            f"(known: {sorted(reader.functions)[:12]})"
+        )
+    return {
+        "function": fn.name,
+        "address": fn.low_pc,
+        "args": {
+            a.name: {
+                "type": a.type_name,
+                "size": a.byte_size,
+                "frame_offset": a.frame_offset,
+            }
+            for a in fn.args
+        },
+    }
 
 
 @dataclass

@@ -262,12 +262,6 @@ def init_schemas(target, memory_limit_mb: int | None = None) -> None:
     its own oldest rows at its budget, so one chatty protocol can never
     evict another's history — the backpressure is per-table by
     construction."""
-    from ..config import get_flag
-
-    limit_mb = (
-        memory_limit_mb if memory_limit_mb is not None
-        else get_flag("table_store_data_limit_mb")
-    )
     budgets = table_budgets(memory_limit_mb)
     if not budgets:
         for name, rel in CANONICAL_SCHEMAS.items():

@@ -1,0 +1,131 @@
+"""DWARF reader tests: fixtures are compiled in-test with g++ -g, so the
+parser is exercised against the toolchain's real output (the reference's
+dwarf_reader_test.cc uses prebuilt -g binaries the same way)."""
+
+import subprocess
+import textwrap
+
+import pytest
+
+from pixie_tpu.utils.dwarf import DwarfError, DwarfReader
+
+FIXTURE_SRC = textwrap.dedent("""
+    struct conn_info {
+      long id;
+      int port;
+      char proto;
+      double rtt;
+    };
+
+    typedef long duration_ns;
+
+    extern "C" __attribute__((noinline))
+    long process_request(struct conn_info* conn, int status,
+                         duration_ns latency) {
+      return conn->id + status + latency;
+    }
+
+    extern "C" __attribute__((noinline)) double score(double a, float b) {
+      return a + b;
+    }
+
+    int main() {
+      struct conn_info c = {1, 80, 't', 0.5};
+      return (int)(process_request(&c, 200, 5) + score(1.0, 2.0f));
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def fixture_bin(tmp_path_factory):
+    d = tmp_path_factory.mktemp("dwarf")
+    src = d / "fix.c"
+    src.write_text(FIXTURE_SRC)
+    out = d / "fix"
+    try:
+        subprocess.run(
+            ["g++", "-g", "-O0", "-o", str(out), str(src)],
+            check=True, capture_output=True,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        pytest.skip("g++ unavailable")
+    return str(out)
+
+
+class TestDwarfReader:
+    def test_function_arg_info(self, fixture_bin):
+        r = DwarfReader(fixture_bin)
+        args = r.get_function_arg_info("process_request")
+        assert [a.name for a in args] == ["conn", "status", "latency"]
+        assert args[0].type_name == "struct conn_info*"
+        assert args[0].byte_size == 8
+        assert args[1].type_name == "int" and args[1].byte_size == 4
+        # typedef resolves to its name; underlying size survives.
+        assert args[2].type_name == "duration_ns"
+        assert args[2].byte_size == 8
+        # -O0 parameters live on the stack: fbreg offsets resolve.
+        assert all(a.frame_offset is not None for a in args)
+
+    def test_float_args(self, fixture_bin):
+        r = DwarfReader(fixture_bin)
+        a, b = r.get_function_arg_info("score")
+        assert (a.type_name, a.byte_size) == ("double", 8)
+        assert (b.type_name, b.byte_size) == ("float", 4)
+
+    def test_struct_layout(self, fixture_bin):
+        r = DwarfReader(fixture_bin)
+        spec = r.get_struct_spec("conn_info")
+        by = {m.name: m for m in spec}
+        assert by["id"].offset == 0 and by["id"].byte_size == 8
+        assert by["port"].offset == 8 and by["port"].byte_size == 4
+        assert by["proto"].offset == 12 and by["proto"].byte_size == 1
+        assert by["rtt"].offset == 16 and by["rtt"].type_name == "double"
+        m = r.get_struct_member_info("conn_info", "rtt")
+        assert m.offset == 16
+
+    def test_low_pc_matches_elf_symbol(self, fixture_bin):
+        from pixie_tpu.utils.elf import ELFReader
+
+        r = DwarfReader(fixture_bin)
+        e = ELFReader(fixture_bin)
+        assert r.functions["process_request"].low_pc == e.symbol_addr(
+            "process_request"
+        )
+
+    def test_missing_lookups_raise(self, fixture_bin):
+        r = DwarfReader(fixture_bin)
+        with pytest.raises(KeyError):
+            r.get_function_arg_info("nope")
+        with pytest.raises(KeyError):
+            r.get_struct_member_info("conn_info", "nope")
+        with pytest.raises(KeyError):
+            r.get_struct_spec("nope")
+
+    def test_non_debug_binary_raises(self, fixture_bin, tmp_path):
+        src = tmp_path / "nodbg.c"
+        src.write_text("int main(){return 0;}\n")
+        out = tmp_path / "nodbg"
+        subprocess.run(["g++", "-O1", "-o", str(out), str(src)],
+                       check=True, capture_output=True)
+        with pytest.raises(DwarfError, match="no DWARF"):
+            DwarfReader(str(out))
+
+
+class TestNativeProbePlan:
+    """The dwarvifier step: trace-spec resolution against a binary."""
+
+    def test_plan_resolves_args(self, fixture_bin):
+        from pixie_tpu.ingest.dynamic import native_probe_plan
+
+        plan = native_probe_plan(fixture_bin, "process_request")
+        assert plan["address"] > 0
+        assert set(plan["args"]) == {"conn", "status", "latency"}
+        assert plan["args"]["status"]["type"] == "int"
+        assert plan["args"]["latency"]["size"] == 8
+        assert plan["args"]["conn"]["frame_offset"] is not None
+
+    def test_unknown_function_raises(self, fixture_bin):
+        from pixie_tpu.ingest.dynamic import TraceError, native_probe_plan
+
+        with pytest.raises(TraceError, match="no DWARF subprogram"):
+            native_probe_plan(fixture_bin, "does_not_exist")

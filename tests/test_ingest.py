@@ -342,14 +342,14 @@ class TestTableStoreBudget:
         from pixie_tpu.exec.engine import Engine
         from pixie_tpu.ingest.schemas import CANONICAL_SCHEMAS, init_schemas
 
-        from pixie_tpu.config import set_flag
+        from pixie_tpu.config import clear_flag, set_flag
 
         set_flag("table_store_http_events_percent", 40)  # hermetic vs env
         eng = Engine(window_rows=1 << 10)
         try:
             init_schemas(eng, memory_limit_mb=2)  # tiny: force expiry
         finally:
-            set_flag("table_store_http_events_percent", 40)
+            clear_flag("table_store_http_events_percent")
         http = eng.tables["http_events"]
         dns = eng.tables["dns_events"]
         assert http.max_bytes == 40 * 2 * 1024 * 1024 // 100
