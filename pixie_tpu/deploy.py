@@ -128,8 +128,40 @@ def _wait_forever() -> None:
     stop.wait()
 
 
+def run_operator() -> int:
+    """Operator role: reconcile the deployment's roles as processes
+    (``src/operator/controllers`` analog — see services/operator.py).
+    Spec comes from PIXIE_TPU_OPERATOR_SPEC (a YAML file of
+    {role: replicas | {replicas, env}}); default is one of each role."""
+    from .services.operator import Reconciler, specs_from_config
+
+    spec_path = os.environ.get("PIXIE_TPU_OPERATOR_SPEC", "")
+    cfg = {"broker": 1, "pem": 1, "kelvin": 1}
+    if spec_path:
+        import yaml
+
+        with open(spec_path) as f:
+            loaded = yaml.safe_load(f)
+        if loaded is not None:
+            if not isinstance(loaded, dict):
+                print("[operator] spec must be a mapping of "
+                      "{role: replicas|{...}}", file=sys.stderr)
+                return 2
+            cfg = loaded
+    # Self-reference would recurse (children also strip the spec env).
+    cfg.pop("operator", None)
+    rec = Reconciler(specs_from_config(cfg))
+    rec.run_as_thread()
+    print(f"[operator] reconciling roles: "
+          f"{ {r: s.replicas for r, s in rec.specs.items()} }", flush=True)
+    _wait_forever()
+    rec.stop()
+    return 0
+
+
 def main(argv=None) -> int:
-    roles = {"broker": run_broker, "pem": run_pem, "kelvin": run_kelvin}
+    roles = {"broker": run_broker, "pem": run_pem, "kelvin": run_kelvin,
+             "operator": run_operator}
     args = argv if argv is not None else sys.argv[1:]
     if len(args) != 1 or args[0] not in roles:
         print(f"usage: python -m pixie_tpu.deploy {{{'|'.join(roles)}}}",

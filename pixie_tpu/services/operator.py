@@ -1,0 +1,222 @@
+"""Deployment operator: reconcile desired roles against live processes.
+
+Reference parity: the Pixie operator
+(``/root/reference/src/operator/controllers`` — a controller loop that
+reconciles a Vizier spec: deploys components, watches their health, and
+auto-recovers failed ones). There is no k8s API in this environment, so
+the reconciliation target is the process level: the same deploy roles
+``pixie_tpu.deploy`` exposes (broker / pem / kelvin), kept at their
+desired replica counts with crash restarts and exponential backoff —
+the failure-detection/recovery story for a deployment, above the
+per-query degraded-mesh handling inside the engine.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+def _terminate_and_reap(proc, timeout_s: float = 5.0) -> None:
+    """SIGTERM then wait — an unreaped child stays a zombie, which reads
+    as alive to liveness probes."""
+    if proc is None or proc.poll() is not None:
+        return
+    proc.terminate()
+    try:
+        proc.wait(timeout=timeout_s)
+    except Exception:
+        proc.kill()
+        try:
+            proc.wait(timeout=timeout_s)
+        except Exception:
+            pass
+
+
+@dataclass(frozen=True)
+class RoleSpec:
+    """Desired state for one role (the Vizier CR spec analog)."""
+
+    name: str
+    replicas: int = 1
+    #: Command argv; None = the in-repo deploy role entrypoint.
+    command: tuple | None = None
+    env: tuple = ()  # ((key, value), ...) extra environment
+
+    def argv(self) -> list:
+        if self.command is not None:
+            return list(self.command)
+        return [sys.executable, "-m", "pixie_tpu.deploy", self.name]
+
+
+@dataclass
+class _Instance:
+    proc: object = None
+    restarts: int = 0
+    backoff_until: float = 0.0
+    last_exit: int | None = None
+
+
+class Reconciler:
+    """One reconcile loop over {role -> RoleSpec}.
+
+    ``reconcile()`` is a single pass (the controller's Reconcile());
+    ``run_as_thread`` re-runs it on an interval. Replica reductions
+    terminate the highest indices first; crashed instances restart with
+    exponential backoff capped at ``max_backoff_s``.
+    """
+
+    def __init__(self, specs: dict | None = None,
+                 check_interval_s: float = 1.0,
+                 base_backoff_s: float = 0.5, max_backoff_s: float = 30.0,
+                 spawn=None):
+        self.specs: dict[str, RoleSpec] = dict(specs or {})
+        self.check_interval_s = check_interval_s
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._spawn = spawn or self._spawn_subprocess
+        self._instances: dict[tuple, _Instance] = {}  # (role, idx)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self.events: list[tuple] = []  # (ts, kind, role, idx)
+
+    @staticmethod
+    def _spawn_subprocess(spec: RoleSpec, idx: int):
+        import os
+
+        env = dict(os.environ)
+        # Children must never inherit the operator spec — a spec that
+        # (mis)lists the operator role would otherwise fork-bomb.
+        env.pop("PIXIE_TPU_OPERATOR_SPEC", None)
+        env.update(dict(spec.env))
+        env["PIXIE_TPU_REPLICA_INDEX"] = str(idx)
+        return subprocess.Popen(
+            spec.argv(), env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def apply(self, specs: dict) -> None:
+        """Replace the desired state (CR update); the next reconcile
+        converges to it."""
+        with self._lock:
+            self.specs = dict(specs)
+
+    _MAX_EVENTS = 1000
+
+    def _record(self, kind: str, role: str, idx: int) -> None:
+        self.events.append((time.time(), kind, role, idx))
+        if len(self.events) > self._MAX_EVENTS:
+            del self.events[: len(self.events) - self._MAX_EVENTS]
+
+    def _backoff(self, inst, now: float) -> None:
+        inst.backoff_until = now + min(
+            self.base_backoff_s * (2 ** min(inst.restarts, 16)),
+            self.max_backoff_s,
+        )
+
+    def reconcile(self) -> None:
+        now = time.monotonic()
+        to_reap = []
+        with self._lock:
+            desired = {
+                (r, i)
+                for r, spec in self.specs.items()
+                for i in range(max(spec.replicas, 0))
+            }
+            # Scale down / removed roles: terminate extras (reaping
+            # happens OUTSIDE the lock — SIGTERM-ignoring children must
+            # not stall status()/apply() callers).
+            for key in [k for k in self._instances if k not in desired]:
+                inst = self._instances.pop(key)
+                to_reap.append(inst.proc)
+                self._record("terminated", *key)
+            # Converge each desired instance.
+            for key in sorted(desired):
+                role, idx = key
+                inst = self._instances.setdefault(key, _Instance())
+                alive = inst.proc is not None and inst.proc.poll() is None
+                if alive:
+                    continue
+                if inst.proc is not None:
+                    # Record the crash ONCE; the dead Popen is dropped so
+                    # backoff passes don't re-record it.
+                    inst.last_exit = inst.proc.returncode
+                    inst.proc = None
+                    self._record("crashed", role, idx)
+                if now < inst.backoff_until:
+                    continue
+                try:
+                    inst.proc = self._spawn(self.specs[role], idx)
+                except Exception:
+                    # Bad command/spec: count it, back off — a silent
+                    # hot retry loop would hide the misconfiguration.
+                    inst.proc = None
+                    inst.restarts += 1
+                    self._record("spawn_failed", role, idx)
+                    self._backoff(inst, now)
+                    continue
+                first = inst.restarts == 0 and inst.last_exit is None
+                self._record("started" if first else "restarted", role, idx)
+                if not first:
+                    inst.restarts += 1
+                self._backoff(inst, now)
+        for proc in to_reap:
+            _terminate_and_reap(proc)
+
+    def status(self) -> list:
+        """Per-instance health (the operator's status subresource)."""
+        with self._lock:
+            out = []
+            for (role, idx), inst in sorted(self._instances.items()):
+                alive = inst.proc is not None and inst.proc.poll() is None
+                out.append({
+                    "role": role, "replica": idx, "alive": alive,
+                    "pid": getattr(inst.proc, "pid", None),
+                    "restarts": inst.restarts,
+                    "last_exit": inst.last_exit,
+                })
+            return out
+
+    # -- lifecycle -----------------------------------------------------------
+    def run_as_thread(self) -> threading.Thread:
+        self._thread = threading.Thread(
+            target=self._loop, name="operator", daemon=True
+        )
+        self._thread.start()
+        return self._thread
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.reconcile()
+            self._stop.wait(self.check_interval_s)
+
+    def stop(self, terminate: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if terminate:
+            with self._lock:
+                procs = [inst.proc for inst in self._instances.values()]
+            for proc in procs:
+                _terminate_and_reap(proc)
+
+
+def specs_from_config(cfg: dict) -> dict:
+    """{role: replicas|{replicas, command, env}} -> {role: RoleSpec}."""
+    out = {}
+    for role, v in cfg.items():
+        if isinstance(v, int):
+            out[role] = RoleSpec(name=role, replicas=v)
+        else:
+            out[role] = RoleSpec(
+                name=role,
+                replicas=int(v.get("replicas", 1)),
+                command=tuple(v["command"]) if v.get("command") else None,
+                env=tuple((k, str(val)) for k, val in
+                          (v.get("env") or {}).items()),
+            )
+    return out

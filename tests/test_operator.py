@@ -1,0 +1,135 @@
+"""Operator/reconciler tests (src/operator/controllers analog): crash
+recovery with backoff, scale up/down convergence, spec updates."""
+
+import subprocess
+import sys
+import time
+
+from pixie_tpu.services.operator import (
+    Reconciler,
+    RoleSpec,
+    specs_from_config,
+)
+
+#: A role whose process just sleeps — cheap and killable.
+SLEEPER = (sys.executable, "-c", "import time; time.sleep(60)")
+
+
+def _specs(**replicas):
+    return {r: RoleSpec(name=r, replicas=n, command=SLEEPER)
+            for r, n in replicas.items()}
+
+
+def _alive(rec, role=None):
+    return [s for s in rec.status()
+            if s["alive"] and (role is None or s["role"] == role)]
+
+
+class TestReconciler:
+    def test_converges_to_desired_replicas(self):
+        rec = Reconciler(_specs(pem=3, kelvin=1), base_backoff_s=0.01)
+        try:
+            rec.reconcile()
+            assert len(_alive(rec, "pem")) == 3
+            assert len(_alive(rec, "kelvin")) == 1
+            kinds = [e[1] for e in rec.events]
+            assert kinds.count("started") == 4
+        finally:
+            rec.stop()
+
+    def test_crash_restarts_with_backoff(self):
+        rec = Reconciler(_specs(pem=1), base_backoff_s=0.05,
+                         max_backoff_s=0.05)
+        try:
+            rec.reconcile()
+            (st,) = _alive(rec, "pem")
+            subprocess.run(["kill", "-9", str(st["pid"])], check=True)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                rec.reconcile()
+                alive = _alive(rec, "pem")
+                if alive and alive[0]["pid"] != st["pid"]:
+                    break
+                time.sleep(0.05)
+            (st2,) = _alive(rec, "pem")
+            assert st2["pid"] != st["pid"]
+            assert st2["restarts"] >= 1
+            assert "crashed" in [e[1] for e in rec.events]
+        finally:
+            rec.stop()
+
+    def test_scale_down_terminates_extras(self):
+        rec = Reconciler(_specs(pem=3), base_backoff_s=0.01)
+        try:
+            rec.reconcile()
+            assert len(_alive(rec, "pem")) == 3
+            rec.apply(_specs(pem=1))
+            rec.reconcile()
+            deadline = time.time() + 5
+            while time.time() < deadline and len(_alive(rec, "pem")) != 1:
+                time.sleep(0.05)
+            assert len(_alive(rec, "pem")) == 1
+            assert [e[1] for e in rec.events].count("terminated") == 2
+        finally:
+            rec.stop()
+
+    def test_role_removal_and_addition(self):
+        rec = Reconciler(_specs(pem=1), base_backoff_s=0.01)
+        try:
+            rec.reconcile()
+            rec.apply(_specs(kelvin=2))
+            rec.reconcile()
+            assert len(_alive(rec, "kelvin")) == 2
+            deadline = time.time() + 5
+            while time.time() < deadline and _alive(rec, "pem"):
+                time.sleep(0.05)
+            assert not _alive(rec, "pem")
+        finally:
+            rec.stop()
+
+    def test_stop_terminates_children(self):
+        rec = Reconciler(_specs(pem=2), base_backoff_s=0.01)
+        rec.reconcile()
+        pids = [s["pid"] for s in _alive(rec)]
+        rec.stop()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            gone = all(
+                subprocess.run(["kill", "-0", str(p)],
+                               capture_output=True).returncode != 0
+                for p in pids
+            )
+            if gone:
+                break
+            time.sleep(0.05)
+        assert gone
+
+
+class TestSpecsFromConfig:
+    def test_shapes(self):
+        specs = specs_from_config({
+            "pem": 3,
+            "broker": {"replicas": 1, "env": {"PIXIE_TPU_NETBUS_PORT": 6100}},
+            "custom": {"replicas": 2, "command": ["sleep", "1"]},
+        })
+        assert specs["pem"].replicas == 3
+        assert specs["pem"].command is None  # deploy-role entrypoint
+        assert dict(specs["broker"].env) == {"PIXIE_TPU_NETBUS_PORT": "6100"}
+        assert specs["custom"].argv() == ["sleep", "1"]
+        assert "pixie_tpu.deploy" in " ".join(specs["pem"].argv())
+
+    def test_spawn_failure_backs_off_and_records(self):
+        rec = Reconciler(
+            {"bad": RoleSpec("bad", replicas=1,
+                             command=("/no/such/binary-xyz",))},
+            base_backoff_s=10.0,
+        )
+        try:
+            rec.reconcile()
+            rec.reconcile()  # inside backoff: must not hot-retry
+            kinds = [e[1] for e in rec.events]
+            assert kinds.count("spawn_failed") == 1
+            (st,) = rec.status()
+            assert not st["alive"] and st["restarts"] == 1
+        finally:
+            rec.stop()
