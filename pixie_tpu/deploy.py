@@ -140,8 +140,13 @@ def run_operator() -> int:
     if spec_path:
         import yaml
 
-        with open(spec_path) as f:
-            loaded = yaml.safe_load(f)
+        try:
+            with open(spec_path) as f:
+                loaded = yaml.safe_load(f)
+        except OSError as e:
+            print(f"[operator] cannot read spec {spec_path}: {e}",
+                  file=sys.stderr)
+            return 2
         if loaded is not None:
             if not isinstance(loaded, dict):
                 print("[operator] spec must be a mapping of "
@@ -150,7 +155,12 @@ def run_operator() -> int:
             cfg = loaded
     # Self-reference would recurse (children also strip the spec env).
     cfg.pop("operator", None)
-    rec = Reconciler(specs_from_config(cfg))
+    try:
+        specs = specs_from_config(cfg)
+    except ValueError as e:
+        print(f"[operator] {e}", file=sys.stderr)
+        return 2
+    rec = Reconciler(specs)
     rec.run_as_thread()
     print(f"[operator] reconciling roles: "
           f"{ {r: s.replicas for r, s in rec.specs.items()} }", flush=True)

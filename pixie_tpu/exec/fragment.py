@@ -981,7 +981,11 @@ def _compile_agg(agg: AggOp, post, limit, apply_pre, rel1, dicts1, registry,
     # packing (engine._fold_agg_state_native).
     native_fold = None
     if dense_domains is not None and all(
-        ae.uda_name in ("count", "sum", "mean", "min", "max")
+        (
+            ae.uda_name in ("count", "sum", "mean", "min", "max")
+            or ae.uda_name == "quantiles"
+            or ae.uda_name.startswith("_quantile_")
+        )
         and len(arg_bound) == 1
         for ae, _uda, arg_bound, _casts in aggs_bound
     ):

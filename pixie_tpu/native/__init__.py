@@ -136,6 +136,29 @@ def seg_fold_call(gids, g: int, specs, vals, outs) -> bool:
     return True
 
 
+def tdigest_hist_call(gids, vals, g: int, shift: int, w, mw) -> bool:
+    """Accumulate the dual t-digest histogram for one window in place.
+
+    ``gids`` i32[n] (>= g rows skipped), ``vals`` f32[n] (non-finite
+    skipped, matching batch_to_digest's isfinite mask), ``w``/``mw``
+    f32[g * bins] tables; ``bin = monotone_u32(v) >> shift``."""
+    lib = load("seg_fold")
+    if lib is None:
+        return False
+    if str(gids.dtype) != "int32" or str(vals.dtype) != "float32":
+        return False
+    lib.tdigest_hist(
+        gids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_longlong(len(gids)), ctypes.c_longlong(g),
+        ctypes.c_int(shift),
+        w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        mw.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int(seg_fold_threads()),
+    )
+    return True
+
+
 def seg_fold_raw_call(key_planes, key_specs, lo: int, hi: int, g: int,
                       specs, vals, outs):
     """Raw-plane fold: slot ids computed in-kernel from the staged key

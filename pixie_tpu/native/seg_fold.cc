@@ -414,4 +414,69 @@ void seg_fold_raw(const void** keys, const uint8_t* key_kind,
   *oob_out = total;
 }
 
+// t-digest histogram fold: the quantile sketch's hot loop. Rows land
+// in B log-spaced bins per group via the order-monotone f32 bit
+// pattern (ops/tdigest.py batch_to_digest's transform, bit-exact), and
+// BOTH histograms (weight + weighted value) accumulate in one pass.
+// Accumulating the global histogram across all windows and compressing
+// ONCE at finalize does strictly less work than the XLA path's
+// per-window compress-and-merge, and loses no accuracy (histogram
+// addition is exact; compression is the only lossy step).
+void tdigest_hist(const int32_t* gids, const float* vals, long long n,
+                  long long g, int shift /* bin = u32(v) >> shift */,
+                  float* w, float* mw, int n_threads) {
+  const int64_t bins = int64_t(1) << (32 - shift);
+  const int64_t rows = g * bins;
+  if (n_threads < 1) n_threads = 1;
+  while (n_threads > 1 &&
+         int64_t(n_threads - 1) * rows * 8 > (int64_t(256) << 20)) {
+    n_threads /= 2;
+  }
+  // Per-thread locals must be zeroed AND merged (2 * rows floats per
+  // extra thread) every call: only worth it when the fold itself is
+  // bigger than that bookkeeping.
+  if (n < (int64_t(1) << 16) || n < rows) n_threads = 1;
+  auto fold = [&](int64_t lo, int64_t hi, float* wt, float* mwt) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int32_t gid = gids[i];
+      if (gid < 0 || gid >= g) continue;  // masked / trash rows
+      float v = vals[i];
+      if (!(v - v == 0.0f)) continue;  // NaN/inf: sketch is over finites
+      uint32_t u;
+      std::memcpy(&u, &v, 4);
+      u = (v < 0.0f) ? ~u : (u | 0x80000000u);
+      int64_t slot = int64_t(gid) * bins + int64_t(u >> shift);
+      wt[slot] += 1.0f;
+      mwt[slot] += v;
+    }
+  };
+  if (n_threads == 1) {
+    fold(0, n, w, mw);
+    return;
+  }
+  std::vector<std::vector<float>> locals(
+      size_t(n_threads - 1) * 2, std::vector<float>(rows, 0.0f));
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = int64_t(t) * chunk;
+    int64_t hi = std::min<int64_t>(lo + chunk, n);
+    if (lo >= hi) break;
+    threads.emplace_back([&, t, lo, hi]() {
+      float* wt = t == 0 ? w : locals[size_t(t - 1) * 2].data();
+      float* mwt = t == 0 ? mw : locals[size_t(t - 1) * 2 + 1].data();
+      fold(lo, hi, wt, mwt);
+    });
+  }
+  for (auto& th : threads) th.join();
+  for (int t = 1; t < n_threads; ++t) {
+    const float* wt = locals[size_t(t - 1) * 2].data();
+    const float* mwt = locals[size_t(t - 1) * 2 + 1].data();
+    for (int64_t i = 0; i < rows; ++i) {
+      w[i] += wt[i];
+      mw[i] += mwt[i];
+    }
+  }
+}
+
 }  // extern "C"

@@ -58,6 +58,7 @@ class _Instance:
     restarts: int = 0
     backoff_until: float = 0.0
     last_exit: int | None = None
+    started_at: float = 0.0
 
 
 class Reconciler:
@@ -72,11 +73,16 @@ class Reconciler:
     def __init__(self, specs: dict | None = None,
                  check_interval_s: float = 1.0,
                  base_backoff_s: float = 0.5, max_backoff_s: float = 30.0,
-                 spawn=None):
+                 healthy_reset_s: float = 600.0, spawn=None):
         self.specs: dict[str, RoleSpec] = dict(specs or {})
         self.check_interval_s = check_interval_s
         self.base_backoff_s = base_backoff_s
         self.max_backoff_s = max_backoff_s
+        # A crash after this much healthy running resets the backoff
+        # ladder (the k8s CrashLoopBackOff reset the docstring's parity
+        # claim implies) — otherwise a once-a-day crasher escalates to
+        # worst-case recovery latency forever.
+        self.healthy_reset_s = healthy_reset_s
         self._spawn = spawn or self._spawn_subprocess
         self._instances: dict[tuple, _Instance] = {}  # (role, idx)
         self._lock = threading.Lock()
@@ -120,7 +126,7 @@ class Reconciler:
 
     def reconcile(self) -> None:
         now = time.monotonic()
-        to_reap = []
+        to_reap, to_spawn = [], []
         with self._lock:
             desired = {
                 (r, i)
@@ -134,7 +140,8 @@ class Reconciler:
                 inst = self._instances.pop(key)
                 to_reap.append(inst.proc)
                 self._record("terminated", *key)
-            # Converge each desired instance.
+            # Decide which instances need (re)spawning; the fork+exec
+            # itself also happens OUTSIDE the lock.
             for key in sorted(desired):
                 role, idx = key
                 inst = self._instances.setdefault(key, _Instance())
@@ -143,24 +150,44 @@ class Reconciler:
                     continue
                 if inst.proc is not None:
                     # Record the crash ONCE; the dead Popen is dropped so
-                    # backoff passes don't re-record it.
+                    # backoff passes don't re-record it. A crash after a
+                    # long healthy run resets the backoff ladder.
                     inst.last_exit = inst.proc.returncode
                     inst.proc = None
+                    if (inst.started_at
+                            and now - inst.started_at > self.healthy_reset_s):
+                        inst.restarts = 0
                     self._record("crashed", role, idx)
                 if now < inst.backoff_until:
                     continue
-                try:
-                    inst.proc = self._spawn(self.specs[role], idx)
-                except Exception:
+                # Claim the slot so a concurrent reconcile can't double-
+                # spawn; the real backoff replaces this after the spawn.
+                inst.backoff_until = now + 3600.0
+                to_spawn.append((key, self.specs[role]))
+        spawned = []
+        for key, spec in to_spawn:
+            try:
+                spawned.append((key, self._spawn(spec, key[1])))
+            except Exception:
+                spawned.append((key, None))
+        with self._lock:
+            for key, proc in spawned:
+                inst = self._instances.get(key)
+                if inst is None:  # scaled away while spawning
+                    if proc is not None:
+                        to_reap.append(proc)
+                    continue
+                if proc is None:
                     # Bad command/spec: count it, back off — a silent
                     # hot retry loop would hide the misconfiguration.
-                    inst.proc = None
                     inst.restarts += 1
-                    self._record("spawn_failed", role, idx)
+                    self._record("spawn_failed", *key)
                     self._backoff(inst, now)
                     continue
+                inst.proc = proc
+                inst.started_at = now
                 first = inst.restarts == 0 and inst.last_exit is None
-                self._record("started" if first else "restarted", role, idx)
+                self._record("started" if first else "restarted", *key)
                 if not first:
                     inst.restarts += 1
                 self._backoff(inst, now)
@@ -206,9 +233,17 @@ class Reconciler:
 
 
 def specs_from_config(cfg: dict) -> dict:
-    """{role: replicas|{replicas, command, env}} -> {role: RoleSpec}."""
+    """{role: replicas|{replicas, command, env}} -> {role: RoleSpec}.
+
+    Raises ValueError with a readable message on malformed entries (a
+    bare 'role:' line parses to None; replicas must be ints)."""
     out = {}
     for role, v in cfg.items():
+        if isinstance(v, bool) or not isinstance(v, (int, dict)):
+            raise ValueError(
+                f"operator spec: role {role!r} must map to an int replica "
+                f"count or a mapping, got {type(v).__name__}"
+            )
         if isinstance(v, int):
             out[role] = RoleSpec(name=role, replicas=v)
         else:

@@ -256,3 +256,52 @@ class TestNativeFoldEdgeCases:
             m = sc == svcs.index(s)
             assert int(sv) == int(lat[m].sum())
             assert int(nv) == int(m.sum())
+
+
+class TestNativeDigestFold:
+    """The native dual-histogram t-digest path (one global histogram,
+    one compress) agrees with the XLA per-window fold within sketch
+    tolerance, and exactly on counts."""
+
+    def test_quantiles_match_xla_fold(self):
+        eng, cols, svcs = _mk_engine(n=60_000, seed=5)
+        q = ("import px\ndf = px.DataFrame(table='t')\n"
+             "out = df.groupby('svc').agg(p=('lat', px.quantiles),"
+             " n=('lat', px.count))\n"
+             "out.p50 = px.pluck_float64(out.p, 'p50')\n"
+             "out.p99 = px.pluck_float64(out.p, 'p99')\n"
+             "out = out[['svc', 'p50', 'p99', 'n']]\npx.display(out)")
+        native = eng.execute_query(q)["output"].to_pydict()
+        set_flag("cpu_fold_threads", 1)
+        try:
+            xla = eng.execute_query(q)["output"].to_pydict()
+        finally:
+            set_flag("cpu_fold_threads", 0)
+        on, ox = np.argsort(native["svc"]), np.argsort(xla["svc"])
+        assert np.array_equal(native["n"][on], xla["n"][ox])
+        np.testing.assert_allclose(native["p50"][on], xla["p50"][ox],
+                                   rtol=0.05)
+        np.testing.assert_allclose(native["p99"][on], xla["p99"][ox],
+                                   rtol=0.05)
+        # Both within the true distribution's range per group.
+        sc, lat = cols["svc"][0], cols["lat"][0]
+        for s, p50 in zip(np.array(native["svc"])[on], native["p50"][on]):
+            m = sc == svcs.index(s)
+            assert lat[m].min() <= p50 <= lat[m].max()
+
+    def test_windowed_quantiles_script_path(self):
+        """service_let-style windowed quantiles run through the digest
+        fold (strided dense window keys + sketch aggs together)."""
+        eng, cols, svcs = _mk_engine(n=40_000, seed=6)
+        got = eng.execute_query("""
+import px
+df = px.DataFrame(table='t')
+df.wnd = px.bin(df.time_, px.DurationNanos(10000000000))
+out = df.groupby(['svc', 'wnd']).agg(
+    p=('lat', px.quantiles), n=('lat', px.count))
+out.p50 = px.pluck_float64(out.p, 'p50')
+out = out[['svc', 'wnd', 'p50', 'n']]
+px.display(out)
+""", max_output_rows=100_000)["output"].to_pydict()
+        assert int(np.sum(got["n"])) == 40_000
+        assert (got["p50"] > 0).all()
