@@ -143,7 +143,7 @@ def run_operator() -> int:
         try:
             with open(spec_path) as f:
                 loaded = yaml.safe_load(f)
-        except OSError as e:
+        except (OSError, yaml.YAMLError) as e:
             print(f"[operator] cannot read spec {spec_path}: {e}",
                   file=sys.stderr)
             return 2

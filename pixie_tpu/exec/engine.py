@@ -415,9 +415,25 @@ class Engine:
                 results[nid] = bind_bridge(bridge_inputs[op.bridge_id])
             else:
                 raise QueryError(f"unsupported operator {op}")
-            # Fan-out of a stream: materialize once, share the batch.
+            # Fan-out of a stream: materialize once, share the batch —
+            # EXCEPT pure table scans (empty/column-select chains over
+            # table sources): their windows are device-cache-resident,
+            # so each consumer re-scanning them is free, while a
+            # materialize would round-trip the whole table through host
+            # memory. (Consumers then fold against the table as it is
+            # when THEY run — the same snapshot caveat DeviceResult
+            # documents for rebuckets.)
             if consumers.get(nid, 0) > 1 and isinstance(results[nid], _Stream):
-                results[nid] = self._materialize(results[nid])
+                st = results[nid]
+                from .fragment import _pure_select_map
+
+                pure_scan = (
+                    isinstance(st.source, list)
+                    and not st.side
+                    and _pure_select_map(st.chain) is not None
+                )
+                if not pure_scan:
+                    results[nid] = self._materialize(st)
         return outputs
 
     def export_otel(self, payload: dict, endpoint) -> None:

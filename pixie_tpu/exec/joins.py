@@ -292,13 +292,26 @@ def _join_host(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
 
 
 def _join_host_nm(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
-    """Vectorized N:M inner/left equijoin on host (numpy sort+searchsorted)
-    — the CPU-backend analog of the device kernel (XLA CPU sorts are too
-    slow to route big joins through the device path there)."""
+    """N:M inner/left equijoin on host — the CPU-backend analog of the
+    device kernel (XLA CPU sorts are too slow to route big joins through
+    the device path there). The native O(n) build+probe hash join
+    (native/hash_join.cc) carries the bulk; the vectorized numpy
+    sort/searchsorted form is the no-toolchain fallback."""
     l_remap, r_remap, _ = _align_join_dicts(left, right, op)
     lk = _packed_key_ids(left, op.left_on, l_remap,
                          right, op.right_on, r_remap)
     lkeys, rkeys = lk
+
+    from ..native import hash_join_call
+
+    if len(rkeys) and len(lkeys):
+        native = hash_join_call(rkeys, lkeys, left_outer=(op.how == "left"))
+        if native is not None:
+            l_idx, r_idx = native
+            return _assemble_join_host(
+                left, right, op,
+                l_idx.astype(np.int64), r_idx.astype(np.int64),
+            )
     order = np.argsort(rkeys, kind="stable")
     span = 0
     if len(rkeys) and len(lkeys):

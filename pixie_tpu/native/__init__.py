@@ -159,6 +159,49 @@ def tdigest_hist_call(gids, vals, g: int, shift: int, w, mw) -> bool:
     return True
 
 
+def hash_join_call(build_keys, probe_keys, left_outer: bool):
+    """(l_idx, r_idx) i32 arrays for an N:M equijoin over packed i64
+    keys, or None when the native library is unavailable. r_idx is -1
+    for unmatched probes kept by ``left_outer``."""
+    lib = load("hash_join")
+    if lib is None:
+        return None
+    bk = np.ascontiguousarray(build_keys, dtype=np.int64)
+    pk = np.ascontiguousarray(probe_keys, dtype=np.int64)
+    if len(bk) > (1 << 31) - 2 or len(pk) > (1 << 31) - 2:
+        return None  # i32 row-index outputs
+    args = [
+        bk.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        ctypes.c_longlong(len(bk)),
+        pk.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        ctypes.c_longlong(len(pk)),
+        ctypes.c_int(1 if left_outer else 0),
+    ]
+    lib.hash_join.restype = ctypes.c_longlong
+    # Speculative capacity: 1:1/N:1 joins (the common case) fit in
+    # len(pk) pairs, finishing in ONE build+probe; only a fan-out
+    # blowup pays the second call at the exact size.
+    cap = max(len(pk), 1)
+    l_idx = np.empty(cap, dtype=np.int32)
+    r_idx = np.empty(cap, dtype=np.int32)
+    total = lib.hash_join(
+        *args,
+        l_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        r_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_longlong(cap),
+    )
+    if total > cap:
+        l_idx = np.empty(total, dtype=np.int32)
+        r_idx = np.empty(total, dtype=np.int32)
+        lib.hash_join(
+            *args,
+            l_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            r_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_longlong(total),
+        )
+    return l_idx[:total], r_idx[:total]
+
+
 def seg_fold_raw_call(key_planes, key_specs, lo: int, hi: int, g: int,
                       specs, vals, outs):
     """Raw-plane fold: slot ids computed in-kernel from the staged key

@@ -247,9 +247,16 @@ def specs_from_config(cfg: dict) -> dict:
         if isinstance(v, int):
             out[role] = RoleSpec(name=role, replicas=v)
         else:
+            try:
+                replicas = int(v.get("replicas", 1))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"operator spec: role {role!r} replicas must be an "
+                    f"int, got {v.get('replicas')!r}"
+                ) from None
             out[role] = RoleSpec(
                 name=role,
-                replicas=int(v.get("replicas", 1)),
+                replicas=replicas,
                 command=tuple(v["command"]) if v.get("command") else None,
                 env=tuple((k, str(val)) for k, val in
                           (v.get("env") or {}).items()),
