@@ -302,3 +302,105 @@ def test_degraded_mesh_agent_loss_mid_stream(engines):
     r2 = _sorted_rows(dist.execute_plan(plan)["out"])
     assert dist.last_distributed_plan is not None
     _assert_rows_close(r1, r2)
+
+
+def test_bridge_merge_realistic_group_counts():
+    """Netbus bridge path at realistic cardinality: three agents ship
+    partial-agg states with ~50K string groups and DIVERGENT
+    dictionaries; the kelvin-tier merge must realign ids and produce
+    exact counts (r4 weak #4: the bridge had only toy-group coverage)."""
+    from pixie_tpu.exec.engine import Engine
+    from pixie_tpu.exec.plan import (
+        AggExpr, AggOp, BridgeSinkOp, BridgeSourceOp, MemorySourceOp,
+        Plan, ResultSinkOp,
+    )
+    from pixie_tpu.services.wire import decode, encode
+
+    n_per_agent, n_keys = 200_000, 50_000
+    payloads = []
+    totals = {}
+    for a in range(3):
+        rng = np.random.default_rng(100 + a)
+        # Each agent sees its own (shifted, shuffled) key universe, so
+        # id spaces disagree across agents.
+        keys = [f"user-{(i * 7 + a * 13) % n_keys}" for i in
+                rng.integers(0, n_keys, n_per_agent)]
+        eng = Engine(window_rows=1 << 15)
+        eng.append_data("events", {
+            "time_": np.arange(n_per_agent, dtype=np.int64),
+            "k": keys,
+            "v": np.ones(n_per_agent, dtype=np.int64),
+        })
+        for k in keys:
+            totals[k] = totals.get(k, 0) + 1
+        p = Plan()
+        src = p.add(MemorySourceOp(table="events"))
+        agg = p.add(AggOp(("k",), (AggExpr("n", "count", (ColumnRef("v"),)),),
+                          mode="partial"), [src])
+        p.add(BridgeSinkOp(bridge_id=1), [agg])
+        out = eng.execute_plan(p)
+        # Round-trip the payload through the wire codec — the exact
+        # bytes-on-the-netbus path.
+        payloads.append(decode(encode(out[("bridge", 1)])))
+
+    kelvin = Engine(window_rows=1 << 15)
+    mp = Plan()
+    bsrc = mp.add(BridgeSourceOp(bridge_id=1))
+    fin = mp.add(
+        AggOp(("k",), (AggExpr("n", "count", (ColumnRef("v"),)),),
+              mode="finalize"),
+        [bsrc],
+    )
+    mp.add(ResultSinkOp("out"), [fin])
+    merged = kelvin.execute_plan(mp, bridge_inputs={1: payloads})
+    got = merged["out"].to_pydict()
+    assert len(got["k"]) == len(totals)
+    got_map = dict(zip(got["k"], got["n"].tolist()))
+    assert got_map == totals
+
+
+def test_distributed_engine_streaming_live_query(engines):
+    """A live (streaming) query over the mesh engine: incremental
+    updates keep matching the table state as rows arrive."""
+    from pixie_tpu.exec.streaming import stream_query
+
+    _single, _dist = engines
+    dist = DistributedEngine(window_rows=4096, mesh=agent_mesh(8))
+    rng = np.random.default_rng(2)
+    updates = []
+
+    def emit(u):
+        updates.append(u)
+
+    n0 = 6000
+    d0 = {
+        "time_": np.arange(n0, dtype=np.int64),
+        "v": rng.integers(0, 5, n0),
+    }
+    dist.append_data("s", d0)
+    lq = stream_query(
+        dist,
+        "import px\ndf = px.DataFrame(table='s')\n"
+        "out = df.groupby('v').agg(n=('v', px.count))\npx.display(out)",
+        emit,
+    )
+    try:
+        lq.poll()
+        assert updates, "no initial update"
+        got = updates[-1].batch.to_pydict()
+        import collections
+
+        want = collections.Counter(d0["v"].tolist())
+        assert dict(zip(got["v"].tolist(), got["n"].tolist())) == dict(want)
+        # Rows arrive; the next poll's replace update covers them.
+        extra = {
+            "time_": np.arange(n0, n0 + 2000, dtype=np.int64),
+            "v": rng.integers(0, 5, 2000),
+        }
+        dist.append_data("s", extra)
+        want.update(collections.Counter(extra["v"].tolist()))
+        lq.poll()
+        got = updates[-1].batch.to_pydict()
+        assert dict(zip(got["v"].tolist(), got["n"].tolist())) == dict(want)
+    finally:
+        pass  # poll-driven cursor: nothing to cancel
