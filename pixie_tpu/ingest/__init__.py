@@ -13,15 +13,28 @@ C ABI the table store exposes (``pixie_tpu/native/table_ring.cc``).
 
 from .core import DataTable, FrequencyManager, SourceConnector
 from .collector import Collector
-from .connectors import ProcessStatsConnector, SeqGenConnector
+from .connectors import (
+    NetworkStatsConnector,
+    PIDRuntimeConnector,
+    ProcExitConnector,
+    ProcStatConnector,
+    ProcessStatsConnector,
+    SeqGenConnector,
+    StirlingErrorConnector,
+)
 from .replay import gen_http_events, replay_into
 
 __all__ = [
     "Collector",
     "DataTable",
     "FrequencyManager",
+    "NetworkStatsConnector",
+    "PIDRuntimeConnector",
+    "ProcExitConnector",
+    "ProcStatConnector",
     "ProcessStatsConnector",
     "SeqGenConnector",
+    "StirlingErrorConnector",
     "gen_http_events",
     "replay_into",
 ]

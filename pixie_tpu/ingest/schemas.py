@@ -195,6 +195,51 @@ AMQP_EVENTS_RELATION = Relation(
     ]
 )
 
+# proc_stat_connector.h kElements (system-wide CPU split, sampled by
+# diffing the aggregate cpu jiffies line of /proc/stat).
+PROC_STAT_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("system_percent", DataType.FLOAT64),
+        ("user_percent", DataType.FLOAT64),
+        ("idle_percent", DataType.FLOAT64),
+    ]
+)
+
+# pid_runtime_connector.h kTable — the reference keeps the BPF-era name
+# "bcc_pid_cpu_usage" for the table even though the gauge is generic.
+PID_RUNTIME_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("pid", DataType.INT64),
+        ("runtime_ns", DataType.INT64),
+        ("cmd", DataType.STRING),
+    ]
+)
+
+# proc_exit_events_table.h kProcExitEventsTable.
+PROC_EXIT_EVENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("exit_code", DataType.INT64),
+        ("signal", DataType.INT64),
+        ("comm", DataType.STRING),
+    ]
+)
+
+# stirling_error_table.h kStirlingErrorElements (self-observability:
+# connector install status + runtime collection errors).
+STIRLING_ERROR_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("upid", DataType.UINT128),
+        ("source_connector", DataType.STRING),
+        ("status", DataType.INT64),
+        ("error", DataType.STRING),
+    ]
+)
+
 # dns_table.h kDNSTable (subset).
 DNS_EVENTS_RELATION = Relation(
     [
@@ -225,6 +270,10 @@ CANONICAL_SCHEMAS: dict[str, Relation] = {
     "process_stats": PROCESS_STATS_RELATION,
     "network_stats": NETWORK_STATS_RELATION,
     "dns_events": DNS_EVENTS_RELATION,
+    "proc_stat": PROC_STAT_RELATION,
+    "bcc_pid_cpu_usage": PID_RUNTIME_RELATION,
+    "proc_exit_events": PROC_EXIT_EVENTS_RELATION,
+    "stirling_error": STIRLING_ERROR_RELATION,
 }
 
 

@@ -1,0 +1,52 @@
+"""TPU-shaped prefix sums.
+
+XLA:TPU lowers a 1-D 64-bit ``cumsum`` to a variadic reduce-window over
+(hi, lo) u32 pairs and stages the ENTIRE operand in scoped vmem — at
+multi-million-row windows that is a guaranteed compile failure
+("Scoped allocation ... exceeded scoped vmem limit", seen at 64 MiB vs
+the 16 MiB cap). The classic two-level blocked scan sidesteps it:
+chunk-local cumsums tile over the major axis (each row is one vmem-
+resident lane), and only the tiny chunk-totals vector takes the scalar
+scan. Integer wraparound keeps every step exact, so the blocked form is
+bit-identical to the flat one.
+
+Reference parity: this replaces the per-group accumulation loops of
+``src/carnot/exec/agg_node.cc`` (value-wise adds into hash-table slots)
+for the sorted-segment reduction strategy documented in
+``udf/builtins/math_ops.py``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+#: Chunk width: one (rows, _CHUNK) i64 row = 64 KiB, comfortably inside
+#: a vmem tile; reduce-window then scans the minor axis per-row.
+_CHUNK = 8192
+
+#: Flat cumsum below this length compiles fine (operand fits scoped
+#: vmem with slack) and avoids the reshape/pad round-trip.
+_FLAT_MAX = 1 << 17
+
+
+def blocked_cumsum(x: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive 1-D cumsum, exact for integers, safe to compile on TPU
+    at any length. Equals ``jnp.cumsum(x)`` elementwise (integer
+    wraparound included); floats get the same association order as the
+    blocked scan, so use it for integer dtypes when bit-exactness vs the
+    flat form matters."""
+    (n,) = x.shape
+    if n <= _FLAT_MAX or np.dtype(x.dtype).itemsize <= 4:
+        return jnp.cumsum(x)
+    c = -(-n // _CHUNK)
+    pad = c * _CHUNK - n
+    x2 = jnp.pad(x, (0, pad)).reshape(c, _CHUNK)
+    within = jnp.cumsum(x2, axis=1)
+    # Exclusive prefix of the chunk totals: a length-c scan (c = n/8192),
+    # small enough for the flat lowering.
+    totals = within[:, -1]
+    prefix = jnp.concatenate(
+        [jnp.zeros(1, x.dtype), jnp.cumsum(totals)[:-1]]
+    )
+    return (within + prefix[:, None]).reshape(-1)[:n]

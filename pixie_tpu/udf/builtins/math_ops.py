@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...ops.scan import blocked_cumsum
 from ..udf import BOOLEAN, FLOAT64, INT64, STRING, TIME64NS
 
 
@@ -141,8 +142,11 @@ def register(reg):
             )[:-1]
         order, _sg, ends = _seg_order(gids, mask, g)
         contrib = jnp.where(mask, v, jnp.zeros((), v.dtype))[order]
+        # blocked_cumsum: XLA:TPU cannot compile a flat multi-million-row
+        # i64 cumsum (scoped-vmem overflow in the u32-pair reduce-window
+        # lowering); the two-level blocked scan is bit-identical.
         cs0 = jnp.concatenate(
-            [jnp.zeros(1, contrib.dtype), jnp.cumsum(contrib)]
+            [jnp.zeros(1, contrib.dtype), blocked_cumsum(contrib)]
         )
         tot = cs0[ends]  # cumulative sum up to each segment's end
         return carry + tot - jnp.concatenate(

@@ -94,6 +94,93 @@ class TestCollector:
         assert "sequences" in col.schemas()
         assert col.schemas()["sequences"].has_column("fibonacci")
 
+    def test_proc_stat(self):
+        from pixie_tpu.ingest import ProcStatConnector
+
+        e = Engine()
+        col = Collector()
+        col.register_source(ProcStatConnector(sampling_period_s=0.0,
+                                              push_period_s=0.0))
+        col.wire_to(e)
+        col.run_core(once=True)  # baseline sample: no row yet
+        time.sleep(0.05)  # let some jiffies elapse for a non-zero delta
+        col.run_core(once=True)
+        col.flush()
+        d = e.tables["proc_stat"].read_all().to_pydict()
+        assert len(d["time_"]) == 1
+        for c in ("system_percent", "user_percent", "idle_percent"):
+            assert 0.0 <= d[c][0] <= 100.0
+
+    def test_pid_runtime(self):
+        from pixie_tpu.ingest import PIDRuntimeConnector
+
+        e = Engine()
+        col = Collector()
+        col.register_source(PIDRuntimeConnector(sampling_period_s=0.0,
+                                                push_period_s=0.0))
+        col.wire_to(e)
+        col.run_core(once=True)
+        col.flush()
+        d = e.tables["bcc_pid_cpu_usage"].read_all().to_pydict()
+        assert 1 in list(d["pid"])  # init is always there
+        assert all(v >= 0 for v in d["runtime_ns"])
+        assert all(c for c in d["cmd"])
+
+    def test_proc_exit_detects_vanished_pid(self):
+        import subprocess
+
+        from pixie_tpu.ingest import ProcExitConnector
+
+        e = Engine()
+        col = Collector()
+        c = ProcExitConnector(sampling_period_s=0.0, push_period_s=0.0)
+        col.register_source(c)
+        col.wire_to(e)
+        child = subprocess.Popen(["sleep", "30"])
+        col.run_core(once=True)  # baseline scan includes the child
+        assert child.pid in c._seen
+        child.kill()
+        child.wait()
+        col.run_core(once=True)  # child vanished -> exit event
+        col.flush()
+        d = e.tables["proc_exit_events"].read_all().to_pydict()
+        assert "sleep" in list(d["comm"])
+        i = list(d["comm"]).index("sleep")
+        # procfs can't see the exit status: both report unknown.
+        assert d["exit_code"][i] == -1 and d["signal"][i] == -1
+        # the UPID's pid plane carries the real pid
+        assert (int(d["upid"][i][0]) & 0xFFFFFFFF) == child.pid
+
+    def test_stirling_error_reports_status_and_failures(self):
+        from pixie_tpu.ingest import StirlingErrorConnector
+
+        class Exploding(SeqGenConnector):
+            name = "exploding"
+
+            def transfer_data(self, ctx, data_tables):
+                raise RuntimeError("boom")
+
+        e = Engine()
+        col = Collector()
+        col.register_source(SeqGenConnector(sampling_period_s=0.0,
+                                            push_period_s=0.0))
+        col.register_source(Exploding(sampling_period_s=0.0,
+                                      push_period_s=0.0))
+        col.register_source(StirlingErrorConnector(sampling_period_s=0.0,
+                                                   push_period_s=0.0))
+        col.wire_to(e)
+        col.run_core(once=True)
+        col.run_core(once=True)  # second pass sees the recorded error
+        col.flush()
+        d = e.tables["stirling_error"].read_all().to_pydict()
+        by = dict(zip(d["source_connector"], d["status"]))
+        assert by["seq_gen"] == 0  # install-status row
+        rows = list(zip(d["source_connector"], d["status"], d["error"]))
+        failures = [r for r in rows if r[0] == "exploding" and r[1] == 2]
+        assert failures and "boom" in failures[0][2]
+        # one status row per connector, no duplicates across cycles
+        assert sum(1 for r in rows if r[0] == "seq_gen" and r[1] == 0) == 1
+
 
 class TestReplay:
     def test_replay_roundtrip_and_query(self):

@@ -337,6 +337,31 @@ def _seed_all_tables(eng, n=3000, seed=11):
         "latency_ns": rng.integers(10**4, 10**7, n).astype(np.int64),
         "pod": pods,
     })
+    eng.append_data("proc_stat", {
+        "time_": t,
+        "system_percent": rng.uniform(0, 30, n),
+        "user_percent": rng.uniform(0, 60, n),
+        "idle_percent": rng.uniform(10, 100, n),
+    })
+    eng.append_data("bcc_pid_cpu_usage", {
+        "time_": t,
+        "pid": rng.integers(1, 50, n).astype(np.int64),
+        "runtime_ns": rng.integers(0, 10**10, n).astype(np.int64),
+        "cmd": [f"proc-{i % 12}" for i in range(n)],
+    })
+    eng.append_data("proc_exit_events", {
+        "time_": t, "upid": upid,
+        "exit_code": rng.choice([-1, 0, 1, 137], n).astype(np.int64),
+        "signal": rng.choice([-1, 9, 15], n).astype(np.int64),
+        "comm": [f"proc-{i % 12}" for i in range(n)],
+    })
+    eng.append_data("stirling_error", {
+        "time_": t, "upid": upid,
+        "source_connector": [("seq_gen", "proc_stat", "tap")[i % 3]
+                             for i in range(n)],
+        "status": rng.choice([0, 0, 0, 2], n).astype(np.int64),
+        "error": [("", "RuntimeError('boom')")[i % 2] for i in range(n)],
+    })
 
 
 @pytest.fixture(scope="module")

@@ -431,3 +431,36 @@ class TestSemanticTypes:
                 "import px\ndf = px.DataFrame(table='t')\n"
                 "df.x = df.ctx['nope']\npx.display(df)"
             )
+
+
+class TestBlockedCumsum:
+    """ops/scan.py: the TPU-compilable two-level prefix sum must be
+    bit-identical to the flat jnp.cumsum for integers."""
+
+    def test_matches_flat_i64_with_wraparound(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pixie_tpu.ops.scan import _FLAT_MAX, blocked_cumsum
+
+        rng = np.random.default_rng(3)
+        # Cross the blocked threshold with a non-multiple-of-chunk length
+        # and values big enough to wrap int64 mid-scan.
+        n = _FLAT_MAX + 12345
+        x = rng.integers(-(2**62), 2**62, n, dtype=np.int64)
+        got = np.asarray(blocked_cumsum(jnp.asarray(x)))
+        want = np.cumsum(x)  # numpy wraps identically on int64
+        np.testing.assert_array_equal(got, want)
+
+    def test_short_and_i32_take_flat_path(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pixie_tpu.ops.scan import blocked_cumsum
+
+        x = np.arange(100, dtype=np.int64)
+        np.testing.assert_array_equal(
+            np.asarray(blocked_cumsum(jnp.asarray(x))), np.cumsum(x))
+        y = np.arange(10, dtype=np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(blocked_cumsum(jnp.asarray(y))), np.cumsum(y))
