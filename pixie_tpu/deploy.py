@@ -64,20 +64,52 @@ def run_broker() -> int:
     return 0
 
 
-def run_pem() -> int:
-    from .ingest.collector import Collector
-    from .ingest.connectors import ProcessStatsConnector, SeqGenConnector
-    from .ingest.profiler import PerfProfilerConnector
-    from .services.agent import PEMAgent
+def _dial_broker(host: str, port: int):
+    """RemoteBus with startup retry: deploy roles come up in any order
+    (k8s gives no sequencing), so a PEM that boots before the broker's
+    netbus listens must keep dialing, not crash."""
+    import time as _time
+
     from .services.netbus import RemoteBus
 
+    deadline = _time.monotonic() + float(
+        os.environ.get("PIXIE_TPU_DIAL_TIMEOUT_S", "60")
+    )
+    while True:
+        try:
+            return RemoteBus(host, port)
+        except (ConnectionError, OSError):
+            if _time.monotonic() >= deadline:
+                raise
+            _time.sleep(0.5)
+
+
+def run_pem() -> int:
+    from .ingest.collector import Collector
+    from .ingest.connectors import (
+        NetworkStatsConnector,
+        PIDRuntimeConnector,
+        ProcExitConnector,
+        ProcStatConnector,
+        ProcessStatsConnector,
+        SeqGenConnector,
+        StirlingErrorConnector,
+    )
+    from .ingest.profiler import PerfProfilerConnector
+    from .services.agent import PEMAgent
+
     host, port = _broker_addr()
-    bus = RemoteBus(host, port)
+    bus = _dial_broker(host, port)
     agent = PEMAgent(bus, _agent_id("pem")).start()
     coll = Collector()
     coll.wire_to(agent)
     coll.register_source(ProcessStatsConnector())
     coll.register_source(PerfProfilerConnector(pod=_agent_id("pem")))
+    coll.register_source(ProcStatConnector())
+    coll.register_source(PIDRuntimeConnector())
+    coll.register_source(ProcExitConnector())
+    coll.register_source(NetworkStatsConnector(pod=_agent_id("pem")))
+    coll.register_source(StirlingErrorConnector())
     if os.environ.get("PIXIE_TPU_SEQGEN"):
         coll.register_source(SeqGenConnector())
     coll.run_as_thread()
@@ -91,10 +123,9 @@ def run_pem() -> int:
 
 def run_kelvin() -> int:
     from .services.agent import KelvinAgent
-    from .services.netbus import RemoteBus
 
     host, port = _broker_addr()
-    bus = RemoteBus(host, port)
+    bus = _dial_broker(host, port)
     agent = KelvinAgent(bus, _agent_id("kelvin")).start()
     obs = _agent_obs(agent)
     print(
@@ -123,8 +154,18 @@ def _agent_obs(agent, extra=None) -> int:
 
 def _wait_forever() -> None:
     stop = threading.Event()
+
+    def on_stop(*_):
+        # Last-gasp flushes before the role's own teardown runs
+        # (crash.register_fatal_handler's SIGTERM contract — the crash
+        # module's own SIGTERM handler is disabled for deploy roles).
+        from .services.crash import run_fatal_handlers
+
+        run_fatal_handlers()
+        stop.set()
+
     for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: stop.set())
+        signal.signal(sig, on_stop)
     stop.wait()
 
 
@@ -177,6 +218,13 @@ def main(argv=None) -> int:
         print(f"usage: python -m pixie_tpu.deploy {{{'|'.join(roles)}}}",
               file=sys.stderr)
         return 2
+    # Crash machinery before anything else (signal_action.h parity):
+    # hard faults dump stacks to the crash log, uncaught exceptions run
+    # registered last-gasp handlers. SIGTERM stays with _wait_forever's
+    # graceful teardown.
+    from .services.crash import install as install_crash
+
+    install_crash(role=args[0], sigterm_exits=False)
     return roles[args[0]]()
 
 

@@ -33,6 +33,24 @@ def _build(name: str) -> str:
     return out
 
 
+def build_executable(name: str) -> str | None:
+    """Build native/<name>.cc as a standalone binary (the client CLI
+    path, vs ``load``'s shared-object path). Returns the binary path or
+    None when the toolchain is unavailable."""
+    src = os.path.join(_DIR, f"{name}.cc")
+    out = os.path.join(_DIR, name)
+    try:
+        if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+            return out
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-pthread", "-o", out, src],
+            check=True, capture_output=True,
+        )
+        return out
+    except (OSError, subprocess.CalledProcessError, FileNotFoundError):
+        return None
+
+
 def load(name: str):
     """Load (building if needed) libpixie native component ``name``.
 

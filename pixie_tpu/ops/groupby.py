@@ -27,6 +27,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .scan import blocked_cumsum
+
 
 def _sortable(plane):
     """Map a key plane to its sortable bit view.
@@ -77,7 +79,9 @@ def dense_group_ids(key_planes, mask, max_groups: int):
         is_new = is_new | diff
     is_new = is_new & sorted_mask
 
-    sorted_gid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    # blocked: a flat window-length i32 cumsum overflows TPU scoped vmem
+    # at multi-million-row windows (see ops/scan.py).
+    sorted_gid = blocked_cumsum(is_new.astype(jnp.int32)) - 1
     n_groups = jnp.sum(is_new.astype(jnp.int32))
     # Clamp overflowing groups into the last slot; invalid rows -> G.
     sorted_gid_c = jnp.where(
@@ -225,7 +229,7 @@ def dense_group_ids_hash(key_planes, mask, max_groups: int,
     probe_failed = jnp.any(active)
 
     occ = occupied[:size]
-    rank = jnp.cumsum(occ.astype(jnp.int32)) - 1  # [size]
+    rank = blocked_cumsum(occ.astype(jnp.int32)) - 1  # [size]
     n_occupied = jnp.sum(occ.astype(jnp.int32))
     n_groups = jnp.where(
         probe_failed, jnp.int32(max_groups + 1), n_occupied

@@ -29,11 +29,14 @@ import jax
 import jax.numpy as jnp
 
 from .groupby import dense_group_ids, dense_group_ids_hash
+from .scan import blocked_cumsum
 
 
 def _exclusive_cumsum(x):
-    """(exclusive cumsum, total) for an int32 vector."""
-    c = jnp.cumsum(x)
+    """(exclusive cumsum, total) for an int32 vector. Blocked so probe-
+    length scans compile on TPU (flat cumsum overflows scoped vmem at
+    multi-million rows — see ops/scan.py)."""
+    c = blocked_cumsum(x)
     return jnp.concatenate([jnp.zeros(1, x.dtype), c[:-1]]), c[-1]
 
 

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .scan import blocked_cummax
+
 # numpy, not jnp: an eagerly-created jax scalar captured as a jit
 # constant permanently poisons axon-tunnel dispatch.
 _EMPTY = np.float32(np.inf)  # priority of an empty reservoir slot
@@ -78,7 +80,7 @@ def _batch_to_reservoir(values, prio, group_ids, mask, num_groups, capacity, dty
     ps = jnp.where(mask, prio, _EMPTY)[order]
     pos = jnp.arange(n)
     is_first = jnp.concatenate([jnp.ones(1, bool), gs[1:] != gs[:-1]])
-    seg_start = jax.lax.cummax(jnp.where(is_first, pos, 0))
+    seg_start = blocked_cummax(jnp.where(is_first, pos, 0))
     rank = pos - seg_start
     slot = jnp.where((gs < g) & (rank < c), gs * c + rank, g * c)
     out_v = jnp.zeros(g * c + 1, dtype).at[slot].set(vs, mode="drop")

@@ -47,7 +47,10 @@ def run_rules(plan: Plan, max_output_rows: int = 10_000) -> Plan:
     prune_noop_filters(plan)
     fuse_quantile_plucks(plan)
     push_filters_below_maps(plan)
+    merge_consecutive_filters(plan)
+    push_limit_below_maps(plan)
     fuse_consecutive_maps(plan)
+    drop_noop_maps(plan)
     merge_nodes(plan)
     push_agg_through_join(plan)
     prune_unused_columns(plan)
@@ -643,6 +646,101 @@ def prune_noop_filters(plan: Plan) -> None:
             for m in plan.nodes.values():
                 m.inputs = [src if i == nid else i for i in m.inputs]
             del plan.nodes[nid]
+
+
+def merge_consecutive_filters(plan: Plan) -> None:
+    """Filter(Filter(x)) -> one Filter over ``logicalAnd(inner, outer)``
+    when the inner filter has a single consumer (reference
+    ``analyzer/combine_consecutive_filters``-style pass). Row masks
+    conjoin exactly, and one FilterOp keeps the fused fragment's op
+    chain (and fold_constants' view of the predicate) whole."""
+    from .pattern import Pat, match, single_consumer
+
+    changed = True
+    while changed:
+        changed = False
+        for nid in list(plan.nodes):
+            m = match(
+                plan, nid,
+                Pat(FilterOp, inputs=[Pat(FilterOp, name="inner")]),
+            )
+            if m is None or not single_consumer(plan, m["inner"].id):
+                continue
+            node, inner = m[0], m["inner"]
+            node.op = FilterOp(
+                predicate=FuncCall(
+                    "logicalAnd",
+                    (inner.op.predicate, node.op.predicate),
+                )
+            )
+            node.inputs = list(inner.inputs)
+            del plan.nodes[inner.id]
+            changed = True
+
+
+def push_limit_below_maps(plan: Plan) -> None:
+    """Limit(Map(x)) -> Map(Limit(x)) when the map has a single consumer
+    (reference analyzer limit-pushdown). Maps are row-wise and order-
+    preserving, so projecting the first n input rows equals taking the
+    first n projected rows — and the limit's early source abort now
+    fires before the projection computes."""
+    from .pattern import Pat, match, single_consumer
+
+    changed = True
+    while changed:
+        changed = False
+        for nid in list(plan.topo_order()):
+            m = match(
+                plan, nid,
+                Pat(LimitOp, inputs=[Pat(MapOp, name="map")]),
+            )
+            if m is None or not single_consumer(plan, m["map"].id):
+                continue
+            node, up = m[0], m["map"]
+            # Id-stable swap (consumers keep pointing at nid): nid
+            # becomes the Map, the map's node becomes the Limit over x.
+            x_inputs = list(up.inputs)
+            map_op, map_rel = up.op, up.relation
+            up.op = node.op
+            up.inputs = x_inputs
+            up.relation = (
+                plan.nodes[x_inputs[0]].relation if x_inputs else None
+            )
+            node.op = map_op
+            node.inputs = [up.id]
+            node.relation = map_rel
+            changed = True
+
+
+def drop_noop_maps(plan: Plan) -> None:
+    """Remove MapOps that are identity projections of their input — the
+    reference's ``analyzer/drop_noop_rule``-class cleanup. A map is a
+    no-op when every output is ``name = col(name)`` and the output
+    column set equals the input relation's, so dropping it cannot
+    change schema or values."""
+    from .pattern import Pat, match
+
+    def identity(node) -> bool:
+        if any(
+            not isinstance(e, ColumnRef) or e.name != n
+            for n, e in node.op.exprs
+        ):
+            return False
+        if not node.inputs:
+            return False
+        src = plan.nodes[node.inputs[0]].relation
+        return src is not None and (
+            [n for n, _ in node.op.exprs] == list(src.column_names)
+        )
+
+    for nid in list(plan.nodes):
+        m = match(plan, nid, Pat(MapOp, where=identity))
+        if m is None:
+            continue
+        src = m[0].inputs[0]
+        for n in plan.nodes.values():
+            n.inputs = [src if i == nid else i for i in n.inputs]
+        del plan.nodes[nid]
 
 
 def fuse_consecutive_maps(plan: Plan) -> None:

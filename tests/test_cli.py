@@ -344,3 +344,80 @@ class TestDeployEndToEnd:
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+class TestNativeClient:
+    """native/pxclient.cc: the C++ netbus client (reference pxapi Go
+    client analog) — framed-TCP wire codec, HMAC token signing, and
+    HostBatch result printing, all without Python on the client side."""
+
+    @pytest.fixture()
+    def binary(self):
+        from pixie_tpu.native import build_executable
+
+        path = build_executable("pxclient")
+        if path is None:
+            pytest.skip("no C++ toolchain")
+        return path
+
+    def _serve(self, served_cluster, secret=""):
+        from pixie_tpu.services.netbus import BusServer
+
+        bus, _tracker, _broker = served_cluster
+        return BusServer(bus, secret=secret)
+
+    def test_execute_prints_table(self, served_cluster, binary):
+        import subprocess
+
+        server = self._serve(served_cluster)
+        try:
+            p = subprocess.run(
+                [binary, "--port", str(server.port), "--pxl", QUERY],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert p.returncode == 0, p.stderr
+            assert "[output] 3 rows" in p.stdout
+            assert "svc-0" in p.stdout and "svc-2" in p.stdout
+            # counts sum to the seeded 2x1500 rows
+            counts = [int(line.split("\t")[1])
+                      for line in p.stdout.splitlines()
+                      if line.startswith("svc-")]
+            assert sum(counts) == 3000
+        finally:
+            server.close()
+
+    def test_list_scripts(self, served_cluster, binary):
+        import subprocess
+
+        server = self._serve(served_cluster)
+        try:
+            p = subprocess.run(
+                [binary, "--port", str(server.port), "--list"],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert p.returncode == 0, p.stderr
+            assert "px/http_stats" in p.stdout
+        finally:
+            server.close()
+
+    def test_signed_token_accepted_and_required(self, served_cluster, binary):
+        import subprocess
+
+        server = self._serve(served_cluster, secret="hunter2")
+        try:
+            ok = subprocess.run(
+                [binary, "--port", str(server.port), "--secret", "hunter2",
+                 "--pxl", QUERY],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert ok.returncode == 0, ok.stderr
+            assert "[output] 3 rows" in ok.stdout
+            bad = subprocess.run(
+                [binary, "--port", str(server.port), "--secret", "wrong",
+                 "--pxl", QUERY],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert bad.returncode != 0
+            assert "auth" in bad.stderr.lower()
+        finally:
+            server.close()

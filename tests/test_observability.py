@@ -247,3 +247,78 @@ class TestObservabilityServer:
         srv = ObservabilityServer(health_fn=lambda: (False, "agent expired"))
         code, _, body = srv.handle("/healthz")
         assert code == 503 and "expired" in body
+
+
+class TestCrashHandler:
+    """services/crash.py: signal_action.h analog — hard-fault stack
+    dumps, uncaught-exception recording, fatal-handler last gasps."""
+
+    def test_segfault_dumps_stacks_to_crash_log(self, tmp_path):
+        import subprocess
+        import sys
+
+        log = tmp_path / "crash.log"
+        code = (
+            "from pixie_tpu.services import crash\n"
+            f"crash.install(crash_log_path={str(log)!r})\n"
+            "import faulthandler\n"
+            "faulthandler._sigsegv()\n"
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", code], cwd="/root/repo",
+            capture_output=True, text=True, timeout=60,
+            env={"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                 "PATH": "/usr/bin:/bin"},
+        )
+        assert p.returncode != 0
+        out = log.read_text()
+        assert "Segmentation fault" in out or "Fatal Python error" in out
+        assert "Current thread" in out or "Thread" in out  # stack dump
+
+    def test_uncaught_exception_runs_fatal_handlers(self, tmp_path):
+        import subprocess
+        import sys
+
+        log = tmp_path / "crash.log"
+        gasp = tmp_path / "gasp.txt"
+        code = (
+            "from pixie_tpu.services import crash\n"
+            f"crash.install(crash_log_path={str(log)!r})\n"
+            "crash.register_fatal_handler(\n"
+            f"    lambda: open({str(gasp)!r}, 'w').write('flushed'))\n"
+            "raise RuntimeError('kaboom')\n"
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", code], cwd="/root/repo",
+            capture_output=True, text=True, timeout=60,
+            env={"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                 "PATH": "/usr/bin:/bin"},
+        )
+        assert p.returncode != 0
+        assert "kaboom" in log.read_text()  # recorded before re-raise
+        assert gasp.read_text() == "flushed"  # last-gasp handler ran
+        assert "kaboom" in p.stderr  # previous hook still reports
+
+    def test_thread_exception_recorded(self, tmp_path):
+        import subprocess
+        import sys
+
+        log = tmp_path / "crash.log"
+        code = (
+            "import threading\n"
+            "from pixie_tpu.services import crash\n"
+            f"crash.install(crash_log_path={str(log)!r})\n"
+            "t = threading.Thread(target=lambda: 1/0, name='worker')\n"
+            "t.start(); t.join()\n"
+            "print('main alive')\n"
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", code], cwd="/root/repo",
+            capture_output=True, text=True, timeout=60,
+            env={"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                 "PATH": "/usr/bin:/bin"},
+        )
+        assert p.returncode == 0 and "main alive" in p.stdout
+        out = log.read_text()
+        assert "thread-exception:worker" in out
+        assert "ZeroDivisionError" in out

@@ -528,3 +528,126 @@ class TestMergeNodesRule:
         assert len(maps) == 1, f"{len(maps)} MapOps survived fusion"
         (m,) = maps
         assert "multiply" in repr(dict(m.op.exprs)["u"])
+
+
+class TestFilterAndLimitRules:
+    def _state(self):
+        from pixie_tpu.udf.registry import default_registry
+
+        return CompilerState(
+            schemas={"t": Relation([("time_", DataType.TIME64NS),
+                                    ("svc", DataType.STRING),
+                                    ("v", DataType.INT64)])},
+            registry=default_registry(),
+        )
+
+    def test_consecutive_filters_merge_to_one(self):
+        from pixie_tpu.exec.plan import FilterOp
+
+        plan = compile_pxl(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df = df[df.v > 10]\n"
+            "df = df[df.v < 100]\npx.display(df)",
+            self._state(),
+        ).plan
+        filters = [n for n in plan.nodes.values()
+                   if isinstance(n.op, FilterOp)]
+        assert len(filters) == 1, f"{len(filters)} FilterOps survived"
+        assert "logicalAnd" in repr(filters[0].op.predicate)
+
+    def test_limit_pushed_below_projection(self):
+        from pixie_tpu.exec.plan import LimitOp, MapOp
+
+        plan = compile_pxl(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df.w = df.v * 2\n"
+            "df = df.head(7)\npx.display(df)",
+            self._state(),
+        ).plan
+        order = [type(plan.nodes[n].op).__name__ for n in plan.topo_order()]
+        li = order.index("LimitOp")
+        mi = order.index("MapOp")
+        assert li < mi, order  # user limit now cuts rows before the map
+
+    def test_merged_filter_and_pushed_limit_end_to_end(self):
+        import numpy as np
+
+        from pixie_tpu.exec.engine import Engine
+
+        eng = Engine()
+        eng.append_data("t", {
+            "time_": np.arange(50, dtype=np.int64),
+            "svc": [f"s{i % 3}" for i in range(50)],
+            "v": np.arange(50, dtype=np.int64),
+        })
+        out = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df = df[df.v >= 10]\n"
+            "df = df[df.v < 40]\n"
+            "df.w = df.v * 2\n"
+            "df = df.head(5)\npx.display(df)"
+        )["output"].to_pydict()
+        np.testing.assert_array_equal(out["v"], np.arange(10, 15))
+        np.testing.assert_array_equal(out["w"], 2 * np.arange(10, 15))
+
+
+class TestPatternMatcher:
+    """planner/pattern.py: typed pattern matching over plan DAGs
+    (reference planner/ir/pattern_match.h analog)."""
+
+    def test_match_binds_named_nodes(self):
+        from pixie_tpu.exec.plan import (
+            FilterOp, Literal, MapOp, MemorySourceOp, Plan,
+        )
+        from pixie_tpu.planner.pattern import Pat, match, single_consumer
+        from pixie_tpu.types import DataType
+
+        plan = Plan()
+        src = plan.add(MemorySourceOp(table="t"))
+        mp = plan.add(MapOp(exprs=()), [src])
+        flt = plan.add(
+            FilterOp(predicate=Literal(True, DataType.BOOLEAN)), [mp]
+        )
+        m = match(plan, flt, Pat(FilterOp, inputs=[Pat(MapOp, name="m")]))
+        assert m is not None and m["m"].id == mp and m[0].id == flt
+        # guard rejects
+        m2 = match(plan, flt, Pat(FilterOp, where=lambda n: False))
+        assert m2 is None
+        # type mismatch at the input position
+        m3 = match(plan, flt, Pat(FilterOp, inputs=[Pat(FilterOp)]))
+        assert m3 is None
+        assert single_consumer(plan, mp)
+        plan.add(MapOp(exprs=()), [mp])  # second consumer
+        assert not single_consumer(plan, mp)
+
+    def test_drop_noop_maps_end_to_end(self):
+        import numpy as np
+
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.exec.plan import ColumnRef, MapOp, Plan
+        from pixie_tpu.exec.plan import MemorySourceOp, ResultSinkOp
+        from pixie_tpu.planner.rules import drop_noop_maps
+        from pixie_tpu.types import DataType
+        from pixie_tpu.types.relation import Relation
+
+        rel = Relation([("time_", DataType.TIME64NS),
+                        ("v", DataType.INT64)])
+        plan = Plan()
+        src = plan.add(MemorySourceOp(table="t"), relation=rel)
+        ident = plan.add(
+            MapOp(exprs=(("time_", ColumnRef("time_")),
+                         ("v", ColumnRef("v")))),
+            [src], relation=rel,
+        )
+        plan.add(ResultSinkOp(name="out"), [ident], relation=rel)
+        drop_noop_maps(plan)
+        assert not any(isinstance(n.op, MapOp) for n in plan.nodes.values())
+        # a REAL projection (subset of columns) must survive
+        plan2 = Plan()
+        s2 = plan2.add(MemorySourceOp(table="t"), relation=rel)
+        proj = plan2.add(
+            MapOp(exprs=(("v", ColumnRef("v")),)), [s2], relation=None
+        )
+        plan2.add(ResultSinkOp(name="out"), [proj])
+        drop_noop_maps(plan2)
+        assert any(isinstance(n.op, MapOp) for n in plan2.nodes.values())

@@ -464,3 +464,20 @@ class TestBlockedCumsum:
         y = np.arange(10, dtype=np.int32)
         np.testing.assert_array_equal(
             np.asarray(blocked_cumsum(jnp.asarray(y))), np.cumsum(y))
+
+    def test_blocked_cummax_matches_flat(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pixie_tpu.ops.scan import _FLAT_MAX_BYTES, blocked_cummax
+
+        rng = np.random.default_rng(7)
+        n = _FLAT_MAX_BYTES // 4 + 999  # crosses the blocked threshold for i32
+        x = rng.integers(-(2**30), 2**30, n).astype(np.int32)
+        got = np.asarray(blocked_cummax(jnp.asarray(x)))
+        np.testing.assert_array_equal(got, np.maximum.accumulate(x))
+        f = rng.standard_normal(1000).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(blocked_cummax(jnp.asarray(f))),
+            np.maximum.accumulate(f))

@@ -20,8 +20,12 @@ for s in http_stats service_stats net_flow_graph sql_stats perf_flamegraph devic
   PIXIE_TPU_BENCH_INNER=1 PIXIE_TPU_BENCH_SHAPES=$s timeout "${PER_SHAPE_TIMEOUT:-900}" python bench.py 2>&1 | grep -a "\[bench\] $s"
 done
 
-echo "== requires_tpu suite =="
-PIXIE_TPU_RUN_TPU_TESTS=1 timeout 1200 python -m pytest tests/test_tpu.py -v -s 2>&1 | tee TPU_TESTS_r05.txt | tail -5
-
+# Bench BEFORE the hardware suite: the bench is the round's evidence
+# gate, and a suite timeout that SIGTERMs a wedged compile can take the
+# tunnel down for hours (r5: the device_join 10M sort compile ran >17
+# min; killing it wedged the chip grant server-side).
 echo "== full bench =="
 PIXIE_TPU_BENCH_BUDGET="${BENCH_BUDGET:-900}" timeout 1000 python bench.py
+
+echo "== requires_tpu suite =="
+PIXIE_TPU_RUN_TPU_TESTS=1 timeout "${TPU_SUITE_TIMEOUT:-1200}" python -m pytest tests/test_tpu.py -v -s 2>&1 | tee TPU_TESTS_r05.txt | tail -5
