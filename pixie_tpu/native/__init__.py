@@ -39,16 +39,22 @@ def build_executable(name: str) -> str | None:
     None when the toolchain is unavailable."""
     src = os.path.join(_DIR, f"{name}.cc")
     out = os.path.join(_DIR, name)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
     try:
-        if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
-            return out
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-pthread", "-o", out, src],
             check=True, capture_output=True,
         )
-        return out
-    except (OSError, subprocess.CalledProcessError, FileNotFoundError):
-        return None
+    except FileNotFoundError:
+        return None  # no toolchain: callers skip/degrade
+    except subprocess.CalledProcessError as e:
+        # A COMPILE error must fail loudly — swallowing it would turn
+        # every native-client test into a silent skip.
+        raise RuntimeError(
+            f"native client build failed:\n{e.stderr.decode(errors='replace')}"
+        ) from None
+    return out
 
 
 def load(name: str):

@@ -12,7 +12,12 @@
 //
 // Build:  g++ -O3 -std=c++17 -pthread -o pxclient pxclient.cc
 // Usage:  pxclient [--host H] [--port P] [--secret S|--token T]
-//                  [--timeout SEC] (--pxl CODE | --script FILE | --list)
+//                  [--timeout SEC] [--stream [--updates N]]
+//                  (--pxl CODE | --script FILE | --list)
+//
+// --stream runs the query live (broker.execute_stream, the reference's
+// StreamResults flow): updates print as they arrive, and after N
+// updates (default 3) the client cancels server-side and exits.
 //
 // No dependencies beyond libc/libstdc++ (SHA-256 is implemented here so
 // auth works without OpenSSL).
@@ -658,25 +663,30 @@ int main(int argc, char** argv) {
   std::string host = "127.0.0.1", secret, token, pxl, script_path;
   int port = 6100;
   double timeout_s = 30.0;
-  bool do_list = false;
-  for (int i = 1; i < argc; i++) {
-    std::string a = argv[i];
-    auto next = [&]() -> std::string {
-      if (i + 1 >= argc) throw std::runtime_error("missing value for " + a);
-      return argv[++i];
-    };
-    if (a == "--host") host = next();
-    else if (a == "--port") port = std::stoi(next());
-    else if (a == "--secret") secret = next();
-    else if (a == "--token") token = next();
-    else if (a == "--timeout") timeout_s = std::stod(next());
-    else if (a == "--pxl") pxl = next();
-    else if (a == "--script") script_path = next();
-    else if (a == "--list") do_list = true;
-    else {
-      std::cerr << "unknown arg: " << a << "\n";
-      return 2;
+  bool do_list = false, do_stream = false;
+  int max_updates = 3;
+  try {
+    for (int i = 1; i < argc; i++) {
+      std::string a = argv[i];
+      auto next = [&]() -> std::string {
+        if (i + 1 >= argc) throw std::runtime_error("missing value for " + a);
+        return argv[++i];
+      };
+      if (a == "--host") host = next();
+      else if (a == "--port") port = std::stoi(next());
+      else if (a == "--secret") secret = next();
+      else if (a == "--token") token = next();
+      else if (a == "--timeout") timeout_s = std::stod(next());
+      else if (a == "--pxl") pxl = next();
+      else if (a == "--script") script_path = next();
+      else if (a == "--list") do_list = true;
+      else if (a == "--stream") do_stream = true;
+      else if (a == "--updates") max_updates = std::stoi(next());
+      else throw std::runtime_error("unknown arg: " + a);
     }
+  } catch (const std::exception& e) {
+    std::cerr << "pxclient: " << e.what() << "\n";
+    return 2;
   }
   if (!script_path.empty()) {
     std::ifstream f(script_path);
@@ -690,8 +700,8 @@ int main(int argc, char** argv) {
   }
   if (pxl.empty() && !do_list) {
     std::cerr << "usage: pxclient [--host H] [--port P] [--secret S|"
-                 "--token T] [--timeout SEC] (--pxl CODE | --script FILE |"
-                 " --list)\n";
+                 "--token T] [--timeout SEC] [--stream [--updates N]] "
+                 "(--pxl CODE | --script FILE | --list)\n";
     return 2;
   }
 
@@ -719,6 +729,65 @@ int main(int argc, char** argv) {
       v->d = d;
       return v;
     };
+    if (do_stream && !do_list) {
+      // Live query (broker.execute_stream): updates arrive on a
+      // client-chosen topic as {table, batch, seq, mode} messages.
+      std::ostringstream up;
+      up << "client.stream.native." << std::hex << rd();
+      bus.subscribe(up.str(), 2);
+      req.emplace_back("query", sv(pxl));
+      req.emplace_back("update_topic", sv(up.str()));
+      req.emplace_back("poll_interval_s", dv(0.25));
+      if (!token.empty()) req.emplace_back("token", sv(token));
+      bus.publish_request("broker.execute_stream", req, inbox.str());
+      std::string qid;
+      bool have_reply = false;
+      int updates = 0;
+      while (!have_reply || updates < max_updates) {
+        ValuePtr f = bus.recv_frame();
+        const Value* op = f->get("op");
+        if (!op || op->kind != Value::STR || op->s != "msg") continue;
+        const Value* fsid = f->get("sid");
+        const Value* msg = nullptr;
+        for (auto& kv : f->map)
+          if (kv.first->s == "msg") msg = kv.second.get();
+        if (!msg) continue;
+        if (fsid && fsid->i == 1) {
+          const Value* ok2 = msg->get("ok");
+          if (!ok2 || ok2->kind != Value::BOOL || !ok2->b) {
+            const Value* err = msg->get("error");
+            std::cerr << "error: " << (err ? err->s : "unknown") << "\n";
+            return 1;
+          }
+          const Value* q = msg->get("qid");
+          if (q) qid = q->s;
+          have_reply = true;
+        } else if (fsid && fsid->i == 2) {
+          const Value* err = msg->get("error");
+          if (err) {
+            std::cerr << "stream error: " << err->s << "\n";
+            return 1;
+          }
+          const Value* tbl = msg->get("table");
+          const Value* seq = msg->get("seq");
+          const Value* mode = msg->get("mode");
+          std::cout << "-- update seq=" << (seq ? seq->i : -1) << " mode="
+                    << (mode ? mode->s : "?") << "\n";
+          for (auto& kv : msg->map)
+            if (kv.first->s == "batch")
+              print_batch(tbl ? tbl->s : "?", *kv.second);
+          updates++;
+        }
+      }
+      if (!qid.empty()) {
+        std::vector<std::pair<std::string, ValuePtr>> c;
+        c.emplace_back("qid", sv(qid));
+        if (!token.empty()) c.emplace_back("token", sv(token));
+        bus.publish_request("broker.stream_cancel", c, inbox.str());
+        bus.wait_reply(1);
+      }
+      return 0;
+    }
     std::string topic;
     if (do_list) {
       topic = "broker.scripts";

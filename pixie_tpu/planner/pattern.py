@@ -69,9 +69,13 @@ def match(plan, nid: int, pat: Pat) -> Optional[dict]:
     return out if walk(nid, pat, 0) else None
 
 
-def single_consumer(plan, nid: int) -> bool:
+def single_consumer(plan, nid: int, consumers: Optional[dict] = None) -> bool:
     """True when exactly one node consumes ``nid`` exactly once (the
-    precondition for every fuse/inline rewrite)."""
+    precondition for every fuse/inline rewrite). Pass a prebuilt
+    ``consumers`` map (``rules._consumers(plan)``) inside sweep loops —
+    the fallback walks every node per call."""
+    if consumers is not None:
+        return len(consumers.get(nid, ())) == 1
     count = 0
     for n in plan.nodes.values():
         count += sum(1 for i in n.inputs if i == nid)

@@ -659,12 +659,15 @@ def merge_consecutive_filters(plan: Plan) -> None:
     changed = True
     while changed:
         changed = False
+        consumers = _consumers(plan)
         for nid in list(plan.nodes):
             m = match(
                 plan, nid,
                 Pat(FilterOp, inputs=[Pat(FilterOp, name="inner")]),
             )
-            if m is None or not single_consumer(plan, m["inner"].id):
+            if m is None or not single_consumer(
+                plan, m["inner"].id, consumers
+            ):
                 continue
             node, inner = m[0], m["inner"]
             node.op = FilterOp(
@@ -675,6 +678,7 @@ def merge_consecutive_filters(plan: Plan) -> None:
             )
             node.inputs = list(inner.inputs)
             del plan.nodes[inner.id]
+            consumers = _consumers(plan)
             changed = True
 
 
@@ -689,12 +693,13 @@ def push_limit_below_maps(plan: Plan) -> None:
     changed = True
     while changed:
         changed = False
+        consumers = _consumers(plan)
         for nid in list(plan.topo_order()):
             m = match(
                 plan, nid,
                 Pat(LimitOp, inputs=[Pat(MapOp, name="map")]),
             )
-            if m is None or not single_consumer(plan, m["map"].id):
+            if m is None or not single_consumer(plan, m["map"].id, consumers):
                 continue
             node, up = m[0], m["map"]
             # Id-stable swap (consumers keep pointing at nid): nid

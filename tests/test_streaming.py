@@ -304,3 +304,50 @@ class TestNetbusStreaming:
                 sub.cancel()
         finally:
             server.close()
+
+    def test_native_client_stream(self, live_cluster):
+        """native/pxclient.cc --stream: the C++ client consumes live
+        updates over the netbus and cancels server-side on exit."""
+        import subprocess
+
+        from pixie_tpu.native import build_executable
+        from pixie_tpu.services.netbus import BusServer
+
+        binary = build_executable("pxclient")
+        if binary is None:
+            pytest.skip("no C++ toolchain")
+        bus, _t, broker, pems = live_cluster
+        server = BusServer(bus)
+        # updates only fire on table growth: feed the PEMs while the
+        # client streams (the Python netbus-stream test's shape).
+        stop = threading.Event()
+
+        def feeder():
+            off = 5000
+            while not stop.is_set():
+                for i, pem in enumerate(pems):
+                    _push(pem, off, 100, seed=40 + i)
+                off += 100
+                time.sleep(0.1)
+
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        try:
+            p = subprocess.run(
+                [binary, "--port", str(server.port), "--stream",
+                 "--updates", "2", "--pxl", AGG_Q, "--timeout", "30"],
+                capture_output=True, text=True, timeout=60,
+            )
+            stop.set()
+            t.join(timeout=5)
+            assert p.returncode == 0, p.stderr
+            assert p.stdout.count("-- update") >= 2
+            assert "mode=replace" in p.stdout
+            assert "svc-0" in p.stdout  # dictionary-decoded group key
+            # cancel reached the broker: the stream handle is reaped
+            deadline = time.time() + 5
+            while broker._stream_handles and time.time() < deadline:
+                time.sleep(0.05)
+            assert not broker._stream_handles
+        finally:
+            server.close()

