@@ -233,6 +233,13 @@ def cmd_docs(args) -> int:
     return 0
 
 
+def cmd_version(args) -> int:
+    from .version import version_info
+
+    print(json.dumps(version_info(), indent=1))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="px", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -282,6 +289,9 @@ def main(argv=None) -> int:
 
     dc = sub.add_parser("docs", help="dump the function reference (markdown)")
     dc.set_defaults(fn=cmd_docs)
+
+    vr = sub.add_parser("version", help="print build/version metadata")
+    vr.set_defaults(fn=cmd_version)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -149,6 +149,13 @@ def register_introspection(reg) -> None:
         doc="Lists registered UDTFs.",
     )
     reg.udtf(
+        "GetVersion",
+        [("key", S), ("value", S)],
+        _get_version,
+        doc="Build/version metadata of the executing process "
+            "(reference Version UDTF / statusz surface).",
+    )
+    reg.udtf(
         "GetDebugTableInfo",
         [
             ("table_name", S),
@@ -164,3 +171,13 @@ def register_introspection(reg) -> None:
         executor=UDTFExecutor.ALL_AGENTS,
         doc="Table-store internals per table (debug).",
     )
+
+
+def _get_version(engine):
+    from ... import version as _v
+
+    info = _v.version_info()
+    return {
+        "key": list(info),
+        "value": ["" if v is None else str(v) for v in info.values()],
+    }

@@ -631,3 +631,102 @@ class TestScriptSemantics:
             want_prod[c] = want_prod.get(c, 0) + (1 if k == 0 else 0)
         got_prod = dict(zip(out2["client_id"], out2["produces"].tolist()))
         assert got_prod == want_prod
+
+    def test_slow_http_requests_floor(self, all_tables_engine):
+        s = load_script("px/slow_http_requests")
+        out = all_tables_engine.execute_query(s.pxl)["output"].to_pydict()
+        hb = self._read(all_tables_engine, "http_events")
+        lat = hb.cols["latency_ns"][0]
+        n_slow = int((lat > 10_000_000).sum())
+        assert len(out["latency_ns"]) == min(n_slow, 256)
+        assert (out["latency_ns"] > 10_000_000).all()
+
+    def test_mysql_latency_normalized_groups(self, all_tables_engine):
+        s = load_script("px/mysql_latency")
+        out = all_tables_engine.execute_query(s.pxl)["output"].to_pydict()
+        hb = self._read(all_tables_engine, "mysql_events")
+        # the seeded queries are "SELECT * FROM t WHERE id=<i>": they all
+        # normalize to ONE statement shape covering every row.
+        n = len(hb.cols["latency_ns"][0])
+        assert len(out["query_norm"]) == 1
+        assert int(out["n"][0]) == n
+        lat = hb.cols["latency_ns"][0]
+        np.testing.assert_allclose(out["lat_mean"][0], lat.mean(), rtol=1e-6)
+        assert int(out["lat_max"][0]) == int(lat.max())
+
+    def test_service_edge_stats(self, all_tables_engine):
+        s = load_script("px/service_edge_stats")
+        out = all_tables_engine.execute_query(
+            s.pxl, max_output_rows=100_000
+        )["output"].to_pydict()
+        hb = self._read(all_tables_engine, "http_events")
+        addrs = np.array([hb.dicts["remote_addr"].strings[i]
+                          for i in hb.cols["remote_addr"][0]])
+        svcs = np.array([hb.dicts["service"].strings[i]
+                         for i in hb.cols["service"][0]])
+        status = hb.cols["resp_status"][0]
+        size = hb.cols["resp_body_size"][0]
+        got = dict(zip(zip(out["remote_addr"], out["service"]),
+                       zip(out["throughput"].tolist(),
+                           out["bytes_total"].tolist(),
+                           out["error_rate"].tolist())))
+        keys = set(zip(addrs.tolist(), svcs.tolist()))
+        assert set(got) == keys
+        for k in keys:
+            m = (addrs == k[0]) & (svcs == k[1])
+            thr, byt, err = got[k]
+            assert thr == int(m.sum())
+            assert byt == int(size[m].sum())
+            np.testing.assert_allclose(err, (status[m] >= 400).mean(),
+                                       rtol=1e-6)
+
+    def test_cql_stats_error_rate(self, all_tables_engine):
+        s = load_script("px/cql_stats")
+        out = all_tables_engine.execute_query(s.pxl)["output"].to_pydict()
+        hb = self._read(all_tables_engine, "cql_events")
+        req_op = hb.cols["req_op"][0]
+        resp_op = hb.cols["resp_op"][0]
+        got = {int(o): (int(t), float(e)) for o, t, e in
+               zip(out["req_op"], out["throughput"], out["error_rate"])}
+        for o in np.unique(req_op):
+            m = req_op == o
+            assert got[int(o)][0] == int(m.sum())
+            np.testing.assert_allclose(
+                got[int(o)][1], (resp_op[m] == 0).mean(), rtol=1e-6)
+
+    def test_node_cpu_windows(self, all_tables_engine):
+        s = load_script("px/node_cpu")
+        out = all_tables_engine.execute_query(
+            s.pxl, max_output_rows=100_000
+        )
+        d = next(iter(out.values())).to_pydict()
+        hb = self._read(all_tables_engine, "proc_stat")
+        t = hb.cols["time_"][0]
+        user = hb.cols["user_percent"][0]
+        win = (t // (10 * 10**9)) * (10 * 10**9)
+        want: dict = {}
+        for w, u in zip(win, user):
+            lst = want.setdefault(int(w), [])
+            lst.append(u)
+        got = dict(zip(d["timestamp"].tolist(), d["user_pct"].tolist()))
+        assert set(got) == set(want)
+        for w, us in want.items():
+            np.testing.assert_allclose(got[w], np.mean(us), rtol=1e-5)
+
+    def test_proc_exits_counts(self, all_tables_engine):
+        import collections
+
+        s = load_script("px/proc_exits")
+        out = all_tables_engine.execute_query(
+            s.pxl, max_output_rows=100_000
+        )
+        d = next(iter(out.values())).to_pydict()
+        hb = self._read(all_tables_engine, "proc_exit_events")
+        comm = np.array([hb.dicts["comm"].strings[i]
+                         for i in hb.cols["comm"][0]])
+        t = hb.cols["time_"][0]
+        win = (t // (10 * 10**9)) * (10 * 10**9)
+        want = collections.Counter(zip(win.tolist(), comm.tolist()))
+        got = dict(zip(zip(d["timestamp"].tolist(), d["comm"]),
+                       d["exits"].tolist()))
+        assert got == dict(want)

@@ -220,3 +220,19 @@ class TestUDTFCluster:
                 a.stop()
             tracker.close()
             bus.close()
+
+
+class TestGetVersion:
+    def test_version_udtf(self):
+        from pixie_tpu.exec import Engine
+
+        eng = Engine()
+        out = eng.execute_query(
+            "import px\npx.display(px.GetVersion(), 'output')"
+        )["output"].to_pydict()
+        kv = dict(zip(out["key"], out["value"]))
+        assert "version" in kv and "git_commit" in kv
+        import re
+
+        assert kv["git_commit"] == "unknown" or re.fullmatch(
+            r"[0-9a-f]{40}", kv["git_commit"]), kv["git_commit"]
