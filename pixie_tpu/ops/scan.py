@@ -33,14 +33,26 @@ _FLAT_MAX_BYTES = 1 << 23
 _FLAT_MAX = _FLAT_MAX_BYTES // 8
 
 
-def blocked_cumsum(x: jnp.ndarray) -> jnp.ndarray:
-    """Inclusive 1-D cumsum, exact for integers, safe to compile on TPU
-    at any length. Equals ``jnp.cumsum(x)`` elementwise (integer
-    wraparound included); floats get the same association order as the
-    blocked scan, so use it for integer dtypes when bit-exactness vs the
-    flat form matters."""
+def _needs_blocking(x, force: bool) -> bool:
+    """Size-based, backend-independent: the blocked form is used above
+    the threshold on EVERY backend (CPU pays only a cheap reshape, and
+    lowering-target-vs-default-backend mismatches can't reintroduce the
+    TPU compile failure). ``force=True`` picks the blocked path at any
+    size — the tests' hook for exercising it on small inputs."""
+    if force:
+        return True
     (n,) = x.shape
-    if n * np.dtype(x.dtype).itemsize <= _FLAT_MAX_BYTES:
+    return n * np.dtype(x.dtype).itemsize > _FLAT_MAX_BYTES
+
+
+def blocked_cumsum(x: jnp.ndarray, force: bool = False) -> jnp.ndarray:
+    """Inclusive 1-D cumsum, exact for integers, safe to compile on TPU
+    at any length. Equals ``jnp.cumsum(x)`` elementwise for integer
+    dtypes (wraparound included) on every backend; float association
+    order depends on which path the size threshold selects, so floats
+    should not rely on bit-reproducibility across sizes."""
+    (n,) = x.shape
+    if not _needs_blocking(x, force):
         return jnp.cumsum(x)
     c = -(-n // _CHUNK)
     pad = c * _CHUNK - n
@@ -55,15 +67,15 @@ def blocked_cumsum(x: jnp.ndarray) -> jnp.ndarray:
     return (within + prefix[:, None]).reshape(-1)[:n]
 
 
-def blocked_cummax(x: jnp.ndarray) -> jnp.ndarray:
+def blocked_cummax(x: jnp.ndarray, force: bool = False) -> jnp.ndarray:
     """Inclusive 1-D cumulative max with the same blocked structure as
     :func:`blocked_cumsum` (``lax.cummax`` has the identical scoped-vmem
     reduce-window lowering on TPU)."""
     import jax
 
-    (n,) = x.shape
-    if n * np.dtype(x.dtype).itemsize <= _FLAT_MAX_BYTES:
+    if not _needs_blocking(x, force):
         return jax.lax.cummax(x)
+    (n,) = x.shape
     if x.dtype == jnp.bool_:
         lowest = False  # cumulative OR: False is the identity
     elif jnp.issubdtype(x.dtype, jnp.integer):
