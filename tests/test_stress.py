@@ -239,3 +239,73 @@ class TestFragmentCacheRaces:
         for t in threads:
             t.join()
         assert not errors
+
+
+class TestNetbusChurn:
+    """Transport-layer races: concurrent remote clients publishing while
+    other clients connect, subscribe and hard-disconnect mid-traffic
+    (the TSAN-analog for netbus.py's per-connection reader threads and
+    the server's subscription forwarding)."""
+
+    def test_clients_churn_under_publish(self):
+        from pixie_tpu.services.netbus import BusServer, RemoteBus
+
+        bus = MessageBus()
+        server = BusServer(bus)
+        got = []
+        bus.subscribe("t", got.append)
+        errors = []
+        stop = threading.Event()
+
+        def publisher(i):
+            try:
+                rb = RemoteBus("127.0.0.1", server.port)
+                for k in range(50):
+                    rb.publish("t", {"src": i, "k": k})
+                rb.close()
+            except Exception as e:  # pragma: no cover
+                errors.append(("pub", i, repr(e)))
+
+        def churner():
+            # connect, subscribe, sometimes vanish WITHOUT unsubscribe —
+            # the server must reap dead forwarders without dropping
+            # other clients' messages.
+            while not stop.is_set():
+                try:
+                    rb = RemoteBus("127.0.0.1", server.port)
+                    rb.subscribe("t", lambda m: None)
+                    time.sleep(0.002)
+                    rb.sock.close()  # hard disconnect, no goodbye
+                except Exception:
+                    pass
+
+        churn_threads = [threading.Thread(target=churner, daemon=True)
+                         for _ in range(3)]
+        for t in churn_threads:
+            t.start()
+        pubs = [threading.Thread(target=publisher, args=(i,))
+                for i in range(4)]
+        for t in pubs:
+            t.start()
+        for t in pubs:
+            t.join(timeout=30)
+            assert not t.is_alive(), "publisher hung"
+        stop.set()
+        for t in churn_threads:
+            t.join(timeout=5)
+        try:
+            assert not errors, errors
+            # every publish from every surviving publisher arrived
+            deadline = time.time() + 5
+            while len(got) < 200 and time.time() < deadline:
+                time.sleep(0.02)
+            assert len(got) == 200, len(got)
+            per_src = {}
+            for m in got:
+                per_src.setdefault(m["src"], []).append(m["k"])
+            for i in range(4):
+                # per-connection ordering is preserved (one TCP stream)
+                assert per_src[i] == sorted(per_src[i]), i
+                assert len(per_src[i]) == 50
+        finally:
+            server.close()

@@ -448,7 +448,7 @@ class TestBlockedCumsum:
         # and values big enough to wrap int64 mid-scan.
         n = _FLAT_MAX + 12345
         x = rng.integers(-(2**62), 2**62, n, dtype=np.int64)
-        got = np.asarray(blocked_cumsum(jnp.asarray(x), force=True))
+        got = np.asarray(blocked_cumsum(jnp.asarray(x)))
         want = np.cumsum(x)  # numpy wraps identically on int64
         np.testing.assert_array_equal(got, want)
 
@@ -475,7 +475,7 @@ class TestBlockedCumsum:
         rng = np.random.default_rng(7)
         n = _FLAT_MAX_BYTES // 4 + 999  # crosses the blocked threshold for i32
         x = rng.integers(-(2**30), 2**30, n).astype(np.int32)
-        got = np.asarray(blocked_cummax(jnp.asarray(x), force=True))
+        got = np.asarray(blocked_cummax(jnp.asarray(x)))
         np.testing.assert_array_equal(got, np.maximum.accumulate(x))
         f = rng.standard_normal(1000).astype(np.float32)
         np.testing.assert_array_equal(
