@@ -46,6 +46,19 @@ def _print_batch(name: str, hb, fmt: str) -> None:
         ]
         print(json.dumps({"table": name, "rows": rows}))
         return
+    if fmt == "csv":
+        # The reference's CSV surface (carnot_executable.cc CSV-out /
+        # `px run -o csv`): header then rows, stdlib-quoted. Each table
+        # is prefixed with a `# table: <name>` comment line so
+        # multi-output scripts stay parseable (split on the marker).
+        import csv as _csv
+
+        print(f"# table: {name}")
+        w = _csv.writer(sys.stdout, lineterminator="\n")
+        w.writerow(cols)
+        for i in range(hb.length):
+            w.writerow([_py(d[c][i]) for c in cols])
+        return
     widths = {
         c: max(len(c), *(len(str(v)) for v in d[c][:200]), 1) if hb.length else len(c)
         for c in cols
@@ -89,7 +102,7 @@ def cmd_run(args) -> int:
                 return 1
         for name, hb in sorted(res["tables"].items()):
             _print_batch(name, hb, args.output)
-        if args.output != "json":
+        if args.output == "table":
             stats = res.get("agent_stats", {})
             if stats:
                 worst = max(s["exec_time_s"] for s in stats.values())
@@ -254,7 +267,7 @@ def main(argv=None) -> int:
                      help="generate an N-row synthetic replay (local)")
     run.add_argument("--timeout", type=float, default=30.0)
     run.add_argument("--max-rows", type=int, default=10_000)
-    run.add_argument("-o", "--output", choices=("table", "json"),
+    run.add_argument("-o", "--output", choices=("table", "json", "csv"),
                      default="table")
     run.set_defaults(fn=cmd_run)
 

@@ -148,6 +148,22 @@ class TestCLI:
         )
         assert '"table": "output"' in out
 
+    def test_run_local_csv(self):
+        import csv
+        import io
+
+        out = _run_cli(
+            "run", "px/http_stats", "--local", "--synthetic", "5000",
+            "-o", "csv",
+        )
+        lines = out.splitlines()
+        assert lines[0] == "# table: output"
+        assert all("\r" not in ln for ln in lines)  # unix line endings
+        rows = list(csv.reader(io.StringIO("\n".join(lines[1:]))))
+        assert rows[0] == ["service", "req_path", "n", "lat_mean", "lat_max"]
+        assert len(rows) > 1 and all(len(r) == 5 for r in rows[1:])
+        assert sum(int(r[2]) for r in rows[1:]) > 0  # counts parse
+
     def test_run_against_served_broker(self, served_cluster, tmp_path):
         # End to end over the real framed-TCP netbus.
         from pixie_tpu.services.netbus import BusServer
