@@ -243,6 +243,19 @@ class RemoteBus:
         from ..config import get_flag
 
         self.sock = socket.create_connection((host, port), connect_timeout_s)
+        # create_connection leaves its timeout ARMED on the socket; the
+        # read loop would then treat any 10s-idle connection as dead
+        # (TimeoutError ⊂ OSError) and silently self-close — streams
+        # with a stalled producer died exactly this way. Receives must
+        # block forever (idle is normal); SENDS stay bounded via
+        # SO_SNDTIMEO so a wedged server can't hang publishers inside
+        # _send_lock.
+        self.sock.settimeout(None)
+        snd_s = max(int(connect_timeout_s), 1)
+        self.sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+            struct.pack("ll", snd_s, 0),
+        )
         self._send_lock = threading.Lock()
         self._lock = threading.Lock()
         self._handlers: dict[int, object] = {}  # sid -> callable

@@ -222,3 +222,32 @@ class TestForwarder:
                 "px.display(df, 'o')\n",
                 timeout_s=0.5,
             )
+
+
+class TestRemoteBusIdle:
+    def test_idle_connection_survives_past_connect_timeout(self):
+        """create_connection's timeout must not leak into the read loop:
+        an idle client (no traffic for longer than connect_timeout_s)
+        has to stay connected and deliver later messages (a stalled
+        stream producer is not a dead connection)."""
+        import time
+
+        from pixie_tpu.services.msgbus import MessageBus
+        from pixie_tpu.services.netbus import BusServer, RemoteBus
+
+        bus = MessageBus()
+        server = BusServer(bus)
+        rb = RemoteBus("127.0.0.1", server.port, connect_timeout_s=0.5)
+        try:
+            got = []
+            rb.subscribe("t", got.append)
+            time.sleep(1.2)  # idle well past the connect timeout
+            assert not rb._closed.is_set(), "idle client self-closed"
+            bus.publish("t", {"late": 1})
+            deadline = time.time() + 5
+            while not got and time.time() < deadline:
+                time.sleep(0.01)
+            assert got == [{"late": 1}]
+        finally:
+            rb.close()
+            server.close()
