@@ -25,6 +25,41 @@ _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 30
 
 
+def _harden_socket(sock: socket.socket, send_timeout_s: int = 10) -> None:
+    """Transport hardening for both bus endpoints:
+
+    - SO_SNDTIMEO bounds blocking sends so a peer that stops READING
+      can't park a sender inside its send lock forever (the timeout
+      surfaces as TimeoutError ⊂ OSError and the caller reaps).
+    - SO_KEEPALIVE (+ aggressive probe knobs where available) detects
+      half-open connections — a peer HOST that died without FIN would
+      otherwise leave recv() blocked forever now that reads are
+      unbounded (idle is normal on this bus).
+    """
+    # Every knob best-effort: hardening must never take a connection
+    # (or the server's accept loop) down — platforms vary in timeval
+    # layout and option support.
+    try:
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+            struct.pack("ll", send_timeout_s, 0),
+        )
+    except OSError:
+        pass
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    except OSError:
+        pass
+    for opt, val in (
+        ("TCP_KEEPIDLE", 30), ("TCP_KEEPINTVL", 10), ("TCP_KEEPCNT", 3),
+    ):
+        if hasattr(socket, opt):
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
+            except OSError:
+                pass
+
+
 def _send_frame(sock: socket.socket, obj) -> None:
     payload = encode(obj)
     if len(payload) > MAX_FRAME:
@@ -93,7 +128,21 @@ class BusServer:
                 sock, _ = self._srv.accept()
             except OSError:
                 return
-            client = _ClientConn(self, sock)
+            try:
+                # Same hardening as the client side: bounded sends (a
+                # non-reading client can't park forwarders in sendall —
+                # the TimeoutError ⊂ OSError path in _send closes and
+                # drops its subscriptions) + keepalive for half-open
+                # peers. Per-client setup failure drops THAT client,
+                # never the acceptor.
+                _harden_socket(sock)
+                client = _ClientConn(self, sock)
+            except OSError:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
             with self._lock:
                 self._clients.append(client)
             client.start()
@@ -251,11 +300,7 @@ class RemoteBus:
         # SO_SNDTIMEO so a wedged server can't hang publishers inside
         # _send_lock.
         self.sock.settimeout(None)
-        snd_s = max(int(connect_timeout_s), 1)
-        self.sock.setsockopt(
-            socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-            struct.pack("ll", snd_s, 0),
-        )
+        _harden_socket(self.sock, send_timeout_s=max(int(connect_timeout_s), 1))
         self._send_lock = threading.Lock()
         self._lock = threading.Lock()
         self._handlers: dict[int, object] = {}  # sid -> callable
@@ -330,8 +375,15 @@ class RemoteBus:
     def _send(self, obj) -> None:
         if self._closed.is_set():
             raise ConnectionError("remote bus closed")
-        with self._send_lock:
-            _send_frame(self.sock, obj)
+        try:
+            with self._send_lock:
+                _send_frame(self.sock, obj)
+        except (ConnectionError, OSError):
+            # A failed/timed-out send may have written a PARTIAL frame:
+            # the stream is desynced for good. Poison the bus so every
+            # later caller fails fast instead of corrupting the wire.
+            self.close()
+            raise
 
     def _read_loop(self) -> None:
         try:

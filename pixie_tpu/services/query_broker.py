@@ -101,12 +101,16 @@ class StreamHandle:
     """A live query's client handle: ``cancel()`` stops the agents'
     streaming cursors and detaches the subscriber."""
 
-    def __init__(self, qid: str, broker: "QueryBroker", sub):
+    def __init__(self, qid: str, broker: "QueryBroker", sub,
+                 merge_agent: str = ""):
         self.qid = qid
+        self.merge_agent = merge_agent
         self._broker = broker
         self._sub = sub
 
     def cancel(self) -> None:
+        self._broker._live_streams.pop(self.qid, None)
+        self._broker._stream_handles.pop(self.qid, None)
         self._broker.bus.publish("query.cancel", {"qid": self.qid})
         if self._sub is not None:
             self._sub.unsubscribe()
@@ -140,6 +144,67 @@ class QueryBroker:
         self.tracepoints = None
         # Live queries started over the bus (qid -> StreamHandle).
         self._stream_handles: dict = {}
+        # Every live stream's handle (qid -> StreamHandle): the stream
+        # watchdog. A stream whose MERGE agent expires can never emit
+        # again (data-agent loss re-merges from survivors instead), so
+        # tracker expiry fails it loudly rather than leaving the client
+        # on a forever-silent subscription (reference: the forwarder's
+        # producer watchdog, query_result_forwarder.go).
+        self._live_streams: dict = {}
+
+        from .tracker import TOPIC_EXPIRED, TOPIC_REGISTER
+
+        self._expiry_sub = self.bus.subscribe(
+            TOPIC_EXPIRED,
+            lambda msg: self._abort_streams_of(
+                msg.get("agent_id"), "expired"
+            ),
+        )
+        # A RE-registration of the merge agent means a new incarnation
+        # (restart): the old process's stream-merge state is gone even
+        # though the agent_id never expired (the operator restarts
+        # faster than the tracker's expiry window). The surviving-agent
+        # resync case is harmless — resync only follows an expiry,
+        # which already aborted the stream.
+        self._register_sub = self.bus.subscribe(
+            TOPIC_REGISTER,
+            lambda msg: self._abort_streams_of(
+                msg.get("agent_id"), "restarted (re-registered)"
+            ),
+        )
+
+    def _abort_streams_of(self, agent_id, why: str) -> None:
+        """Fail every live stream whose merge agent is gone: error to
+        the client THEN cancel directly — cleanup must not depend on
+        the client's on_update callback surviving (the bus swallows
+        handler exceptions). The atomic pop makes the abort exactly-
+        once even when expiry and re-registration race on separate
+        dispatcher threads."""
+        for qid, handle in list(self._live_streams.items()):
+            if handle.merge_agent != agent_id:
+                continue
+            if self._live_streams.pop(qid, None) is None:
+                continue  # another aborter claimed it first
+            self.bus.publish(
+                f"query.{qid}.results",
+                {"error": f"merge agent {agent_id} {why}; "
+                          f"live query {qid} aborted"},
+            )
+            handle.cancel()  # idempotent (entry already popped)
+
+    def close(self) -> None:
+        """Detach the broker from the bus: watchdog subscriptions, the
+        served API topics (if serve() ran), and any still-live streams.
+        Transient brokers on a shared bus must not keep reacting to
+        agent lifecycle events after they're discarded."""
+        for qid in list(self._live_streams):
+            handle = self._live_streams.pop(qid, None)
+            if handle is not None:
+                handle.cancel()
+        for sub in (self._expiry_sub, self._register_sub):
+            sub.unsubscribe()
+        for sub in getattr(self, "_serve_subs", []):
+            sub.unsubscribe()
 
     def execute_script(
         self,
@@ -298,8 +363,14 @@ class QueryBroker:
                 cell["handle"].cancel()
 
         sub = self.bus.subscribe(f"query.{qid}.results", _relay)
-        handle = StreamHandle(qid, self, sub)
+        handle = StreamHandle(qid, self, sub, merge_agent=merge_agent)
         cell["handle"] = handle
+        self._live_streams[qid] = handle
+        # Close the planning window: if the merge agent expired between
+        # the tracker snapshot and this registration, its one-shot
+        # expiry event already fired — abort now instead of never.
+        if not self.tracker.has_agent(merge_agent):
+            self._abort_streams_of(merge_agent, "expired during planning")
         self.bus.publish(
             f"agent.{merge_agent}.stream_merge",
             {

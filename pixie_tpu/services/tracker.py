@@ -91,6 +91,11 @@ class AgentTracker:
                     asid=rec.info.asid,
                 )
 
+    def has_agent(self, agent_id: str) -> bool:
+        """True while ``agent_id`` is registered and unexpired."""
+        with self._lock:
+            return agent_id in self._agents
+
     def agents_info(self) -> list:
         """Live-agent status rows (id, asid, kind, heartbeat age, tables)."""
         now = time.monotonic()

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import os
 import subprocess
-import threading
 import time
 
 import pytest
@@ -55,7 +54,6 @@ def test_soak_query_through_role_kill():
     rec.run_as_thread()
     results = []  # (t, ok, err)
     stream_updates = []
-    stop = threading.Event()
 
     def one_query():
         try:
@@ -102,7 +100,6 @@ def test_soak_query_through_role_kill():
         sub.cancel()
         stream_client.close()
     finally:
-        stop.set()
         rec.stop()
 
     assert killed["pid"] is not None, "never reached the kill phase"

@@ -351,3 +351,107 @@ class TestNetbusStreaming:
             assert not broker._stream_handles
         finally:
             server.close()
+
+    def test_merge_agent_expiry_fails_stream_loudly(self):
+        """Stream watchdog: a live query whose MERGE agent dies must
+        deliver {error} to the client once the tracker expires the
+        agent — never a forever-silent subscription (reference: the
+        forwarder's producer watchdog)."""
+        from pixie_tpu.services.agent import KelvinAgent, PEMAgent
+        from pixie_tpu.services.msgbus import MessageBus
+        from pixie_tpu.services.query_broker import QueryBroker
+        from pixie_tpu.services.tracker import AgentTracker
+
+        bus = MessageBus()
+        tracker = AgentTracker(bus, expiry_s=0.6, check_interval_s=0.1)
+        pem = PEMAgent(bus, "pem-w", heartbeat_interval_s=0.1).start()
+        kelvin = KelvinAgent(bus, "kelvin-w", heartbeat_interval_s=0.1).start()
+        _push(pem, 0, 500, seed=3)
+        pem._register()
+        deadline = time.time() + 5
+        while time.time() < deadline and len(tracker.schemas()) < 1:
+            time.sleep(0.01)
+        broker = QueryBroker(bus, tracker)
+        updates = []
+        try:
+            handle = broker.execute_script_streaming(
+                AGG_Q, on_update=updates.append, poll_interval_s=0.05
+            )
+            deadline = time.time() + 5
+            while not updates and time.time() < deadline:
+                time.sleep(0.02)
+            assert updates, "stream never started"
+            assert broker._live_streams  # watchdog is tracking it
+            # Merge agent dies WITHOUT deregistering (SIGKILL analog:
+            # heartbeats just stop).
+            kelvin.stop()
+            deadline = time.time() + 10
+            while time.time() < deadline and not any(
+                "error" in u for u in updates
+            ):
+                time.sleep(0.05)
+            errs = [u for u in updates if "error" in u]
+            assert errs, "merge-agent death never surfaced to the client"
+            assert "expired" in errs[0]["error"]
+            # the errored stream reaped its watchdog entry
+            deadline = time.time() + 5
+            while broker._live_streams and time.time() < deadline:
+                time.sleep(0.05)
+            assert not broker._live_streams
+            assert handle.merge_agent == "kelvin-w"
+        finally:
+            pem.stop()
+            kelvin.stop()
+            tracker.close()
+
+    def test_merge_agent_restart_fails_stream_before_expiry(self):
+        """An operator restarts a crashed merge agent FASTER than the
+        tracker expiry window: the new incarnation's re-registration
+        (same agent_id) must abort the old stream — its merge state
+        died with the old process even though the agent_id never
+        expired."""
+        from pixie_tpu.services.agent import KelvinAgent, PEMAgent
+        from pixie_tpu.services.msgbus import MessageBus
+        from pixie_tpu.services.query_broker import QueryBroker
+        from pixie_tpu.services.tracker import AgentTracker
+
+        bus = MessageBus()
+        tracker = AgentTracker(bus, expiry_s=60.0, check_interval_s=60.0)
+        pem = PEMAgent(bus, "pem-r", heartbeat_interval_s=0.1).start()
+        kelvin = KelvinAgent(bus, "kelvin-r", heartbeat_interval_s=0.1).start()
+        _push(pem, 0, 500, seed=4)
+        pem._register()
+        deadline = time.time() + 5
+        while time.time() < deadline and len(tracker.schemas()) < 1:
+            time.sleep(0.01)
+        broker = QueryBroker(bus, tracker)
+        updates = []
+        kelvin2 = None
+        try:
+            broker.execute_script_streaming(
+                AGG_Q, on_update=updates.append, poll_interval_s=0.05
+            )
+            deadline = time.time() + 5
+            while not updates and time.time() < deadline:
+                time.sleep(0.02)
+            assert updates, "stream never started"
+            # crash + operator restart: same id, new incarnation
+            kelvin.stop()
+            kelvin2 = KelvinAgent(
+                bus, "kelvin-r", heartbeat_interval_s=0.1
+            ).start()
+            deadline = time.time() + 10
+            while time.time() < deadline and not any(
+                "error" in u for u in updates
+            ):
+                time.sleep(0.05)
+            errs = [u for u in updates if "error" in u]
+            assert errs, "restart never surfaced (expiry is 60s away)"
+            assert "re-registered" in errs[0]["error"]
+            assert not broker._live_streams
+        finally:
+            pem.stop()
+            kelvin.stop()
+            if kelvin2 is not None:
+                kelvin2.stop()
+            tracker.close()
