@@ -102,15 +102,15 @@ class StreamHandle:
     streaming cursors and detaches the subscriber."""
 
     def __init__(self, qid: str, broker: "QueryBroker", sub,
-                 merge_agent: str = ""):
+                 merge_agent: str = "", data_agents: tuple = ()):
         self.qid = qid
         self.merge_agent = merge_agent
+        self.data_agents = tuple(data_agents)
         self._broker = broker
         self._sub = sub
 
     def cancel(self) -> None:
         self._broker._live_streams.pop(self.qid, None)
-        self._broker._stream_handles.pop(self.qid, None)
         self._broker.bus.publish("query.cancel", {"qid": self.qid})
         if self._sub is not None:
             self._sub.unsubscribe()
@@ -142,8 +142,6 @@ class QueryBroker:
         # Dynamic-tracing support (the MutationExecutor dependency,
         # mutation_executor.go:84); wire a TracepointRegistry to enable.
         self.tracepoints = None
-        # Live queries started over the bus (qid -> StreamHandle).
-        self._stream_handles: dict = {}
         # Every live stream's handle (qid -> StreamHandle): the stream
         # watchdog. A stream whose MERGE agent expires can never emit
         # again (data-agent loss re-merges from survivors instead), so
@@ -160,34 +158,45 @@ class QueryBroker:
                 msg.get("agent_id"), "expired"
             ),
         )
-        # A RE-registration of the merge agent means a new incarnation
-        # (restart): the old process's stream-merge state is gone even
-        # though the agent_id never expired (the operator restarts
-        # faster than the tracker's expiry window). The surviving-agent
-        # resync case is harmless — resync only follows an expiry,
-        # which already aborted the stream.
+        # A RE-registration of a PLANNED agent means a new incarnation
+        # (restart): the old process's stream state — merge carries on
+        # a kelvin, the streaming cursor + bridge on a data agent — is
+        # gone even though the agent_id never expired (the operator
+        # restarts faster than the tracker's expiry window). A restarted
+        # data agent's slice would otherwise silently never rejoin the
+        # view (a permanently partial live aggregate); aborting lets the
+        # client re-plan against the new topology. The surviving-agent
+        # resync case only follows an expiry, which already aborted
+        # merge-dead streams and degraded data-dead ones visibly.
         self._register_sub = self.bus.subscribe(
             TOPIC_REGISTER,
             lambda msg: self._abort_streams_of(
-                msg.get("agent_id"), "restarted (re-registered)"
+                msg.get("agent_id"), "restarted (re-registered)",
+                include_data_agents=True,
             ),
         )
 
-    def _abort_streams_of(self, agent_id, why: str) -> None:
-        """Fail every live stream whose merge agent is gone: error to
-        the client THEN cancel directly — cleanup must not depend on
-        the client's on_update callback surviving (the bus swallows
-        handler exceptions). The atomic pop makes the abort exactly-
-        once even when expiry and re-registration race on separate
-        dispatcher threads."""
+    def _abort_streams_of(self, agent_id, why: str,
+                          include_data_agents: bool = False) -> None:
+        """Fail every live stream that planned ``agent_id`` as its merge
+        agent (always) or as a data agent (``include_data_agents``):
+        error to the client THEN cancel directly — cleanup must not
+        depend on the client's on_update callback surviving (the bus
+        swallows handler exceptions). The atomic pop makes the abort
+        exactly-once even when expiry and re-registration race on
+        separate dispatcher threads."""
         for qid, handle in list(self._live_streams.items()):
-            if handle.merge_agent != agent_id:
+            if handle.merge_agent == agent_id:
+                role = "merge agent"
+            elif include_data_agents and agent_id in handle.data_agents:
+                role = "data agent"
+            else:
                 continue
             if self._live_streams.pop(qid, None) is None:
                 continue  # another aborter claimed it first
             self.bus.publish(
                 f"query.{qid}.results",
-                {"error": f"merge agent {agent_id} {why}; "
+                {"error": f"{role} {agent_id} {why}; "
                           f"live query {qid} aborted"},
             )
             handle.cancel()  # idempotent (entry already popped)
@@ -363,14 +372,17 @@ class QueryBroker:
                 cell["handle"].cancel()
 
         sub = self.bus.subscribe(f"query.{qid}.results", _relay)
-        handle = StreamHandle(qid, self, sub, merge_agent=merge_agent)
+        handle = StreamHandle(qid, self, sub, merge_agent=merge_agent,
+                              data_agents=data_agents)
         cell["handle"] = handle
         self._live_streams[qid] = handle
         # Close the planning window: if the merge agent expired between
         # the tracker snapshot and this registration, its one-shot
-        # expiry event already fired — abort now instead of never.
+        # expiry event already fired — abort now instead of never (and
+        # skip dispatch: no point starting cursors for a dead query).
         if not self.tracker.has_agent(merge_agent):
             self._abort_streams_of(merge_agent, "expired during planning")
+            return handle
         self.bus.publish(
             f"agent.{merge_agent}.stream_merge",
             {
@@ -469,7 +481,7 @@ class QueryBroker:
                     # receivers means it disconnected — reap the stream
                     # rather than polling for a ghost.
                     if self.bus.publish(_topic, u) == 0:
-                        h = self._stream_handles.pop(
+                        h = self._live_streams.pop(
                             handle_box.get("qid"), None
                         )
                         if h is not None:
@@ -483,13 +495,12 @@ class QueryBroker:
                     now_ns=int(msg.get("now_ns", 0)),
                 )
                 handle_box["qid"] = handle.qid
-                self._stream_handles[handle.qid] = handle
                 _reply(msg, {"ok": True, "qid": handle.qid})
             except Exception as e:
                 _reply(msg, {"ok": False, "error": f"{type(e).__name__}: {e}"})
 
         def _on_stream_cancel(msg):
-            handle = self._stream_handles.pop(msg.get("qid"), None)
+            handle = self._live_streams.pop(msg.get("qid"), None)
             if handle is not None:
                 handle.cancel()
             _reply(msg, {"ok": True})

@@ -97,6 +97,21 @@ def test_soak_query_through_role_kill():
                 subprocess.run(["kill", "-9", str(st["pid"])], check=True)
                 killed = {"pid": st["pid"], "t": time.time()}
             time.sleep(2)
+        # The killed PEM's restart aborts the stream with a visible
+        # error (its new incarnation can't rejoin the old plan); a
+        # fresh stream against the new topology must then deliver.
+        stream_errs = [u for u in stream_updates if "error" in u]
+        assert stream_errs, "data-agent restart never surfaced to stream"
+        ups2 = []
+        sub2 = stream_client.stream_script(
+            QUERY, on_update=ups2.append, poll_interval_s=0.5
+        )
+        deadline = time.time() + 30
+        while len([u for u in ups2 if "rows" in u]) < 2 and \
+                time.time() < deadline:
+            time.sleep(0.5)
+        assert len([u for u in ups2 if "rows" in u]) >= 2
+        sub2.cancel()
         sub.cancel()
         stream_client.close()
     finally:
@@ -111,7 +126,8 @@ def test_soak_query_through_role_kill():
     # The operator recorded the crash and restarted the role.
     kinds = [e[1] for e in rec.events]
     assert "crashed" in kinds and "restarted" in kinds
-    # The live stream kept delivering across the kill.
+    # The live stream delivered before the kill and errored cleanly at
+    # the restart (never a silent partial view).
     assert len([u for u in stream_updates if "rows" in u]) >= 3
     # Overall availability: the only tolerated failures sit inside the
     # 30s recovery window.

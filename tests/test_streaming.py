@@ -346,9 +346,9 @@ class TestNetbusStreaming:
             assert "svc-0" in p.stdout  # dictionary-decoded group key
             # cancel reached the broker: the stream handle is reaped
             deadline = time.time() + 5
-            while broker._stream_handles and time.time() < deadline:
+            while broker._live_streams and time.time() < deadline:
                 time.sleep(0.05)
-            assert not broker._stream_handles
+            assert not broker._live_streams
         finally:
             server.close()
 
