@@ -19,7 +19,7 @@ import struct
 import threading
 
 from .msgbus import MessageBus
-from .wire import decode, encode
+from .wire import WireError, decode, encode
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 30
@@ -223,7 +223,9 @@ class _ClientConn:
                     sub = self._subs.pop(frame["sid"], None)
                     if sub is not None:
                         sub.unsubscribe()
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, WireError):
+            # WireError: the peer sent a corrupted/hostile frame — the
+            # stream is unparseable from here, drop the connection.
             pass
         finally:
             self.close()
@@ -396,7 +398,9 @@ class RemoteBus:
                         sub = self._handlers.get(frame["sid"])
                     if sub is not None:
                         sub._deliver(frame["msg"])
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, WireError):
+            # WireError: the peer sent a corrupted/hostile frame — the
+            # stream is unparseable from here, drop the connection.
             pass
         finally:
             self._closed.set()

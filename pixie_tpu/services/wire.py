@@ -313,6 +313,10 @@ def _dec(r: _Reader):
         count = 1
         for d in shape:
             count *= d
+        # Every element takes >= 1 byte on the wire: a corrupted shape
+        # must fail as truncation, not as a giant up-front allocation.
+        if count > len(r.buf) - r.pos:
+            raise WireError("object-array count exceeds buffer")
         arr = np.empty(count, dtype=object)
         for i in range(count):
             arr[i] = _dec(r)
@@ -339,6 +343,25 @@ def _dec(r: _Reader):
     raise WireError(f"unknown wire tag {tag!r}")
 
 
+def _dec_guarded(r: _Reader):
+    """_dec with the failure surface promised to transports: ANY
+    malformed input raises WireError. Corruption otherwise leaks
+    ValueError/UnicodeDecodeError/KeyError/TypeError/struct.error out
+    of the tag handlers and object constructors (fuzz-verified), and
+    transport read loops only treat WireError/ConnectionError as
+    "drop this peer"."""
+    try:
+        return _dec(r)
+    except WireError:
+        raise
+    except (ValueError, KeyError, TypeError, AttributeError, IndexError,
+            OverflowError, UnicodeDecodeError, struct.error,
+            RecursionError) as e:
+        raise WireError(
+            f"malformed message: {type(e).__name__}: {e}"
+        ) from None
+
+
 def decode(buf: bytes):
     if not buf:
         raise WireError("empty message")
@@ -346,7 +369,7 @@ def decode(buf: bytes):
         raise WireError(f"wire version {buf[0]} != {WIRE_VERSION}")
     r = _Reader(buf)
     r.pos = 1
-    obj = _dec(r)
+    obj = _dec_guarded(r)
     if r.pos != len(buf):
         raise WireError(f"{len(buf) - r.pos} trailing bytes")
     return obj

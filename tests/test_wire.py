@@ -252,3 +252,46 @@ class TestMultiProcessCluster:
             tracker.close()
             server.close()
             bus.close()
+
+
+class TestDecodeFuzz:
+    def test_corruption_only_raises_wire_error(self):
+        """The transport contract: ANY corrupted frame decodes to
+        WireError (or a valid value when the flip lands in padding) —
+        never UnicodeDecodeError/KeyError/TypeError/MemoryError leaking
+        into the netbus read loops, and never a giant allocation from a
+        corrupted length/shape field."""
+        import random
+
+        import numpy as np
+
+        from pixie_tpu.services.wire import WireError, decode, encode
+        from pixie_tpu.types.batch import HostBatch
+
+        hb = HostBatch.from_pydict({
+            "time_": np.arange(50, dtype=np.int64),
+            "v": np.random.default_rng(0).standard_normal(50),
+            "s": [f"x{i % 5}" for i in range(50)],
+        })
+        msg = {"op": "msg", "sid": 3,
+               "msg": {"table": "t", "batch": hb, "seq": 7,
+                       "nested": [1, 2.5, None, True, ("a", b"bytes")]}}
+        buf = bytearray(encode(msg))
+        rng = random.Random(7)
+        for _trial in range(2000):
+            b = bytearray(buf)
+            for _ in range(rng.randint(1, 4)):
+                b[rng.randrange(len(b))] = rng.randrange(256)
+            try:
+                decode(bytes(b))
+            except WireError:
+                pass
+
+    def test_recursion_bomb_is_wire_error(self):
+        from pixie_tpu.services.wire import WIRE_VERSION, WireError, decode
+
+        bomb = bytes([WIRE_VERSION]) + b"U\x01\x00\x00\x00" * 3000 + b"N"
+        import pytest
+
+        with pytest.raises(WireError, match="Recursion"):
+            decode(bomb)
