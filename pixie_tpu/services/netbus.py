@@ -223,9 +223,12 @@ class _ClientConn:
                     sub = self._subs.pop(frame["sid"], None)
                     if sub is not None:
                         sub.unsubscribe()
-        except (ConnectionError, OSError, WireError):
-            # WireError: the peer sent a corrupted/hostile frame — the
-            # stream is unparseable from here, drop the connection.
+        except (ConnectionError, OSError, WireError,
+                AttributeError, KeyError, TypeError):
+            # WireError: corrupted/hostile frame. AttributeError/
+            # KeyError/TypeError: the frame DECODED but has the wrong
+            # schema (non-dict, missing keys, unhashable sid) — equally
+            # malformed; drop the connection either way.
             pass
         finally:
             self.close()
@@ -398,9 +401,12 @@ class RemoteBus:
                         sub = self._handlers.get(frame["sid"])
                     if sub is not None:
                         sub._deliver(frame["msg"])
-        except (ConnectionError, OSError, WireError):
-            # WireError: the peer sent a corrupted/hostile frame — the
-            # stream is unparseable from here, drop the connection.
+        except (ConnectionError, OSError, WireError,
+                AttributeError, KeyError, TypeError):
+            # WireError: corrupted/hostile frame. AttributeError/
+            # KeyError/TypeError: the frame DECODED but has the wrong
+            # schema (non-dict, missing keys, unhashable sid) — equally
+            # malformed; drop the connection either way.
             pass
         finally:
             self._closed.set()

@@ -317,9 +317,13 @@ def _dec(r: _Reader):
         # must fail as truncation, not as a giant up-front allocation.
         if count > len(r.buf) - r.pos:
             raise WireError("object-array count exceeds buffer")
+        # Decode into a list FIRST: allocation stays proportional to
+        # elements actually present (truncation fails fast) instead of
+        # an up-front count-sized pointer array from a hostile shape.
+        vals = [_dec(r) for _ in range(count)]
         arr = np.empty(count, dtype=object)
-        for i in range(count):
-            arr[i] = _dec(r)
+        for i, v in enumerate(vals):
+            arr[i] = v  # per-element: sequences must not broadcast
         return arr.reshape(shape)
     if tag == b"U":
         (n,) = _U32.unpack(r.take(4))
@@ -356,7 +360,7 @@ def _dec_guarded(r: _Reader):
         raise
     except (ValueError, KeyError, TypeError, AttributeError, IndexError,
             OverflowError, UnicodeDecodeError, struct.error,
-            RecursionError) as e:
+            RecursionError, MemoryError) as e:
         raise WireError(
             f"malformed message: {type(e).__name__}: {e}"
         ) from None

@@ -675,3 +675,108 @@ class TestParserHardeningR5:
         (rec,) = st.drain()
         assert rec["latency_ns"] == 12
         assert st.parse_errors == 0
+
+
+class TestParserFuzz:
+    """No byte stream may crash a stitcher: feed() must absorb garbage,
+    random flips of valid traffic, and pathological chunking without
+    raising (the socket tracer's resilience contract — kernel captures
+    are arbitrarily truncated/corrupted). Counters may move; exceptions
+    may not."""
+
+    def _stitchers(self):
+        from pixie_tpu.ingest.amqp_parser import AMQPStitcher
+        from pixie_tpu.ingest.cql_parser import CQLStitcher
+        from pixie_tpu.ingest.http2_parser import HTTP2Stitcher
+        from pixie_tpu.ingest.http_parser import HTTPStitcher
+        from pixie_tpu.ingest.kafka_parser import KafkaStitcher
+        from pixie_tpu.ingest.mux_parser import MuxStitcher
+        from pixie_tpu.ingest.mysql_parser import MySQLStitcher
+        from pixie_tpu.ingest.nats_parser import NATSStitcher
+        from pixie_tpu.ingest.pgsql_parser import PgSQLStitcher
+        from pixie_tpu.ingest.redis_parser import RedisStitcher
+
+        return {
+            "http": HTTPStitcher, "http2": HTTP2Stitcher,
+            "mysql": MySQLStitcher, "pgsql": PgSQLStitcher,
+            "redis": RedisStitcher, "kafka": KafkaStitcher,
+            "cql": CQLStitcher, "nats": NATSStitcher,
+            "mux": MuxStitcher, "amqp": AMQPStitcher,
+        }
+
+    def test_random_bytes_never_raise(self):
+        import random
+
+        rng = random.Random(11)
+        for name, cls in self._stitchers().items():
+            st = cls()
+            for trial in range(60):
+                blob = bytes(
+                    rng.randrange(256)
+                    for _ in range(rng.randrange(1, 400))
+                )
+                # random chunking, both directions, two connections
+                off = 0
+                while off < len(blob):
+                    k = rng.randrange(1, 64)
+                    st.feed(trial % 2, blob[off:off + k],
+                            is_request=bool(rng.randrange(2)),
+                            ts_ns=trial * 1000)
+                    off += k
+
+    def test_dns_random_payloads_never_raise(self):
+        import random
+
+        from pixie_tpu.ingest.dns_parser import DNSStitcher
+
+        rng = random.Random(12)
+        st = DNSStitcher()
+        for trial in range(300):
+            st.feed(bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(0, 200))),
+                    ts_ns=trial * 1000)
+
+    def test_flipped_valid_traffic_never_raises(self):
+        """Mutations of REAL protocol bytes walk deeper parser paths
+        than pure noise."""
+        import random
+
+        from pixie_tpu.ingest.redis_parser import RedisStitcher
+
+        import struct
+
+        from pixie_tpu.ingest.kafka_parser import KafkaStitcher
+        from pixie_tpu.ingest.mysql_parser import MySQLStitcher
+        from pixie_tpu.ingest.pgsql_parser import PgSQLStitcher
+
+        def my_pkt(seq, payload):
+            return struct.pack("<I", len(payload))[:3] + bytes([seq]) + payload
+
+        samples = {
+            RedisStitcher: (
+                b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$5\r\nhello\r\n",
+                b"+OK\r\n",
+            ),
+            MySQLStitcher: (
+                my_pkt(0, b"\x03SELECT 1"),
+                my_pkt(1, b"\x00\x00\x00\x02\x00\x00\x00"),
+            ),
+            PgSQLStitcher: (
+                b"Q" + struct.pack(">I", 13) + b"SELECT 1;\x00",
+                b"C" + struct.pack(">I", 13) + b"SELECT 1\x00"
+                + b"Z" + struct.pack(">I", 5) + b"I",
+            ),
+            KafkaStitcher: (kafka_req(0, 9, 7), kafka_resp(7)),
+        }
+        rng = random.Random(13)
+        for cls, (valid_req, valid_resp) in samples.items():
+            for trial in range(250):
+                st = cls()
+                req = bytearray(valid_req)
+                for _ in range(rng.randrange(1, 4)):
+                    req[rng.randrange(len(req))] = rng.randrange(256)
+                resp = bytearray(valid_resp)
+                if trial % 3 == 0:  # corrupt the response too
+                    resp[rng.randrange(len(resp))] = rng.randrange(256)
+                st.feed(1, bytes(req), is_request=True, ts_ns=1)
+                st.feed(1, bytes(resp), is_request=False, ts_ns=2)
