@@ -73,6 +73,15 @@ def _send_frame(sock: socket.socket, obj) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
+#: Required keys per frame op (both directions share the codec).
+_FRAME_KEYS = {
+    "pub": ("topic", "msg"),
+    "sub": ("topic", "sid"),
+    "unsub": ("sid",),
+    "msg": ("sid", "msg"),
+}
+
+
 def _recv_frame(sock: socket.socket):
     header = _recv_exact(sock, 4)
     if header is None:
@@ -83,7 +92,27 @@ def _recv_frame(sock: socket.socket):
     payload = _recv_exact(sock, n)
     if payload is None:
         return None
-    return decode(payload)
+    frame = decode(payload)
+    # Schema gate at the frame boundary: a frame that decodes but has
+    # the wrong SHAPE (non-dict, non-str op/topic, non-int sid) is just
+    # as malformed as undecodable bytes — fail it here as WireError so
+    # the read loops keep their narrow except lists and no handler runs
+    # on hostile input (e.g. bus.subscribe before an unhashable-sid
+    # lookup raised would leak the subscription forever).
+    if not isinstance(frame, dict):
+        raise WireError(f"frame is {type(frame).__name__}, not a dict")
+    op = frame.get("op")
+    if not isinstance(op, str):
+        raise WireError("frame has no string 'op'")
+    required = _FRAME_KEYS.get(op, ())
+    for key in required:
+        if key not in frame:
+            raise WireError(f"'{op}' frame missing {key!r}")
+    if "topic" in frame and not isinstance(frame["topic"], str):
+        raise WireError("frame 'topic' is not a string")
+    if "sid" in frame and not isinstance(frame["sid"], int):
+        raise WireError("frame 'sid' is not an int")
+    return frame
 
 
 def _recv_exact(sock: socket.socket, n: int):
@@ -223,12 +252,10 @@ class _ClientConn:
                     sub = self._subs.pop(frame["sid"], None)
                     if sub is not None:
                         sub.unsubscribe()
-        except (ConnectionError, OSError, WireError,
-                AttributeError, KeyError, TypeError):
-            # WireError: corrupted/hostile frame. AttributeError/
-            # KeyError/TypeError: the frame DECODED but has the wrong
-            # schema (non-dict, missing keys, unhashable sid) — equally
-            # malformed; drop the connection either way.
+        except (ConnectionError, OSError, WireError):
+            # WireError covers corrupted bytes AND wrong-schema frames
+            # (validated in _recv_frame) — drop the connection; real
+            # handler bugs still raise visibly.
             pass
         finally:
             self.close()
@@ -401,12 +428,10 @@ class RemoteBus:
                         sub = self._handlers.get(frame["sid"])
                     if sub is not None:
                         sub._deliver(frame["msg"])
-        except (ConnectionError, OSError, WireError,
-                AttributeError, KeyError, TypeError):
-            # WireError: corrupted/hostile frame. AttributeError/
-            # KeyError/TypeError: the frame DECODED but has the wrong
-            # schema (non-dict, missing keys, unhashable sid) — equally
-            # malformed; drop the connection either way.
+        except (ConnectionError, OSError, WireError):
+            # WireError covers corrupted bytes AND wrong-schema frames
+            # (validated in _recv_frame) — drop the connection; real
+            # handler bugs still raise visibly.
             pass
         finally:
             self._closed.set()

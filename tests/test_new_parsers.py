@@ -689,12 +689,10 @@ class TestParserFuzz:
         from pixie_tpu.ingest.cql_parser import CQLStitcher
         from pixie_tpu.ingest.http2_parser import HTTP2Stitcher
         from pixie_tpu.ingest.http_parser import HTTPStitcher
-        from pixie_tpu.ingest.kafka_parser import KafkaStitcher
         from pixie_tpu.ingest.mux_parser import MuxStitcher
         from pixie_tpu.ingest.mysql_parser import MySQLStitcher
         from pixie_tpu.ingest.nats_parser import NATSStitcher
         from pixie_tpu.ingest.pgsql_parser import PgSQLStitcher
-        from pixie_tpu.ingest.redis_parser import RedisStitcher
 
         return {
             "http": HTTPStitcher, "http2": HTTP2Stitcher,
@@ -741,11 +739,9 @@ class TestParserFuzz:
         than pure noise."""
         import random
 
-        from pixie_tpu.ingest.redis_parser import RedisStitcher
 
         import struct
 
-        from pixie_tpu.ingest.kafka_parser import KafkaStitcher
         from pixie_tpu.ingest.mysql_parser import MySQLStitcher
         from pixie_tpu.ingest.pgsql_parser import PgSQLStitcher
 

@@ -651,3 +651,39 @@ class TestPatternMatcher:
         plan2.add(ResultSinkOp(name="out"), [proj])
         drop_noop_maps(plan2)
         assert any(isinstance(n.op, MapOp) for n in plan2.nodes.values())
+
+
+class TestCompilerFuzz:
+    def test_mutated_scripts_raise_only_pxl_error(self):
+        """API contract: ANY malformed script fails as PxLError (the
+        broker forwards its message verbatim to clients) — never an
+        arbitrary exception class from the AST walk or binding."""
+        import random
+
+        from pixie_tpu.ingest.schemas import CANONICAL_SCHEMAS
+        from pixie_tpu.scripts import list_scripts, load_script
+        from pixie_tpu.udf.registry import default_registry
+
+        state_kw = dict(
+            schemas=dict(CANONICAL_SCHEMAS),
+            registry=default_registry(),
+            now_ns=10**18, max_output_rows=10_000,
+        )
+        rng = random.Random(5)
+        srcs = [load_script(n).pxl for n in list_scripts()[:12]]
+        chars = "abcdef_.()[]'\"=,0123456789 \n+-*/<>%"
+        for _trial in range(120):
+            src = list(rng.choice(srcs))
+            for _ in range(rng.randint(1, 5)):
+                i = rng.randrange(len(src))
+                op = rng.randrange(3)
+                if op == 0:
+                    src[i] = rng.choice(chars)
+                elif op == 1:
+                    del src[i]
+                else:
+                    src.insert(i, rng.choice(chars))
+            try:
+                compile_pxl("".join(src), CompilerState(**state_kw))
+            except PxLError:
+                pass
