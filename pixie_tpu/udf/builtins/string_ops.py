@@ -40,3 +40,12 @@ def register(reg):
     dict_udf("atoi", (STRING,), INT64, _atoi)
     dict_udf("startswith", (STRING, STRING), BOOLEAN, lambda s, p: s.startswith(p))
     dict_udf("endswith", (STRING, STRING), BOOLEAN, lambda s, p: s.endswith(p))
+    from ...types.semantic import SemanticType
+
+    reg.scalar(
+        "pod_name_to_namespace", (STRING,), STRING,
+        lambda s: s.split("/", 1)[0] if "/" in s else "",
+        executor=Executor.HOST_DICT,
+        semantic_type=int(SemanticType.ST_NAMESPACE_NAME),
+        doc="Namespace of a 'namespace/pod' name ('' if unqualified).",
+    )

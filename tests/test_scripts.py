@@ -730,3 +730,15 @@ class TestScriptSemantics:
         got = dict(zip(zip(d["timestamp"].tolist(), d["comm"]),
                        d["exits"].tolist()))
         assert got == dict(want)
+
+    def test_namespaces_groups(self, all_tables_engine):
+        s = load_script("px/namespaces")
+        out = all_tables_engine.execute_query(s.pxl)["output"].to_pydict()
+        hb = self._read(all_tables_engine, "http_events")
+        pods = np.array([hb.dicts["pod"].strings[i]
+                         for i in hb.cols["pod"][0]])
+        ns = np.array([p.split("/", 1)[0] if "/" in p else "" for p in pods])
+        got = dict(zip(out["namespace"], out["requests"].tolist()))
+        import collections
+
+        assert got == dict(collections.Counter(ns.tolist()))

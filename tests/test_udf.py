@@ -492,3 +492,24 @@ class TestBlockedCumsum:
         np.testing.assert_array_equal(
             np.asarray(blocked_cumsum(jnp.asarray(x), force=True)),
             np.cumsum(x))
+
+
+class TestPodNameToNamespace:
+    def test_split_and_fallback(self):
+        import numpy as np
+
+        from pixie_tpu.exec.engine import Engine
+
+        eng = Engine()
+        eng.append_data("t", {
+            "time_": np.arange(4, dtype=np.int64),
+            "pod": ["prod/api-1", "staging/worker-2", "bare-pod", "a/b/c"],
+        })
+        out = eng.execute_query(
+            "import px\ndf = px.DataFrame(table='t')\n"
+            "df.ns = px.pod_name_to_namespace(df.pod)\n"
+            "df = df[['pod', 'ns']]\npx.display(df)"
+        )["output"].to_pydict()
+        got = dict(zip(out["pod"], out["ns"]))
+        assert got == {"prod/api-1": "prod", "staging/worker-2": "staging",
+                       "bare-pod": "", "a/b/c": "a"}
