@@ -255,6 +255,83 @@ def _push_encoded(eng, name, rel, col_fn, n, window, dicts):
         eng.append_data(name, hb)
 
 
+#: Pipeline overlap report of the most recent ``_time_query`` (merged
+#: into each shape's result dict via ``_with_pipeline``).
+_LAST_PIPELINE: dict | None = None
+
+
+def _host_equal(a: dict, b: dict) -> bool:
+    """Exact equality of two {name: HostBatch} query outputs."""
+    if set(a) != set(b):
+        return False
+    for k in a:
+        da, db = a[k].to_pydict(), b[k].to_pydict()
+        if set(da) != set(db):
+            return False
+        for c in da:
+            if not np.array_equal(da[c], db[c]):
+                return False
+    return True
+
+
+def _flag_override(name, value):
+    """Scoped flag override preserving any pre-existing one."""
+    from pixie_tpu.config import override_flag
+
+    return override_flag(name, value)
+
+
+def _pipeline_ab(eng, query, host_ref) -> dict:
+    """A/B the window pipeline: serial (depth=1) vs pipelined (depth>=2)
+    with device residency OFF, so every window pays the real host
+    slicing + packing + device_put staging cost the pipeline exists to
+    hide (resident windows skip staging entirely and overlap ~nothing).
+    ``checked`` asserts the two modes' outputs are bit-identical and
+    match the resident-path result."""
+    saved_depth = eng.pipeline_depth
+    depth = max(2, saved_depth)
+    secs, host, pl = {}, {}, {}
+    try:
+        with _flag_override("device_residency", False):
+            for label, d in (("serial", 1), ("pipelined", depth)):
+                eng.pipeline_depth = d
+                t0 = time.perf_counter()
+                out = eng.execute_query(query, materialize=False)
+                host[label] = {
+                    k: (v.to_host() if hasattr(v, "to_host") else v)
+                    for k, v in out.items()
+                }
+                secs[label] = time.perf_counter() - t0
+                pl[label] = dict(eng.last_pipeline or {})
+    finally:
+        eng.pipeline_depth = saved_depth
+    stage = pl["pipelined"].get("stage_secs", 0.0)
+    stall = pl["pipelined"].get("stall_secs", 0.0)
+    return {
+        "depth": depth,
+        "serial_secs": round(secs["serial"], 4),
+        "pipelined_secs": round(secs["pipelined"], 4),
+        "speedup": round(secs["serial"] / max(secs["pipelined"], 1e-9), 3),
+        "stage_secs": round(stage, 4),
+        "stall_secs": round(stall, 4),
+        # Fraction of staging time hidden behind compute.
+        "overlap_frac": round(
+            max(0.0, min(1.0, 1.0 - stall / stage)) if stage > 0 else 1.0, 3
+        ),
+        "checked": bool(
+            _host_equal(host["serial"], host["pipelined"])
+            and _host_equal(host["pipelined"], host_ref)
+        ),
+    }
+
+
+def _with_pipeline(res: dict) -> dict:
+    """Attach the last ``_time_query`` pipeline report to a shape result."""
+    if _LAST_PIPELINE is not None:
+        res["pipeline"] = _LAST_PIPELINE
+    return res
+
+
 def _time_query(eng, query, n_rows, warm_eng=None, profile=False):
     """(rows/s, secs, host result[, profile]) for the steady-state run.
 
@@ -266,7 +343,15 @@ def _time_query(eng, query, n_rows, warm_eng=None, profile=False):
     table staging outside the timer; the timed run measures the query's
     real execution (fold + finalize + readback) in the synchronous
     regime against the already-resident table.
+
+    Unless PIXIE_TPU_BENCH_AB=0, an A/B pass afterwards re-runs the
+    query with device residency off at pipeline_depth 1 vs >=2 — the
+    host-staged regime where the window-prefetch pipeline earns its keep
+    — and reports per-shape overlap efficiency (``pipeline`` key).
     """
+    global _LAST_PIPELINE
+    _LAST_PIPELINE = None
+    ab = os.environ.get("PIXIE_TPU_BENCH_AB", "1") not in ("0", "false")
     # Single-window engine first (cheap shape coverage), then the FULL
     # engine: its window count selects the scan-fold program, which must
     # exist before the flush (compiling after it can stall).
@@ -275,6 +360,16 @@ def _time_query(eng, query, n_rows, warm_eng=None, profile=False):
         for v in warm_out.values():
             if hasattr(v, "block_until_ready"):
                 v.block_until_ready()
+    if ab:
+        # Warm the host-staged program variants (mask validity instead of
+        # the device cache's (lo, hi) pairs) for the A/B pass — they too
+        # must exist before the journal flush.
+        with _flag_override("device_residency", False):
+            for e in ([warm_eng] if warm_eng is not None else []) + [eng]:
+                warm_out = e.execute_query(query, materialize=False)
+                for v in warm_out.values():
+                    if hasattr(v, "block_until_ready"):
+                        v.block_until_ready()
     # Steady state means the replay is already resident in HBM: staging
     # H2D is journaled lazily by the tunnel, so force its flush (one tiny
     # readback) before the timer starts; the timed run then measures the
@@ -299,6 +394,18 @@ def _time_query(eng, query, n_rows, warm_eng=None, profile=False):
         for k, v in out.items()
     }
     dt = time.perf_counter() - t0
+    pl = dict(eng.last_pipeline or {})
+    _LAST_PIPELINE = {
+        "depth": pl.get("depth", eng.pipeline_depth),
+        "windows": pl.get("windows", 0),
+        "stall_secs": round(pl.get("stall_secs", 0.0), 4),
+    }
+    if ab:
+        _LAST_PIPELINE["ab"] = _pipeline_ab(eng, query, host)
+        # Headline stall/overlap come from the host-staged A/B arm (the
+        # resident-path run above stages ~nothing).
+        _LAST_PIPELINE["overlap_frac"] = _LAST_PIPELINE["ab"]["overlap_frac"]
+        _LAST_PIPELINE["stall_secs"] = _LAST_PIPELINE["ab"]["stall_secs"]
     if not profile:
         return n_rows / dt, dt, host
     # Per-stage attribution (forces sync per stage; post-readback, so the
@@ -389,11 +496,11 @@ def _shape_http_stats(n, window):
     assert np.array_equal(got["n"][order], cnt[ro].astype(got["n"].dtype))
     np.testing.assert_allclose(got["lat_mean"][order], mean[ro], rtol=1e-5)
     np.testing.assert_allclose(got["lat_max"][order], mx[ro])
-    return {
+    return _with_pipeline({
         "rows": n, "rows_per_sec": round(rps), "secs": round(dt, 3),
         "vs_baseline": round(rps / (n / base_dt), 3), "checked": True,
         "profile": prof,
-    }
+    })
 
 
 def _shape_service_stats(n, window):
@@ -421,10 +528,10 @@ def _shape_service_stats(n, window):
         assert abs(p99 - r99) / r99 < 0.15, f"p99 off: {p99} vs {r99}"
         np.testing.assert_allclose(err, rerr, rtol=1e-4)
         assert thr == rthr
-    return {
+    return _with_pipeline({
         "rows": n, "rows_per_sec": round(rps), "secs": round(dt, 3),
         "vs_baseline": round(rps / (n / base_dt), 3), "checked": True,
-    }
+    })
 
 
 def _shape_net_flow_graph(n, window):
@@ -486,10 +593,10 @@ def _shape_net_flow_graph(n, window):
     ro = np.argsort(uniq)
     np.testing.assert_allclose(got["bytes_sent"][order], ref_sent[ro], rtol=1e-6)
     np.testing.assert_allclose(got["bytes_recv"][order], ref_recv[ro], rtol=1e-6)
-    return {
+    return _with_pipeline({
         "rows": n, "rows_per_sec": round(rps), "secs": round(dt, 3),
         "vs_baseline": round(rps / (n / base_dt), 3), "checked": True,
-    }
+    })
 
 
 def _shape_sql_stats(n, window):
@@ -547,10 +654,10 @@ def _shape_sql_stats(n, window):
     ro = np.argsort(uniq)
     assert np.array_equal(got["n"][order], ref_n[ro].astype(got["n"].dtype))
     np.testing.assert_allclose(got["lat_mean"][order], ref_mean[ro], rtol=1e-5)
-    return {
+    return _with_pipeline({
         "rows": n, "rows_per_sec": round(rps), "secs": round(dt, 3),
         "vs_baseline": round(rps / (n / base_dt), 3), "checked": True,
-    }
+    })
 
 
 def _shape_perf_flamegraph(n, window):
@@ -598,10 +705,10 @@ def _shape_perf_flamegraph(n, window):
     present = np.nonzero(ref)[0]
     assert np.array_equal(got["stack_trace"][order], present), "stack keys mismatch"
     np.testing.assert_allclose(got["count"][order], ref[present], rtol=1e-6)
-    return {
+    return _with_pipeline({
         "rows": n, "rows_per_sec": round(rps), "secs": round(dt, 3),
         "vs_baseline": round(rps / (n / base_dt), 3), "checked": True,
-    }
+    })
 
 
 def _shape_device_join(n, window):
@@ -674,10 +781,10 @@ px.display(out)
     assert np.array_equal(got["b"][order], present), "join keys mismatch"
     np.testing.assert_allclose(got["n"][order], ref_n[present], rtol=1e-9)
     np.testing.assert_allclose(got["s"][order], ref_s[present], rtol=1e-9)
-    return {
+    return _with_pipeline({
         "rows": 2 * n, "rows_per_sec": round(rps), "secs": round(dt, 3),
         "vs_baseline": round(rps / ((2 * n) / base_dt), 3), "checked": True,
-    }
+    })
 
 
 def inner() -> int:

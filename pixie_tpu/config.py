@@ -11,6 +11,7 @@ resolves from (in order) an explicit ``set_flag`` override, the
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from dataclasses import dataclass
@@ -77,6 +78,24 @@ def clear_flag(name: str) -> None:
         _OVERRIDES.pop(name, None)
 
 
+@contextlib.contextmanager
+def override_flag(name: str, value):
+    """Scoped ``set_flag`` that restores any PRE-EXISTING programmatic
+    override on exit (a bare set/clear pair would delete a caller's own
+    override, silently flipping later runs back to the default)."""
+    with _LOCK:
+        had = name in _OVERRIDES
+        prev = _OVERRIDES.get(name)
+    set_flag(name, value)
+    try:
+        yield
+    finally:
+        if had:
+            set_flag(name, prev)
+        else:
+            clear_flag(name)
+
+
 def all_flags() -> dict:
     """{name: (value, doc)} snapshot — the --helpfull / statusz listing."""
     return {n: (get_flag(n), f.doc) for n, f in sorted(_REGISTRY.items())}
@@ -112,6 +131,16 @@ define_flag("fold_scan_windows", 16,
             "aggregate dispatch via one lax.scan program (1 disables); "
             "each dispatch costs a tunnel round trip in the synchronous "
             "regime, so batching windows amortizes it.")
+define_flag("pipeline_depth", 2,
+            "Window-executor prefetch depth: host slicing/packing/"
+            "device_put of window N+1 runs on a background thread while "
+            "window N computes, with at most this many windows in "
+            "flight. 1 = serial (no prefetch thread, today's behavior).")
+define_flag("join_probe_window_rows", 1 << 20,
+            "Probe rows per device-join dispatch for inner/left N:M "
+            "joins: the build side is sorted and staged on device ONCE "
+            "per query and probe windows stream through the prefetch "
+            "pipeline. 0 = single-shot kernel over the whole probe side.")
 define_flag("device_residency", True,
             "Stage full table windows into device memory (HBM) at append "
             "time so steady-state queries run without host transfers.")

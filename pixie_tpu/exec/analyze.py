@@ -14,14 +14,20 @@ breakdown of the TPU streaming pipeline:
 - ``compute``  device program (update/fold), measured to completion
 - ``finalize`` agg finalize program
 - ``materialize`` device -> host copy + host batch assembly
+- ``stall``    consumer time blocked waiting on the window-prefetch
+               pipeline (pipeline_depth > 1); high stall with low stage
+               time means the device, not staging, is the bottleneck
 
 Enabling analyze forces synchronization after each stage
 (``block_until_ready``), so overlap is sacrificed for attribution — run
-benchmarks with it off.
+benchmarks with it off. With the pipelined window executor the ``stage``
+timer runs on the prefetch thread while ``compute`` runs on the query
+thread, so FragmentStats.add is lock-protected.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -42,12 +48,18 @@ class FragmentStats:
     rows_in: int = 0
     rows_out: int = 0
     stages: dict = field(default_factory=dict)  # {stage: StageStat}
+    # Staging runs on the prefetch thread concurrently with compute on
+    # the query thread (pipeline.py), so stage accumulation is locked.
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def add(self, stage: str, seconds: float, rows: int = 0) -> None:
-        s = self.stages.setdefault(stage, StageStat())
-        s.seconds += seconds
-        s.rows += int(rows)
-        s.count += 1
+        with self._lock:
+            s = self.stages.setdefault(stage, StageStat())
+            s.seconds += seconds
+            s.rows += int(rows)
+            s.count += 1
 
     def timed(self, stage: str, rows: int = 0):
         return _Timer(self, stage, rows)

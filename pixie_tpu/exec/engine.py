@@ -44,6 +44,7 @@ from .bridge import (  # noqa: F401  (re-exported)
     merge_agg_bridge,
 )
 from .fragment import compile_fragment_cached as compile_fragment
+from .pipeline import WindowPipeline
 from .joins import (  # noqa: F401  (re-exported)
     _join_dispatch,
     _union_host,
@@ -172,13 +173,23 @@ class Engine:
     ``src/carnot/engine_state.h``.)"""
 
     def __init__(self, registry: Registry | None = None,
-                 window_rows: int | None = None):
+                 window_rows: int | None = None,
+                 pipeline_depth: int | None = None):
         from ..config import get_flag
         from ..table_store import TableStore
 
         self.registry = registry or default_registry()
         self.table_store = TableStore()
         self.window_rows = window_rows or get_flag("window_rows")
+        # Window-executor prefetch depth (pipeline.py): staging of window
+        # N+1 overlaps compute of window N; 1 = serial.
+        self.pipeline_depth = int(pipeline_depth or get_flag("pipeline_depth"))
+        # Pipeline accounting: per-query snapshot + engine-lifetime totals
+        # (exported by services.observability.engine_collector).
+        self.last_pipeline: dict | None = None
+        self.pipeline_totals = {
+            "windows": 0, "stage_secs": 0.0, "stall_secs": 0.0,
+        }
         self.last_stats = None
         self._query_stats = None
         self._cancel = None  # per-query cancel event (execute_plan arg)
@@ -282,6 +293,7 @@ class Engine:
         self, plan, bridge_inputs, analyze, materialize, cancel
     ) -> dict:
         self._cancel = cancel
+        self.last_pipeline = None  # fresh per-query pipeline snapshot
         if analyze:
             from .analyze import QueryStats
 
@@ -377,7 +389,7 @@ class Engine:
                 else:
                     left = mat_input(node.inputs[0])
                     right = mat_input(node.inputs[1])
-                    results[nid] = _join_dispatch(left, right, op)
+                    results[nid] = _join_dispatch(left, right, op, self)
             elif isinstance(op, UnionOp):
                 mats = [mat_input(i) for i in node.inputs]
                 results[nid] = _union_host(mats)
@@ -514,28 +526,33 @@ class Engine:
             pend_hi.clear()
             return state
 
-        for cols, valid in self._staged_windows(stream, stats):
-            batchable = (
-                chunk_w > 1
-                and isinstance(valid, tuple)
-                and (
-                    not pend_cols
-                    or _window_shapes(cols) == _window_shapes(pend_cols[0])
+        pipe = self._window_pipeline(stream, stats)
+        try:
+            for cols, valid in pipe:
+                batchable = (
+                    chunk_w > 1
+                    and isinstance(valid, tuple)
+                    and (
+                        not pend_cols
+                        or _window_shapes(cols) == _window_shapes(pend_cols[0])
+                    )
                 )
-            )
-            with _timed(stats, "compute"):
-                if batchable:
-                    pend_cols.append(cols)
-                    pend_lo.append(valid[0])
-                    pend_hi.append(valid[1])
-                    if len(pend_cols) >= chunk_w:
+                with _timed(stats, "compute"):
+                    if batchable:
+                        pend_cols.append(cols)
+                        pend_lo.append(valid[0])
+                        pend_hi.append(valid[1])
+                        if len(pend_cols) >= chunk_w:
+                            state = flush_pending(state)
+                    else:
                         state = flush_pending(state)
-                else:
-                    state = flush_pending(state)
-                    state = agg_step(state, cols, valid)
-                _block_if(stats, state)
-            if stats is not None:
-                stats.windows += 1
+                        state = agg_step(state, cols, valid)
+                    _block_if(stats, state)
+                if stats is not None:
+                    stats.windows += 1
+        finally:
+            pipe.close()
+            self._note_pipeline(pipe)
         with _timed(stats, "compute"):
             state = flush_pending(state)
             _block_if(stats, state)
@@ -615,50 +632,63 @@ class Engine:
             # produces; the raw fast path handles scalar ops only.
             raw = None
         oob_any = False
-        for cols, valid in self._staged_windows(stream, stats):
-            with _timed(stats, "compute"):
-                if raw is not None and isinstance(valid, tuple):
-                    # Zero-device-work path: the kernel reads the staged
-                    # planes directly (keys packed in-kernel; np_view
-                    # shares the buffers, no copies).
-                    planes = [
-                        np_view(cols[c][0]) for c in raw["key_cols"]
-                    ]
+        xla_fallback = False  # aborted mid-stream: XLA re-runs the fold
+        pipe = self._window_pipeline(stream, stats)
+        try:
+            for cols, valid in pipe:
+                with _timed(stats, "compute"):
+                    if raw is not None and isinstance(valid, tuple):
+                        # Zero-device-work path: the kernel reads the
+                        # staged planes directly (keys packed in-kernel;
+                        # np_view shares the buffers, no copies).
+                        planes = [
+                            np_view(cols[c][0]) for c in raw["key_cols"]
+                        ]
+                        vals = [
+                            None if a is None
+                            else np_view(cols[raw["arg_cols"][a]][0])
+                            for _op, _dt, a in specs
+                        ]
+                        oob_n = seg_fold_raw_call(
+                            planes, raw["key_specs"], int(valid[0]),
+                            int(valid[1]), g, specs, vals, outs,
+                        )
+                        if oob_n is not None:
+                            oob_any = oob_any or oob_n > 0
+                            if stats is not None:
+                                stats.windows += 1
+                            continue
+                        # Unsupported dtype combo: fall through to the
+                        # jit form for this (and subsequent) windows.
+                    # NOTE: keep gids_dev/args referenced while the kernel
+                    # reads their zero-copy views (np_view aliases
+                    # buffers).
+                    gids_dev, args, oob = inputs_jit(cols, valid)
+                    gids = np_view(gids_dev)
                     vals = [
-                        None if a is None
-                        else np_view(cols[raw["arg_cols"][a]][0])
+                        None if a is None else np_view(args[a])
                         for _op, _dt, a in specs
                     ]
-                    oob_n = seg_fold_raw_call(
-                        planes, raw["key_specs"], int(valid[0]),
-                        int(valid[1]), g, specs, vals, outs,
-                    )
-                    if oob_n is not None:
-                        oob_any = oob_any or oob_n > 0
-                        if stats is not None:
-                            stats.windows += 1
-                        continue
-                    # Unsupported dtype combo: fall through to the jit
-                    # form for this (and subsequent) windows.
-                # NOTE: keep gids_dev/args referenced while the kernel
-                # reads their zero-copy views (np_view aliases buffers).
-                gids_dev, args, oob = inputs_jit(cols, valid)
-                gids = np_view(gids_dev)
-                vals = [
-                    None if a is None else np_view(args[a])
-                    for _op, _dt, a in specs
-                ]
-                if specs and not seg_fold_call(gids, g, specs, vals, outs):
-                    return None  # exotic dtype combo: XLA fallback
-                for _name, _init, j, w, mw in digests:
-                    v = np_view(args[j])
-                    if str(v.dtype) != "float32":
-                        return None
-                    if not tdigest_hist_call(gids, v, g, hist_shift, w, mw):
-                        return None
-                oob_any = oob_any or bool(np.asarray(oob))
-            if stats is not None:
-                stats.windows += 1
+                    if specs and not seg_fold_call(gids, g, specs, vals, outs):
+                        xla_fallback = True
+                        return None  # exotic dtype combo: XLA fallback
+                    for _name, _init, j, w, mw in digests:
+                        v = np_view(args[j])
+                        if str(v.dtype) != "float32":
+                            xla_fallback = True
+                            return None
+                        if not tdigest_hist_call(gids, v, g, hist_shift, w, mw):
+                            xla_fallback = True
+                            return None
+                    oob_any = oob_any or bool(np.asarray(oob))
+                if stats is not None:
+                    stats.windows += 1
+        finally:
+            pipe.close()
+            if not xla_fallback:
+                # A fallback's windows re-run through the XLA fold's own
+                # pipeline — noting the aborted one would double-count.
+                self._note_pipeline(pipe)
         carries = {}
         k = 0
         for out_name, treedef, n_leaves in treedefs:
@@ -771,6 +801,35 @@ class Engine:
             return
         yield from self._staged_windows_inner(stream, stats)
 
+    def _window_pipeline(self, stream: "_Stream", stats=None) -> WindowPipeline:
+        """Pipelined view of ``_staged_windows``: staging for window N+1
+        runs on a prefetch thread while the caller computes window N
+        (``pipeline_depth`` windows in flight; 1 = serial, no thread).
+        Callers MUST wrap iteration in try/finally close() — that is the
+        no-leaked-threads / no-use-after-cancel contract."""
+        return WindowPipeline(
+            self._staged_windows(stream, stats), self.pipeline_depth,
+            cancel=getattr(self, "_cancel", None), stats=stats,
+        )
+
+    def _note_pipeline(self, pipe: WindowPipeline) -> None:
+        """Fold a finished pipeline's counters into the per-query snapshot
+        (``last_pipeline``) and the engine-lifetime totals."""
+        lp = self.last_pipeline
+        if lp is None:
+            lp = self.last_pipeline = {
+                "depth": pipe.depth, "windows": 0,
+                "stage_secs": 0.0, "stall_secs": 0.0,
+            }
+        lp["depth"] = pipe.depth
+        lp["windows"] += pipe.windows
+        lp["stage_secs"] += pipe.stage_secs
+        lp["stall_secs"] += pipe.stall_secs
+        tot = self.pipeline_totals
+        tot["windows"] += pipe.windows
+        tot["stage_secs"] += pipe.stage_secs
+        tot["stall_secs"] += pipe.stall_secs
+
     def _put_side(self, v):
         """Stage one fused-join side table (DistributedEngine replicates
         over its mesh instead)."""
@@ -872,20 +931,25 @@ class Engine:
         # Non-agg: stream windows, stop early once a limit is satisfied.
         _, _, rows_step = self._compile_steps(frag)
         pieces, total = [], 0
-        for cols, valid in self._staged_windows(stream, stats):
-            with _timed(stats, "compute"):
-                out_cols, out_valid = rows_step(cols, valid)
-                _block_if(stats, (out_cols, out_valid))
-            if stats is not None:
-                stats.windows += 1
-            with _timed(stats, "materialize"):
-                piece = _to_host_batch(
-                    frag.out_meta, out_cols, np.asarray(out_valid)
-                )
-            pieces.append(piece)
-            total += piece.length
-            if frag.limit is not None and total >= frag.limit:
-                break
+        pipe = self._window_pipeline(stream, stats)
+        try:
+            for cols, valid in pipe:
+                with _timed(stats, "compute"):
+                    out_cols, out_valid = rows_step(cols, valid)
+                    _block_if(stats, (out_cols, out_valid))
+                if stats is not None:
+                    stats.windows += 1
+                with _timed(stats, "materialize"):
+                    piece = _to_host_batch(
+                        frag.out_meta, out_cols, np.asarray(out_valid)
+                    )
+                pieces.append(piece)
+                total += piece.length
+                if frag.limit is not None and total >= frag.limit:
+                    break
+        finally:
+            pipe.close()
+            self._note_pipeline(pipe)
         out = _concat_host(pieces, frag.relation)
         if stats is not None:
             stats.rows_out = out.length

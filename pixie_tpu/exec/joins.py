@@ -53,12 +53,15 @@ def _key_tuples(hb: HostBatch, on, remaps):
 DEVICE_JOIN_MIN_ROWS = 1 << 15
 
 
-def _join_dispatch(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
+def _join_dispatch(left: HostBatch, right: HostBatch, op: JoinOp,
+                   engine=None) -> HostBatch:
     """Route a join to the host N:1 path or the device N:M kernel.
 
     Reference: ``equijoin_node.cc`` always hash-joins; here small unique-
     key inner/left joins (the post-agg common case) stay on host, and
-    everything else uses ``pixie_tpu.ops.join.device_join``.
+    everything else uses ``pixie_tpu.ops.join.device_join``. ``engine``
+    (when the call comes from a query) carries the pipeline depth and
+    the per-query cancel handle into the windowed device driver.
     """
     if len(op.left_on) != len(op.right_on):
         raise QueryError("join key arity mismatch")
@@ -76,7 +79,7 @@ def _join_dispatch(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
         # XLA CPU sorts make the device kernel a regression there; the
         # vectorized numpy N:M join is the CPU-backend fast path.
         return _join_host_nm(left, right, op)
-    return _join_device(left, right, op)
+    return _join_device(left, right, op, engine)
 
 
 class _BuildNotUnique(Exception):
@@ -216,9 +219,153 @@ def _device_join_cache(n_build, n_probe, dtypes, capacity, how):
     )
 
 
-def _join_device(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
+@functools.lru_cache(maxsize=64)
+def _probe_sorted_cache(n_build_cap, n_probe_cap, capacity, how):
+    """One jitted presorted-probe kernel per (bucketed shapes, capacity,
+    how); the sorted build side and its row count are runtime args, so
+    every probe window of a query (and across queries of the same
+    shapes) reuses one program."""
+    import jax
+
+    from ..ops.join import probe_sorted_join
+
+    return jax.jit(
+        lambda sbk, rb, pk, pv: probe_sorted_join(sbk, rb, pk, pv, capacity, how)
+    )
+
+
+def _join_device_windowed(left: HostBatch, right: HostBatch, op: JoinOp,
+                          window_rows: int, engine=None) -> HostBatch:
+    """Multi-window device join driver (inner/left N:M).
+
+    The build side is packed to comparable int64 key ids, sorted, and
+    staged on device ONCE per query (the fused-join ``__side__``
+    discipline: a query-constant table rides as a reused runtime arg,
+    never re-``device_put`` per window). Probe windows then stream
+    through the window-prefetch pipeline, so staging window N+1 overlaps
+    the join kernel on window N. Output rows are bit-identical to the
+    single-shot kernel's: windows emit in probe order, and matches
+    within a probe row follow build order on both paths.
+    """
+    import jax
+
+    from ..config import get_flag
+    from .pipeline import WindowPipeline
+    from .stream import _block_if, _timed
+
+    # Under analyze, the join gets its own stage breakdown (stage /
+    # compute / stall) like every other window consumer.
+    qstats = getattr(engine, "_query_stats", None) if engine is not None \
+        else None
+    stats = qstats.new_fragment([op]) if qstats is not None else None
+
+    l_remap, r_remap, key_dicts = _align_join_dicts(left, right, op)
+    lkeys, rkeys = _packed_key_ids(left, op.left_on, l_remap,
+                                   right, op.right_on, r_remap)
+    order = np.argsort(rkeys, kind="stable")
+    rb = len(order)
+    nb = bucket_capacity(rb)
+    sentinel = np.iinfo(np.int64).max  # sorts past every real key
+    sbk = np.full(nb, sentinel, dtype=np.int64)
+    sbk[:rb] = rkeys[order]
+    sbk_dev = jax.device_put(sbk)  # staged once; reused by every window
+    rb_s = np.int32(rb)
+
+    wcap = bucket_capacity(min(window_rows, left.length))
+
+    def staged_probe_windows():
+        for off in range(0, left.length, window_rows):
+            m = min(window_rows, left.length - off)
+            with _timed(stats, "stage", rows=m):
+                pk = np.full(wcap, sentinel, dtype=np.int64)
+                pk[:m] = lkeys[off:off + m]
+                pv = np.zeros(wcap, dtype=bool)
+                pv[:m] = True
+                pk_dev, pv_dev = jax.device_put(pk), jax.device_put(pv)
+                _block_if(stats, (pk_dev, pv_dev))
+            if stats is not None:
+                stats.rows_in += m
+            yield off, pk_dev, pv_dev
+
+    parts = []  # (l_idx, l_take, r_idx, r_take) per window
+    depth = (
+        engine.pipeline_depth if engine is not None
+        else get_flag("pipeline_depth")
+    )
+    pipe = WindowPipeline(
+        staged_probe_windows(), depth,
+        cancel=getattr(engine, "_cancel", None), stats=stats,
+    )
+    # Capacity persists across windows: once one window's fan-out forces
+    # a doubling, later windows start there instead of re-overflowing.
+    capacity = bucket_capacity(max(2 * window_rows, 1))
+    try:
+        for off, pk_dev, pv_dev in pipe:
+            with _timed(stats, "compute"):
+                while True:
+                    fn = _probe_sorted_cache(nb, wcap, capacity, op.how)
+                    p_idx, p_take, b_idx, b_take, out_valid, overflow = (
+                        np.asarray(a)
+                        for a in fn(sbk_dev, rb_s, pk_dev, pv_dev)
+                    )
+                    if not bool(overflow):
+                        break
+                    capacity *= 2
+            if stats is not None:
+                stats.windows += 1
+            sel = np.nonzero(out_valid)[0]
+            parts.append((
+                p_idx[sel].astype(np.int64) + off,
+                p_take[sel],
+                order[np.clip(b_idx[sel], 0, max(rb - 1, 0))],
+                b_take[sel],
+            ))
+    finally:
+        pipe.close()
+        if engine is not None:
+            engine._note_pipeline(pipe)
+
+    def cat(i, dtype):
+        if not parts:
+            return np.zeros(0, dtype=dtype)
+        return np.concatenate([p[i] for p in parts]).astype(dtype, copy=False)
+
+    out_rel, src = _join_out_schema(left, right, op)
+    out = _assemble_join(
+        left, right, op, out_rel, src,
+        cat(0, np.int64), cat(1, bool), cat(2, np.int64), cat(3, bool),
+        r_remap=r_remap, key_dicts=key_dicts,
+    )
+    if stats is not None:
+        stats.rows_out = out.length
+    return out
+
+
+def _join_device(left: HostBatch, right: HostBatch, op: JoinOp,
+                 engine=None) -> HostBatch:
     """N:M device join: pad to bucketed capacities, run the sort-based
     kernel, re-run doubled on overflow, gather columns host-side."""
+    from ..config import get_flag
+
+    probe_window = get_flag("join_probe_window_rows")
+    if (
+        op.how in ("inner", "left")
+        and probe_window > 0
+        and left.length > probe_window
+        and right.length > 0
+    ):
+        # Same key-dtype guard as the single-shot path below — the
+        # packed-id densify would otherwise paper over a mismatch via
+        # numpy promotion (int64 vs float64 collides above 2^53).
+        for lc, rc in zip(op.left_on, op.right_on):
+            for lp_, rp_ in zip(left.cols[lc], right.cols[rc]):
+                if lp_.dtype != rp_.dtype:
+                    raise QueryError(
+                        f"join key dtype mismatch: {rp_.dtype} vs {lp_.dtype}"
+                    )
+        # Windowable joins with a big probe side: sorted build staged
+        # once, probe windows pipelined (one dispatch per window).
+        return _join_device_windowed(left, right, op, probe_window, engine)
     l_remap, r_remap, key_dicts = _align_join_dicts(left, right, op)
     probe_planes = _join_key_planes(left, op.left_on, l_remap)
     build_planes = _join_key_planes(right, op.right_on, r_remap)
@@ -367,18 +514,31 @@ def _packed_key_ids(left, left_on, l_remap, right, right_on, r_remap):
         return out
     lp = planes(left, left_on, l_remap)
     rp = planes(right, right_on, r_remap)
-    if len(lp) == 1:
-        # Single-plane keys compare directly — no densification pass.
+    if (
+        len(lp) == 1
+        and np.issubdtype(lp[0].dtype, np.integer)
+        and np.issubdtype(rp[0].dtype, np.integer)
+    ):
+        # Single-plane INTEGER keys compare directly — no densification
+        # pass (the int64 cast is equality-preserving, wrapping uints
+        # bijectively). Floats must densify: casting would truncate
+        # 1.2 and 1.7 onto the same key.
         return (lp[0].astype(np.int64, copy=False),
                 rp[0].astype(np.int64, copy=False))
-    stacked = np.stack(
-        [np.concatenate([a.astype(np.int64, copy=False),
-                         b.astype(np.int64, copy=False)])
-         for a, b in zip(lp, rp)],
-        axis=1,
-    )
-    _, inv = np.unique(stacked, axis=0, return_inverse=True)
-    inv = inv.astype(np.int64).reshape(-1)
+    # Exact densify: per-plane np.unique codes (lossless for ANY dtype —
+    # a blanket int64 cast would truncate float keys), then one unique
+    # over the code tuples for multi-plane keys.
+    codes = []
+    for a, b in zip(lp, rp):
+        _, inv = np.unique(np.concatenate([a, b]), return_inverse=True)
+        codes.append(inv.astype(np.int64).reshape(-1))
+    if len(codes) == 1:
+        inv = codes[0]
+    else:
+        _, inv = np.unique(
+            np.stack(codes, axis=1), axis=0, return_inverse=True
+        )
+        inv = inv.astype(np.int64).reshape(-1)
     return inv[: left.length], inv[left.length:]
 
 

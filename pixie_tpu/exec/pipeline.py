@@ -1,0 +1,187 @@
+"""Pipelined window executor: overlap host staging with device compute.
+
+The serial window loop stages (host slice -> pack -> ``device_put``)
+window N and only then dispatches compute for it, so the host idles
+during compute and the device idles during staging. This module runs the
+staging generator on a background *prefetch thread* while the consumer
+computes, with a bounded number of windows in flight — the standard
+near-data-execution overlap lever, and on TPU (where each host->device
+transfer costs a tunnel round trip) the difference between a stalled and
+a saturated device.
+
+Design:
+
+- The producer thread pulls from the underlying staged-window generator
+  (which performs all the staging work — for device-cache-resident
+  windows that work is ~zero and the prefetcher degenerates to a cheap
+  hand-off) and enqueues items.
+- A semaphore with ``depth`` permits bounds in-flight windows: the
+  producer acquires a permit *before* staging the next window; the
+  consumer releases it only after it finishes computing that window.
+  ``depth=1`` disables the thread entirely (bit-for-bit the serial
+  executor).
+- Errors raised during background staging are captured and re-raised in
+  the consumer with the original traceback — a staging failure is the
+  query's failure, never a hang or a secondary ``queue.Empty``.
+- ``close()`` is idempotent and *always* joins the prefetch thread and
+  drains staged-but-unconsumed device buffers; every consumer wraps its
+  loop in try/finally so cancellation, limits, and compute errors can
+  never leak a thread or touch a buffer after cancel.
+
+Instrumentation: the pipeline tracks ``windows``, ``stage_secs``
+(producer time spent staging), and ``stall_secs`` (consumer time blocked
+waiting for a window). Under ``analyze`` the stall also lands in the
+fragment's stage breakdown (stage ``"stall"``); engines accumulate
+per-query and lifetime totals for bench.py's overlap report and the
+observability metrics.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+#: Poll period for interruptible blocking waits (slot acquire / queue
+#: get). Bounds how long cancellation/teardown can lag, not throughput —
+#: steady-state hand-offs never hit the timeout.
+_POLL_S = 0.05
+
+
+class WindowPipeline:
+    """Bounded-depth prefetch over a staged-window generator.
+
+    Iterate it exactly once; call :meth:`close` when done (iteration
+    wrapped in try/finally — see module docstring). ``cancel`` is an
+    optional ``threading.Event``-like object polled on both sides;
+    when set, iteration raises ``QueryCancelled``.
+    """
+
+    def __init__(self, gen, depth: int, cancel=None, stats=None):
+        self._gen = gen
+        self.depth = max(1, int(depth))
+        self._cancel = cancel
+        self._stats = stats
+        self.windows = 0
+        self.stage_secs = 0.0
+        self.stall_secs = 0.0
+        self._slots = threading.Semaphore(self.depth)
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._iterated = False
+
+    # -- consumer side -------------------------------------------------------
+    def __iter__(self):
+        if self._iterated:
+            raise RuntimeError("WindowPipeline is single-use")
+        self._iterated = True
+        if self.depth <= 1:
+            # Serial mode: no thread, no queue — today's loop, but the
+            # cancel handle is still polled per window so generators
+            # without their own check (e.g. the windowed join driver)
+            # keep the both-sides cancellation contract.
+            for item in self._gen:
+                self._check_cancel()
+                self.windows += 1
+                yield item
+            return
+        self._thread = threading.Thread(
+            target=self._produce, name="pixie-window-prefetch", daemon=True
+        )
+        self._thread.start()
+        try:
+            while True:
+                self._check_cancel()
+                t0 = time.perf_counter()
+                kind, val = self._get()
+                dt = time.perf_counter() - t0
+                self.stall_secs += dt
+                if self._stats is not None:
+                    self._stats.add("stall", dt)
+                if kind == "done":
+                    return
+                if kind == "error":
+                    # Surface the background staging failure as the
+                    # query's own error, original traceback intact.
+                    raise val
+                self._check_cancel()
+                self.windows += 1
+                yield val
+                val = None  # drop the device refs before freeing the slot
+                self._slots.release()
+        finally:
+            self.close()
+
+    def _get(self):
+        while True:
+            try:
+                return self._q.get(timeout=_POLL_S)
+            except queue.Empty:
+                self._check_cancel()
+                t = self._thread
+                if (t is None or not t.is_alive()) and self._q.empty():
+                    # Defensive: the producer always enqueues a terminal
+                    # sentinel, so this is unreachable unless the thread
+                    # was killed externally. Fail loudly, don't hang.
+                    raise RuntimeError("window prefetch thread died")
+
+    def _check_cancel(self):
+        if self._cancel is not None and self._cancel.is_set():
+            from .stream import QueryCancelled
+
+            raise QueryCancelled("query cancelled")
+
+    def close(self) -> None:
+        """Stop the producer, join its thread, drop staged buffers.
+
+        Idempotent; safe on partially-consumed, cancelled, and errored
+        pipelines. After close() returns no prefetch thread is alive and
+        no staged window remains referenced by the pipeline.
+        """
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        gen, self._gen = self._gen, iter(())
+        try:
+            gen.close()
+        except AttributeError:
+            pass
+
+    # -- producer side -------------------------------------------------------
+    def _produce(self):
+        try:
+            while True:
+                if not self._acquire_slot():
+                    return  # consumer closed the pipeline
+                t0 = time.perf_counter()
+                try:
+                    item = next(self._gen)
+                except StopIteration:
+                    self._put(("done", None))
+                    return
+                self.stage_secs += time.perf_counter() - t0
+                if not self._put(("item", item)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — relayed, not swallowed
+            self._put(("error", e))
+
+    def _acquire_slot(self) -> bool:
+        while not self._stop.is_set():
+            if self._slots.acquire(timeout=_POLL_S):
+                return True
+        return False
+
+    def _put(self, item) -> bool:
+        # The queue is unbounded (the slot semaphore bounds in-flight
+        # windows), so put never blocks; stop just discards late items.
+        if self._stop.is_set():
+            return False
+        self._q.put(item)
+        return True

@@ -191,8 +191,15 @@ class StreamingQuery:
             self._state = None
 
     def _new_windows(self):
-        """(cols, valid) device windows appended since the last poll;
-        advances watermarks."""
+        """(cols, valid, (tablet_key, row_hi)) device windows appended
+        since the last poll.
+
+        Watermarks are NOT advanced here: with the prefetch pipeline this
+        generator runs up to ``pipeline_depth`` windows ahead of the
+        consumer, and advancing eagerly would mark windows consumed that
+        an error/cancel then drops forever. The consumer commits
+        ``self._wm[tablet_key] = row_hi`` only AFTER folding/emitting a
+        window (at-least-once, matching the serial executor)."""
         for t in self.tablets:
             be = getattr(t, "_backend", None)
             if be is None:
@@ -201,8 +208,8 @@ class StreamingQuery:
             end = be.end_row_id()
             # Ring expiry may have dropped rows under the watermark.
             wm = max(wm, be.first_row_id())
+            self._wm[id(t)] = wm
             if end <= wm:
-                self._wm[id(t)] = wm
                 continue
             for win, lo, hi in t.device_scan(
                 window_rows=self.engine.window_rows,
@@ -210,12 +217,43 @@ class StreamingQuery:
             ):
                 yield win.cols, (
                     np.int32(lo - win.row0), np.int32(hi - win.row0)
-                )
-            self._wm[id(t)] = end
+                ), (id(t), hi)
 
     def _check_cancel(self):
         if self.cancel is not None and self.cancel.is_set():
             raise QueryCancelled("stream cancelled")
+
+    def _pipelined_windows(self):
+        """``_new_windows`` behind the engine's window-prefetch pipeline:
+        the next appended window stages on a background thread while the
+        current one folds/emits. Callers wrap iteration in try/finally
+        close() (no leaked prefetch threads on cancel/StopStream).
+
+        Empty polls (nothing appended since the watermark) run serial —
+        a 0.25s-interval idle stream must not churn a thread per poll."""
+        from .pipeline import WindowPipeline
+
+        depth = getattr(self.engine, "pipeline_depth", 1)
+        if depth > 1 and not self._has_new_rows():
+            depth = 1
+        return WindowPipeline(
+            self._new_windows(), depth, cancel=self.cancel,
+        )
+
+    def _has_new_rows(self) -> bool:
+        # Mirrors _new_windows' watermark arithmetic (clamp to
+        # first_row_id for ring expiry, compare against end_row_id);
+        # keep the two in lockstep. Disagreement is only a perf wobble
+        # (thread churn or a serial poll), never a correctness issue —
+        # _new_windows alone decides what is yielded.
+        for t in self.tablets:
+            be = getattr(t, "_backend", None)
+            if be is None:
+                continue
+            wm = max(self._wm[id(t)], be.first_row_id())
+            if be.end_row_id() > wm:
+                return True
+        return False
 
     def _fold_new(self, frag):
         """Shared agg half: fold newly appended windows into the
@@ -234,11 +272,17 @@ class StreamingQuery:
                         else be.first_row_id()
                     )
         folded = False
-        for cols, valid in self._new_windows():
-            self._check_cancel()
-            self._state = frag.update(self._state, cols, valid)
-            rows += int(valid[1] - valid[0])
-            folded = True
+        pipe = self._pipelined_windows()
+        try:
+            for cols, valid, (wm_key, wm_hi) in pipe:
+                self._check_cancel()
+                self._state = frag.update(self._state, cols, valid)
+                rows += int(valid[1] - valid[0])
+                folded = True
+                self._wm[wm_key] = wm_hi  # commit AFTER the fold
+        finally:
+            pipe.close()
+            self.engine._note_pipeline(pipe)
         return rows, folded
 
     def _rebucket(self):
@@ -275,28 +319,37 @@ class StreamingQuery:
             self.seq += 1
             return rows
         # Non-blocking: each new window emits once.
-        for cols, valid in self._new_windows():
-            self._check_cancel()
-            out_cols, out_valid = frag.update(cols, valid)
-            hb = _to_host_batch(frag.out_meta, out_cols, np.asarray(out_valid))
-            if hb.length == 0:
+        pipe = self._pipelined_windows()
+        try:
+            for cols, valid, (wm_key, wm_hi) in pipe:
+                self._check_cancel()
+                out_cols, out_valid = frag.update(cols, valid)
+                hb = _to_host_batch(
+                    frag.out_meta, out_cols, np.asarray(out_valid)
+                )
+                if hb.length == 0:
+                    rows += int(valid[1] - valid[0])
+                    self._wm[wm_key] = wm_hi
+                    continue
+                if frag.limit is not None:
+                    left = frag.limit - self.rows_emitted
+                    if left <= 0:
+                        raise StopStream()
+                    if hb.length > left:
+                        hb = _head(hb, left)
+                self.emit(StreamUpdate(
+                    table=self.chain.sink_name, batch=hb, seq=self.seq,
+                    mode="append",
+                ))
+                self.seq += 1
+                self.rows_emitted += hb.length
                 rows += int(valid[1] - valid[0])
-                continue
-            if frag.limit is not None:
-                left = frag.limit - self.rows_emitted
-                if left <= 0:
+                self._wm[wm_key] = wm_hi  # commit AFTER the emit
+                if frag.limit is not None and self.rows_emitted >= frag.limit:
                     raise StopStream()
-                if hb.length > left:
-                    hb = _head(hb, left)
-            self.emit(StreamUpdate(
-                table=self.chain.sink_name, batch=hb, seq=self.seq,
-                mode="append",
-            ))
-            self.seq += 1
-            self.rows_emitted += hb.length
-            rows += int(valid[1] - valid[0])
-            if frag.limit is not None and self.rows_emitted >= frag.limit:
-                raise StopStream()
+        finally:
+            pipe.close()
+            self.engine._note_pipeline(pipe)
         return rows
 
     def _poll_bridge(self, frag) -> int:
@@ -333,18 +386,26 @@ class StreamingQuery:
             ))
             self.seq += 1
             return rows
-        for cols, valid in self._new_windows():
-            self._check_cancel()
-            out_cols, out_valid = frag.update(cols, valid)
-            hb = _to_host_batch(frag.out_meta, out_cols, np.asarray(out_valid))
-            rows += int(valid[1] - valid[0])
-            if hb.length == 0:
-                continue
-            self.emit(StreamUpdate(
-                table=None, batch=RowsPayload(batch=hb), seq=self.seq,
-                mode="rows", bridge_id=self.chain.bridge_id,
-            ))
-            self.seq += 1
+        pipe = self._pipelined_windows()
+        try:
+            for cols, valid, (wm_key, wm_hi) in pipe:
+                self._check_cancel()
+                out_cols, out_valid = frag.update(cols, valid)
+                hb = _to_host_batch(
+                    frag.out_meta, out_cols, np.asarray(out_valid)
+                )
+                rows += int(valid[1] - valid[0])
+                if hb.length != 0:
+                    self.emit(StreamUpdate(
+                        table=None, batch=RowsPayload(batch=hb),
+                        seq=self.seq, mode="rows",
+                        bridge_id=self.chain.bridge_id,
+                    ))
+                    self.seq += 1
+                self._wm[wm_key] = wm_hi  # commit AFTER the emit
+        finally:
+            pipe.close()
+            self.engine._note_pipeline(pipe)
         return rows
 
     def run(self, poll_interval_s: float = 0.25, max_rounds=None) -> int:

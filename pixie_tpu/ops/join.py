@@ -60,6 +60,81 @@ def _owners(slot_of, emitting, count, capacity):
     return _cummax(marker)
 
 
+def probe_sorted_join(
+    sorted_build_keys,
+    n_build,
+    probe_keys,
+    probe_valid,
+    capacity: int,
+    how: str = "inner",
+):
+    """Probe one window against a PRE-SORTED device-resident build side.
+
+    The multi-window join driver (``exec/joins.py``) packs both sides'
+    keys into one comparable int64 id space on host, sorts the build ids
+    ONCE, and stages them on device once per query; each probe window
+    then runs only the searchsorted + expansion half of ``device_join``
+    — no per-window dense-id pass, no per-window build sort, and no
+    per-window build transfer.
+
+    Args:
+      sorted_build_keys: int64[B]; entries [0, n_build) ascending, the
+        rest padded with int64 max (never matched — ranges clamp to
+        ``n_build``).
+      n_build: traced int32 count of real build rows.
+      probe_keys / probe_valid: int64[N] ids + bool[N] mask for this
+        probe window.
+      capacity: static output row capacity C.
+      how: 'inner' | 'left' (windowable joins only: each probe row's
+        output is independent of every other window's; right/outer need
+        global unmatched-build knowledge and stay single-shot).
+
+    Returns the same (probe_idx, probe_take, build_idx, build_take,
+    out_valid, overflow) contract as ``device_join``, with ``build_idx``
+    indexing the SORTED build order (the driver maps back through its
+    host-side sort permutation).
+    """
+    if how not in ("inner", "left"):
+        raise ValueError(f"probe_sorted_join supports inner/left, not {how!r}")
+    b = sorted_build_keys.shape[0]
+    n = probe_valid.shape[0]
+    c = capacity
+    nb = jnp.asarray(n_build, dtype=jnp.int32)
+    lo = jnp.minimum(
+        jnp.searchsorted(sorted_build_keys, probe_keys, side="left"), nb
+    ).astype(jnp.int32)
+    hi = jnp.minimum(
+        jnp.searchsorted(sorted_build_keys, probe_keys, side="right"), nb
+    ).astype(jnp.int32)
+    m = jnp.where(probe_valid, hi - lo, 0).astype(jnp.int32)
+
+    e = jnp.maximum(m, 1) if how == "left" else m
+    e = jnp.where(probe_valid, e, 0).astype(jnp.int32)
+    start, _ = _exclusive_cumsum(e)
+    # Overflow detection in 64-bit: a window with > 2^31 total pairs
+    # wraps the int32 prefix sums, which would otherwise read as "fits"
+    # and silently drop the window. The int32 slot math stays exact in
+    # every non-overflow case (total <= capacity << 2^31); on overflow
+    # the caller discards this output and retries doubled anyway.
+    total_pairs = jnp.sum(e.astype(jnp.int64))
+
+    slot_of = jnp.where((e > 0) & (start < c), start, c)
+    owner1 = _owners(slot_of, (e > 0).astype(jnp.int32), n, c)
+    probe_idx = jnp.maximum(owner1 - 1, 0)
+
+    j = jnp.arange(c, dtype=jnp.int32)
+    t = j - start[probe_idx]
+    pair_valid = (j < total_pairs) & (owner1 > 0)
+    is_match = t < m[probe_idx]
+    build_idx = jnp.clip(
+        lo[probe_idx] + jnp.minimum(t, m[probe_idx] - 1), 0, b - 1
+    )
+    return (
+        probe_idx, pair_valid, build_idx, pair_valid & is_match,
+        pair_valid, total_pairs > c,
+    )
+
+
 def device_join(
     build_keys,
     build_valid,

@@ -188,5 +188,27 @@ def engine_collector(engine):
             "pixie_device_cache_bytes",
             "Device-resident window bytes (all tables)",
         ).set(total_resident_bytes())
+        # Window-prefetch pipeline (exec/pipeline.py): lifetime totals of
+        # windows executed, producer staging time, and consumer stall
+        # time. stall << stage means the overlap is hiding staging cost;
+        # stall ~= stage means the device is waiting on the host.
+        pt = getattr(engine, "pipeline_totals", None)
+        if pt is not None:
+            reg.gauge(
+                "pixie_pipeline_depth",
+                "Configured window-prefetch depth (1 = serial)",
+            ).set(getattr(engine, "pipeline_depth", 1))
+            reg.gauge(
+                "pixie_pipeline_windows_total",
+                "Windows executed through the window pipeline",
+            ).set(pt["windows"])
+            reg.gauge(
+                "pixie_pipeline_stage_seconds_total",
+                "Prefetch-thread seconds spent staging windows",
+            ).set(round(pt["stage_secs"], 6))
+            reg.gauge(
+                "pixie_pipeline_stall_seconds_total",
+                "Query-thread seconds stalled waiting for a window",
+            ).set(round(pt["stall_secs"], 6))
 
     return collect

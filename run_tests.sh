@@ -3,4 +3,22 @@
 # PALLAS_AXON_POOL_IPS must be cleared BEFORE the interpreter starts
 # (sitecustomize registers the plugin at boot); conftest.py alone is too
 # late. See .claude/skills/verify/SKILL.md.
+#
+# Modes:
+#   ./run_tests.sh [pytest args...]   plain pytest passthrough
+#   ./run_tests.sh --fast [args...]   skip slow + stress markers
+#   ./run_tests.sh --tier1            the ROADMAP.md tier-1 command verbatim
+case "$1" in
+  --fast)
+    shift
+    [ $# -eq 0 ] && set -- tests/
+    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest -q -m 'not slow and not stress' "$@"
+    ;;
+  --tier1)
+    export PALLAS_AXON_POOL_IPS=
+    # ROADMAP.md "Tier-1 verify", verbatim:
+    set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+    ;;
+esac
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest "$@"

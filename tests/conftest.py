@@ -48,6 +48,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running tests"
     )
+    config.addinivalue_line(
+        "markers",
+        "stress: concurrency/thread-hammer tests (skipped by "
+        "./run_tests.sh --fast)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

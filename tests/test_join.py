@@ -182,9 +182,9 @@ class TestJoinRouting:
         calls = []
         orig = getattr(eng_mod, expected)
 
-        def spy(left, right, op):
+        def spy(left, right, op, *a, **kw):
             calls.append(op.how)
-            return orig(left, right, op)
+            return orig(left, right, op, *a, **kw)
 
         monkeypatch.setattr(eng_mod, expected, spy)
         _check([1, 2, 3], [2, 3, 4], "inner")
