@@ -259,6 +259,36 @@ def _push_encoded(eng, name, rel, col_fn, n, window, dicts):
 #: into each shape's result dict via ``_with_pipeline``).
 _LAST_PIPELINE: dict | None = None
 
+#: Latency-quantile report (p50/p95/p99 from the tracer's histograms)
+#: of the most recent ``_time_query``, merged the same way.
+_LAST_LATENCY: dict | None = None
+
+
+def _latency_report(eng) -> dict | None:
+    """p50/p95/p99 pulled from the always-on trace histograms
+    (services.observability quantiles over pixie_query_duration_seconds
+    and pixie_window_stage_seconds). Each shape runs in its own
+    subprocess, so the process-global registry holds only this shape's
+    observations (warm-ups + timed run + A/B arms)."""
+    reg = eng.tracer.registry
+    out: dict = {}
+
+    def pcts(name, **labels):
+        q = reg.quantiles(name, (0.5, 0.95, 0.99), **labels)
+        if not q:
+            return None
+        return {"p50": round(q[0.5], 6), "p95": round(q[0.95], 6),
+                "p99": round(q[0.99], 6)}
+
+    p = pcts("pixie_query_duration_seconds")
+    if p:
+        out["query_seconds"] = p
+    for stage in ("compute", "stage", "stall"):
+        p = pcts("pixie_window_stage_seconds", stage=stage)
+        if p:
+            out[f"window_{stage}_seconds"] = p
+    return out or None
+
 
 def _host_equal(a: dict, b: dict) -> bool:
     """Exact equality of two {name: HostBatch} query outputs."""
@@ -326,9 +356,12 @@ def _pipeline_ab(eng, query, host_ref) -> dict:
 
 
 def _with_pipeline(res: dict) -> dict:
-    """Attach the last ``_time_query`` pipeline report to a shape result."""
+    """Attach the last ``_time_query`` pipeline + latency-quantile
+    reports to a shape result."""
     if _LAST_PIPELINE is not None:
         res["pipeline"] = _LAST_PIPELINE
+    if _LAST_LATENCY is not None:
+        res["latency"] = _LAST_LATENCY
     return res
 
 
@@ -349,8 +382,9 @@ def _time_query(eng, query, n_rows, warm_eng=None, profile=False):
     host-staged regime where the window-prefetch pipeline earns its keep
     — and reports per-shape overlap efficiency (``pipeline`` key).
     """
-    global _LAST_PIPELINE
+    global _LAST_PIPELINE, _LAST_LATENCY
     _LAST_PIPELINE = None
+    _LAST_LATENCY = None
     ab = os.environ.get("PIXIE_TPU_BENCH_AB", "1") not in ("0", "false")
     # Single-window engine first (cheap shape coverage), then the FULL
     # engine: its window count selects the scan-fold program, which must
@@ -406,6 +440,7 @@ def _time_query(eng, query, n_rows, warm_eng=None, profile=False):
         # resident-path run above stages ~nothing).
         _LAST_PIPELINE["overlap_frac"] = _LAST_PIPELINE["ab"]["overlap_frac"]
         _LAST_PIPELINE["stall_secs"] = _LAST_PIPELINE["ab"]["stall_secs"]
+    _LAST_LATENCY = _latency_report(eng)
     if not profile:
         return n_rows / dt, dt, host
     # Per-stage attribution (forces sync per stage; post-readback, so the

@@ -180,3 +180,27 @@ define_flag(
     "Shared secret for netbus/broker bearer tokens; empty disables auth "
     "(single-trust-domain deployments).",
 )
+
+# -- query-lifecycle tracing (exec/trace.py) ---------------------------------
+define_flag(
+    "trace_ring_size", 128,
+    "Finished query traces kept in the engine tracer's ring buffer "
+    "(served by /debug/queryz; oldest evicted first).",
+)
+define_flag(
+    "trace_window_sample", 64,
+    "Record one per-window stage/compute/stall interval span every N "
+    "windows per fragment (1 = every window, 0 = no window spans). "
+    "Timestamps only — never forces device sync.",
+)
+define_flag(
+    "trace_export_url", "",
+    "OTLP/HTTP base URL (e.g. http://collector:4318) to push finished "
+    "query traces to via exec.otel.OTLPHttpExporter; empty keeps traces "
+    "in-memory only (ring buffer).",
+)
+define_flag(
+    "slow_query_threshold_ms", 0.0,
+    "Queries slower than this (wall-clock ms) dump their full trace to "
+    "the 'pixie_tpu.slow_query' logger; 0 disables the slow-query log.",
+)

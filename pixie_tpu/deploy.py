@@ -136,8 +136,15 @@ def run_kelvin() -> int:
 
 
 def _agent_obs(agent, extra=None) -> int:
-    """healthz/statusz/metrics for an agent process; returns the port."""
-    from .services.observability import ObservabilityServer
+    """healthz/statusz/metrics/queryz for an agent process; returns the
+    port. The engine's tracer backs /debug/queryz and the query-latency
+    histograms; the engine collector refreshes table/cache/pipeline
+    gauges at each scrape (docs/OBSERVABILITY.md)."""
+    from .services.observability import (
+        ObservabilityServer,
+        default_registry,
+        engine_collector,
+    )
 
     def statusz():
         out = {
@@ -148,7 +155,10 @@ def _agent_obs(agent, extra=None) -> int:
             out.update(extra())
         return out
 
-    obs = ObservabilityServer(statusz_fn=statusz)
+    default_registry.register_collector(engine_collector(agent.engine))
+    obs = ObservabilityServer(
+        statusz_fn=statusz, tracer=agent.engine.tracer
+    )
     return obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "0")))
 
 

@@ -1,4 +1,5 @@
 from .engine import Engine, QueryError
+from .trace import QueryTrace, Tracer
 from .plan import (
     AggExpr,
     AggOp,
@@ -18,6 +19,8 @@ from .plan import (
 __all__ = [
     "Engine",
     "QueryError",
+    "QueryTrace",
+    "Tracer",
     "Plan",
     "MemorySourceOp",
     "MapOp",

@@ -18,8 +18,13 @@ breakdown of the TPU streaming pipeline:
                pipeline (pipeline_depth > 1); high stall with low stage
                time means the device, not staging, is the bottleneck
 
-Enabling analyze forces synchronization after each stage
-(``block_until_ready``), so overlap is sacrificed for attribution — run
+Since the query-lifecycle tracing subsystem (``trace.py``) landed,
+these stats are one detail level of the always-on trace spine: every
+query gets a ``QueryStats`` (attached to its ``QueryTrace``) with
+``sync=False`` — stage timers stamp host-side wall-clock boundaries and
+overlap survives. Enabling ``analyze`` sets ``sync=True``, which forces
+synchronization after each stage (``block_until_ready``) so stage times
+attribute real device work — overlap is sacrificed for attribution; run
 benchmarks with it off. With the pipelined window executor the ``stage``
 timer runs on the prefetch thread while ``compute`` runs on the query
 thread, so FragmentStats.add is lock-protected.
@@ -47,6 +52,10 @@ class FragmentStats:
     windows: int = 0
     rows_in: int = 0
     rows_out: int = 0
+    # True = analyze mode: _block_if syncs the device after each stage so
+    # timings attribute device work. False = always-on tracing: stamp
+    # wall-clock boundaries only, never force a sync.
+    sync: bool = True
     stages: dict = field(default_factory=dict)  # {stage: StageStat}
     # Staging runs on the prefetch thread concurrently with compute on
     # the query thread (pipeline.py), so stage accumulation is locked.
@@ -65,18 +74,22 @@ class FragmentStats:
         return _Timer(self, stage, rows)
 
     def to_dict(self) -> dict:
+        # Snapshot under the lock: /debug/queryz renders IN-FLIGHT
+        # queries, so add() on the query/prefetch threads can be
+        # inserting stage keys while a scrape iterates.
+        with self._lock:
+            stages = {
+                k: (v.seconds, v.rows, v.count)
+                for k, v in self.stages.items()
+            }
         return {
             "ops": list(self.ops),
             "windows": self.windows,
             "rows_in": self.rows_in,
             "rows_out": self.rows_out,
             "stages": {
-                k: {
-                    "seconds": round(v.seconds, 6),
-                    "rows": v.rows,
-                    "count": v.count,
-                }
-                for k, v in self.stages.items()
+                k: {"seconds": round(s, 6), "rows": r, "count": c}
+                for k, (s, r, c) in stages.items()
             },
         }
 
@@ -99,20 +112,25 @@ class QueryStats:
 
     fragments: list = field(default_factory=list)  # list[FragmentStats]
     total_seconds: float = 0.0
+    sync: bool = True  # propagated to fragments; see FragmentStats.sync
 
     def new_fragment(self, ops) -> FragmentStats:
-        fs = FragmentStats(ops=tuple(type(o).__name__ for o in ops))
+        fs = FragmentStats(
+            ops=tuple(type(o).__name__ for o in ops), sync=self.sync
+        )
         self.fragments.append(fs)
         return fs
 
     def to_dict(self) -> dict:
+        # Per-fragment to_dict snapshots under each fragment's lock;
+        # totals come from those snapshots (never raw racing dicts).
+        frags = [f.to_dict() for f in self.fragments]
         totals: dict = {}
-        for f in self.fragments:
-            for k, v in f.stages.items():
-                t = totals.setdefault(k, 0.0)
-                totals[k] = t + v.seconds
+        for fd in frags:
+            for k, v in fd["stages"].items():
+                totals[k] = totals.get(k, 0.0) + v["seconds"]
         return {
             "total_seconds": round(self.total_seconds, 6),
             "stage_totals": {k: round(v, 6) for k, v in sorted(totals.items())},
-            "fragments": [f.to_dict() for f in self.fragments],
+            "fragments": frags,
         }

@@ -45,6 +45,7 @@ from .bridge import (  # noqa: F401  (re-exported)
 )
 from .fragment import compile_fragment_cached as compile_fragment
 from .pipeline import WindowPipeline
+from .trace import Tracer, plan_script
 from .joins import (  # noqa: F401  (re-exported)
     _join_dispatch,
     _union_host,
@@ -193,6 +194,10 @@ class Engine:
         self.last_stats = None
         self._query_stats = None
         self._cancel = None  # per-query cancel event (execute_plan arg)
+        # Always-on query-lifecycle tracing (exec/trace.py): every
+        # execute_plan gets a trace (spans + stats spine, ring-buffered,
+        # /debug/queryz). Cheap: timestamps only, no device sync.
+        self.tracer = Tracer()
         # One query at a time; reentrant so subclasses can hold it across
         # their own engine-state mutations around super().execute_plan().
         self._exec_guard = threading.RLock()
@@ -241,16 +246,41 @@ class Engine:
         (returns DeviceResult — call ``.to_host()`` for bytes)."""
         from ..planner import CompilerState, compile_pxl
 
-        state = CompilerState(
-            schemas={n: t.relation for n, t in self.tables.items()},
-            registry=self.registry,
-            now_ns=now_ns,
-            max_output_rows=max_output_rows,
-        )
-        compiled = compile_pxl(query, state)
-        return self.execute_plan(
-            compiled.plan, analyze=analyze, materialize=materialize
-        )
+        # The query's lifecycle trace starts HERE so the parse/compile/
+        # plan phase gets its own span; execute_plan ends the trace.
+        trace = self.tracer.begin_query(script=query, analyze=analyze)
+        try:
+            with trace.span("compile"):
+                state = CompilerState(
+                    schemas={n: t.relation for n, t in self.tables.items()},
+                    registry=self.registry,
+                    now_ns=now_ns,
+                    max_output_rows=max_output_rows,
+                )
+                compiled = compile_pxl(query, state)
+        except BaseException as e:
+            self.tracer.end_query(
+                trace, status="error", error=f"{type(e).__name__}: {e}"
+            )
+            raise
+        try:
+            return self.execute_plan(
+                compiled.plan, analyze=analyze, materialize=materialize,
+                trace=trace,
+            )
+        except BaseException as e:
+            # Safety net for execute_plan overrides that can raise before
+            # reaching the base implementation (e.g. DistributedEngine's
+            # replan): end_query is idempotent, so the normal path —
+            # where execute_plan already ended the trace — is a no-op.
+            self.tracer.end_query(
+                trace,
+                status=(
+                    "cancelled" if isinstance(e, QueryCancelled) else "error"
+                ),
+                error=f"{type(e).__name__}: {e}",
+            )
+            raise
 
     def set_metadata_state(self, state) -> None:
         """Attach k8s metadata; rebinds the metadata UDFs to a snapshot of
@@ -266,7 +296,7 @@ class Engine:
     def execute_plan(
         self, plan: Plan, bridge_inputs: dict | None = None,
         analyze: bool = False, materialize: bool = True,
-        cancel=None,
+        cancel=None, trace=None,
     ) -> dict:
         """Execute a plan. Whole plans return {sink name: HostBatch}.
 
@@ -283,34 +313,50 @@ class Engine:
         bus dispatcher threads can overlap execute/merge/bridge work)
         serialize on an engine lock rather than corrupting each other's
         cancel handles.
+
+        ``trace`` is the query's in-progress QueryTrace when the caller
+        (execute_query) already began one; otherwise a fresh trace is
+        started here. Either way this call ends it — AFTER releasing the
+        exec guard, so the trace sinks (slow-query log, OTLP push to a
+        possibly-slow collector) never serialize the next query.
         """
-        with self._exec_guard:
-            return self._execute_plan_guarded(
-                plan, bridge_inputs, analyze, materialize, cancel
+        if trace is None:
+            trace = self.tracer.begin_query(
+                script=plan_script(plan), analyze=analyze
             )
+        status, error = "ok", ""
+        try:
+            with self._exec_guard:
+                return self._execute_plan_guarded(
+                    plan, bridge_inputs, analyze, materialize, cancel, trace
+                )
+        except QueryCancelled as e:
+            status, error = "cancelled", str(e)
+            raise
+        except BaseException as e:
+            status, error = "error", f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self.tracer.end_query(trace, status=status, error=error)
 
     def _execute_plan_guarded(
-        self, plan, bridge_inputs, analyze, materialize, cancel
+        self, plan, bridge_inputs, analyze, materialize, cancel, trace
     ) -> dict:
         self._cancel = cancel
         self.last_pipeline = None  # fresh per-query pipeline snapshot
-        if analyze:
-            from .analyze import QueryStats
-
-            self._query_stats = QueryStats()
-            t_start = time.perf_counter()
-            try:
-                out = self._execute_plan_inner(plan, bridge_inputs, materialize)
-            finally:
-                self._query_stats.total_seconds = time.perf_counter() - t_start
-                self.last_stats = self._query_stats
-                self._query_stats = None
-                self._cancel = None
-            return out
+        # The trace's stats spine IS the per-fragment stats object —
+        # analyze just runs it with sync=True (see analyze.py).
+        self._query_stats = trace.stats
         try:
             return self._execute_plan_inner(plan, bridge_inputs, materialize)
         finally:
+            if analyze:
+                self.last_stats = trace.stats
+            self._query_stats = None
             self._cancel = None
+            trace.pipeline = (
+                dict(self.last_pipeline) if self.last_pipeline else None
+            )
 
     def _execute_plan_inner(
         self, plan: Plan, bridge_inputs: dict | None = None,
@@ -814,21 +860,21 @@ class Engine:
 
     def _note_pipeline(self, pipe: WindowPipeline) -> None:
         """Fold a finished pipeline's counters into the per-query snapshot
-        (``last_pipeline``) and the engine-lifetime totals."""
+        (``last_pipeline``, which the query's trace snapshots at end)
+        and the engine-lifetime totals."""
+        c = pipe.counters()
         lp = self.last_pipeline
         if lp is None:
             lp = self.last_pipeline = {
-                "depth": pipe.depth, "windows": 0,
+                "depth": c["depth"], "windows": 0,
                 "stage_secs": 0.0, "stall_secs": 0.0,
             }
-        lp["depth"] = pipe.depth
-        lp["windows"] += pipe.windows
-        lp["stage_secs"] += pipe.stage_secs
-        lp["stall_secs"] += pipe.stall_secs
+        lp["depth"] = c["depth"]
         tot = self.pipeline_totals
-        tot["windows"] += pipe.windows
-        tot["stage_secs"] += pipe.stage_secs
-        tot["stall_secs"] += pipe.stall_secs
+        for d in (lp, tot):
+            d["windows"] += c["windows"]
+            d["stage_secs"] += c["stage_secs"]
+            d["stall_secs"] += c["stall_secs"]
 
     def _put_side(self, v):
         """Stage one fused-join side table (DistributedEngine replicates

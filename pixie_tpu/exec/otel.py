@@ -8,6 +8,11 @@ encoding (ResourceMetrics / ResourceSpans dicts); the transport is a
 pluggable exporter callback — in-memory collection by default, an OTLP
 HTTP/gRPC pusher where the deployment provides one (grpc is gated: not
 part of the baked environment).
+
+The engine's own query-lifecycle traces (``exec/trace.py``) dogfood
+this path: ``QueryTrace.to_otlp()`` builds the same ResourceSpans
+payload shape (via ``_attr_kvs``) and ``Tracer`` pushes it through
+``OTLPHttpExporter`` when the ``trace_export_url`` flag is set.
 """
 
 from __future__ import annotations

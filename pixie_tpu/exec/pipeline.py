@@ -30,10 +30,12 @@ Design:
 
 Instrumentation: the pipeline tracks ``windows``, ``stage_secs``
 (producer time spent staging), and ``stall_secs`` (consumer time blocked
-waiting for a window). Under ``analyze`` the stall also lands in the
-fragment's stage breakdown (stage ``"stall"``); engines accumulate
-per-query and lifetime totals for bench.py's overlap report and the
-observability metrics.
+waiting for a window). The per-window stall intervals also land in the
+query's fragment stats (stage ``"stall"``) — always on since the trace
+spine (``trace.py``) passes stats for every query, feeding the
+``pixie_window_stage_seconds{stage="stall"}`` histogram and sampled
+``window.stall`` spans; engines accumulate per-query and lifetime
+totals for bench.py's overlap report and the observability gauges.
 """
 
 from __future__ import annotations
@@ -131,6 +133,17 @@ class WindowPipeline:
             from .stream import QueryCancelled
 
             raise QueryCancelled("query cancelled")
+
+    def counters(self) -> dict:
+        """Counter snapshot ({depth, windows, stage_secs, stall_secs}) —
+        what ``Engine._note_pipeline`` folds into the per-query trace
+        and the engine-lifetime totals."""
+        return {
+            "depth": self.depth,
+            "windows": self.windows,
+            "stage_secs": self.stage_secs,
+            "stall_secs": self.stall_secs,
+        }
 
     def close(self) -> None:
         """Stop the producer, join its thread, drop staged buffers.

@@ -150,8 +150,12 @@ def _timed(stats, stage: str, rows: int = 0):
 
 
 def _block_if(stats, x) -> None:
-    """block_until_ready under analyze only (attribution needs sync)."""
-    if stats is not None:
+    """block_until_ready under analyze only (attribution needs sync).
+
+    The always-on trace spine passes stats with ``sync=False``: stage
+    timestamps still land, but the device is never fenced — pipeline
+    overlap survives (the ISSUE-3 no-forced-sync contract)."""
+    if stats is not None and getattr(stats, "sync", True):
         import jax
 
         jax.block_until_ready(x)

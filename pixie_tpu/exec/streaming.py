@@ -37,6 +37,7 @@ from .engine import (
     _double_agg_groups,
     _stream_col_stats,
     _Stream,
+    _timed,
     _to_host_batch,
 )
 from .fragment import compile_fragment_cached as compile_fragment
@@ -145,7 +146,8 @@ class StreamingQuery:
     since the last poll and emits 0..n StreamUpdates; ``run()`` loops
     until cancelled (the service-loop form)."""
 
-    def __init__(self, engine: Engine, plan: Plan, emit, cancel=None):
+    def __init__(self, engine: Engine, plan: Plan, emit, cancel=None,
+                 script: str = ""):
         self.engine = engine
         self.emit = emit
         self.cancel = cancel
@@ -178,7 +180,21 @@ class StreamingQuery:
                 self._wm[id(t)] = be.first_row_id()
         self._state = None
         self._frag = None
-        self._compile()
+        # One lifecycle trace per stream (exec/trace.py): the stream
+        # shows in /debug/queryz as in-flight until close()/run() ends
+        # it; per-poll window work lands in its fragment stats. Begun
+        # last so earlier __init__ raises can't leak an in-flight trace.
+        from .trace import plan_script
+
+        self.trace = engine.tracer.begin_query(
+            script=script or plan_script(plan), kind="stream"
+        )
+        self._tstats = None  # current compile's fragment stats
+        try:
+            self._compile()
+        except BaseException as e:
+            self.close(status="error", error=f"{type(e).__name__}: {e}")
+            raise
 
     def _compile(self):
         stream = _Stream(self.relation, self.dicts, list(self.ops), self.tablets)
@@ -186,9 +202,22 @@ class StreamingQuery:
             self.ops, self.relation, self.dicts, self.engine.registry,
             col_stats=_stream_col_stats(stream),
         )
+        if self.trace is not None:
+            # A fresh fragment per (re)compile: rebuckets show as their
+            # own fragment rows, the engine one-shot convention.
+            self._tstats = self.trace.stats.new_fragment(self.ops)
         if self.chain.is_agg and self._state is not None:
             # Rebucket path: state restarts from scratch at the new size.
             self._state = None
+
+    def close(self, status: str = "ok", error: str = "") -> None:
+        """End the stream's lifecycle trace (idempotent). ``run()`` calls
+        this on exit; callers driving ``poll()`` directly should close
+        explicitly so /debug/queryz stops listing the stream as
+        in-flight."""
+        tr, self.trace = self.trace, None
+        if tr is not None:
+            self.engine.tracer.end_query(tr, status=status, error=error)
 
     def _new_windows(self):
         """(cols, valid, (tablet_key, row_hi)) device windows appended
@@ -238,6 +267,7 @@ class StreamingQuery:
             depth = 1
         return WindowPipeline(
             self._new_windows(), depth, cancel=self.cancel,
+            stats=self._tstats,
         )
 
     def _has_new_rows(self) -> bool:
@@ -272,12 +302,18 @@ class StreamingQuery:
                         else be.first_row_id()
                     )
         folded = False
+        st = self._tstats
         pipe = self._pipelined_windows()
         try:
             for cols, valid, (wm_key, wm_hi) in pipe:
                 self._check_cancel()
-                self._state = frag.update(self._state, cols, valid)
-                rows += int(valid[1] - valid[0])
+                with _timed(st, "compute"):
+                    self._state = frag.update(self._state, cols, valid)
+                w_rows = int(valid[1] - valid[0])
+                rows += w_rows
+                if st is not None:
+                    st.windows += 1
+                    st.rows_in += w_rows
                 folded = True
                 self._wm[wm_key] = wm_hi  # commit AFTER the fold
         finally:
@@ -319,14 +355,21 @@ class StreamingQuery:
             self.seq += 1
             return rows
         # Non-blocking: each new window emits once.
+        st = self._tstats
         pipe = self._pipelined_windows()
         try:
             for cols, valid, (wm_key, wm_hi) in pipe:
                 self._check_cancel()
-                out_cols, out_valid = frag.update(cols, valid)
-                hb = _to_host_batch(
-                    frag.out_meta, out_cols, np.asarray(out_valid)
-                )
+                with _timed(st, "compute"):
+                    out_cols, out_valid = frag.update(cols, valid)
+                with _timed(st, "materialize"):
+                    hb = _to_host_batch(
+                        frag.out_meta, out_cols, np.asarray(out_valid)
+                    )
+                if st is not None:
+                    st.windows += 1
+                    st.rows_in += int(valid[1] - valid[0])
+                    st.rows_out += hb.length
                 if hb.length == 0:
                     rows += int(valid[1] - valid[0])
                     self._wm[wm_key] = wm_hi
@@ -386,15 +429,22 @@ class StreamingQuery:
             ))
             self.seq += 1
             return rows
+        st = self._tstats
         pipe = self._pipelined_windows()
         try:
             for cols, valid, (wm_key, wm_hi) in pipe:
                 self._check_cancel()
-                out_cols, out_valid = frag.update(cols, valid)
-                hb = _to_host_batch(
-                    frag.out_meta, out_cols, np.asarray(out_valid)
-                )
+                with _timed(st, "compute"):
+                    out_cols, out_valid = frag.update(cols, valid)
+                with _timed(st, "materialize"):
+                    hb = _to_host_batch(
+                        frag.out_meta, out_cols, np.asarray(out_valid)
+                    )
                 rows += int(valid[1] - valid[0])
+                if st is not None:
+                    st.windows += 1
+                    st.rows_in += int(valid[1] - valid[0])
+                    st.rows_out += hb.length
                 if hb.length != 0:
                     self.emit(StreamUpdate(
                         table=None, batch=RowsPayload(batch=hb),
@@ -412,6 +462,7 @@ class StreamingQuery:
         """Poll until cancelled (or the row limit / max_rounds hits).
         Returns the number of updates emitted."""
         rounds = 0
+        status, error = "ok", ""
         try:
             while True:
                 self._check_cancel()
@@ -421,11 +472,19 @@ class StreamingQuery:
                     break
                 if self.cancel is not None:
                     if self.cancel.wait(poll_interval_s):
+                        status = "cancelled"
                         break
                 else:
                     time.sleep(poll_interval_s)
-        except (StopStream, QueryCancelled):
-            pass
+        except StopStream:
+            pass  # row limit satisfied: a normal end
+        except QueryCancelled as e:
+            status, error = "cancelled", str(e)
+        except BaseException as e:
+            self.close(status="error", error=f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            self.close(status=status, error=error)
         return self.seq
 
 
@@ -468,4 +527,5 @@ def stream_query(
         max_output_rows=max_output_rows or (1 << 62),
     )
     compiled = compile_pxl(query, state)
-    return StreamingQuery(engine, compiled.plan, emit, cancel=cancel)
+    return StreamingQuery(engine, compiled.plan, emit, cancel=cancel,
+                          script=query)

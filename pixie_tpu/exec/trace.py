@@ -1,0 +1,499 @@
+"""Always-on query-lifecycle tracing: spans, ring buffer, OTLP export.
+
+Reference parity: Carnot ships per-operator ``OperatorExecutionStats``
+with every query result (``src/carnot/carnot.cc:389-423``) and the
+services expose statusz/metrics — but that telemetry is per-request and
+the engine's own ``analyze`` mode forces device sync (killing the PR-1
+pipeline overlap). This module is the cheap, always-on third way: every
+query gets a **trace** — a tree of spans stamped at existing host-side
+boundaries, never ``block_until_ready`` — kept in a bounded ring buffer
+and optionally pushed over the engine's own OTLP path (dogfooding
+``exec/otel.py``'s span dicts through ``OTLPHttpExporter``).
+
+Span hierarchy (one trace per ``Engine.execute_plan`` /
+``StreamingQuery`` lifetime):
+
+- ``query``               root; status/script-hash/row-count attributes
+- ``compile``             parse + PxL compile + plan (execute_query path)
+- ``fragment``            one per compiled fragment actually executed
+  (Map/Filter/Agg chain, join driver, rebucket attempt); attributes
+  carry windows, rows in/out and the per-stage second totals
+- ``window.<stage>``      sampled per-window stage/compute/stall
+  intervals (every ``trace_window_sample``-th interval per stage),
+  children of their fragment span
+
+The stats spine is shared with ``analyze`` (``analyze.py``): a trace
+owns a ``QueryStats`` whose fragments the engine fills exactly as
+before; ``analyze=True`` just flips ``sync=True`` on that object, so
+analyze is a *detail level* of the same trace, not a separate path.
+
+Because compute stamps are taken without fencing the device, a window's
+``compute`` interval measures **dispatch** time (host-side cost of
+enqueueing the program) and ``stall`` measures where the query thread
+actually waited — which is exactly the signal sketch/telemetry-driven
+optimization wants (arXiv:2102.02440, arXiv:2506.20010): where does
+wall-clock go, without perturbing it.
+
+Consumers:
+
+- ``Tracer.recent()`` / ``in_flight()`` — served by
+  ``ObservabilityServer`` as ``/debug/queryz``
+- Prometheus histograms on the shared ``MetricsRegistry``
+  (``pixie_query_duration_seconds``, ``pixie_window_stage_seconds``,
+  ``pixie_pipeline_stall_seconds``) — ``/metrics``
+- the slow-query log (``slow_query_threshold_ms`` flag): offending
+  queries dump their full trace to the ``pixie_tpu.slow_query`` logger
+- OTLP/HTTP push of finished traces when ``trace_export_url`` is set
+  (in-memory otherwise); export failures count in
+  ``pixie_trace_export_errors_total`` and never fail the query
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..config import get_flag
+from .analyze import FragmentStats, QueryStats, StageStat
+
+logger = logging.getLogger("pixie_tpu.slow_query")
+
+#: Hard cap on spans kept per trace (sampling bounds the rate, this
+#: bounds the worst case — a million-window query must not hold a
+#: million span dicts).
+MAX_SPANS_PER_TRACE = 512
+
+#: Sub-second buckets for per-window stage timings (a window stage is
+#: typically 0.1ms..1s; the prometheus defaults top out too coarse).
+STAGE_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+@dataclass
+class Span:
+    """One timed interval. ``to_otlp`` emits the OTLP-JSON span shape
+    ``exec/otel.py`` ships (plus trace/span ids, which the batch path
+    leaves to the collector)."""
+
+    name: str
+    trace_id: str
+    span_id: str = field(default_factory=lambda: _new_id(8))
+    parent_id: str = ""
+    start_unix_nano: int = 0
+    end_unix_nano: int = 0
+    attributes: dict = field(default_factory=dict)
+
+    def to_otlp(self) -> dict:
+        from .otel import _attr_kvs
+
+        d = {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "startTimeUnixNano": int(self.start_unix_nano),
+            "endTimeUnixNano": int(self.end_unix_nano),
+            "attributes": _attr_kvs(sorted(self.attributes.items())),
+        }
+        if self.parent_id:
+            d["parentSpanId"] = self.parent_id
+        return d
+
+
+class _SpanCtx:
+    """Context manager stamping a span's start/end around a block."""
+
+    def __init__(self, trace: "QueryTrace", name: str, parent: Span | None):
+        self.span = trace._new_span(name, parent)
+
+    def __enter__(self) -> Span:
+        self.span.start_unix_nano = time.time_ns()
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb):
+        self.span.end_unix_nano = time.time_ns()
+        if exc is not None:
+            self.span.attributes["error"] = f"{type(exc).__name__}: {exc}"
+
+
+class TracedFragment(FragmentStats):
+    """FragmentStats that additionally owns a ``fragment`` span and
+    records sampled per-window stage-interval spans + stage histograms.
+    ``add`` runs on both the query thread (compute/stall) and the
+    prefetch thread (stage) — the inherited lock covers both."""
+
+    def __init__(self, ops: tuple, trace: "QueryTrace", sync: bool):
+        super().__init__(ops=ops, sync=sync)
+        self.trace = trace
+        self.span = trace._new_span("fragment", trace.root)
+        self.span.start_unix_nano = time.time_ns()
+        self.span.attributes["ops"] = ",".join(ops) or "(join)"
+        self.last_activity_ns = self.span.start_unix_nano
+
+    def add(self, stage: str, seconds: float, rows: int = 0) -> None:
+        now_ns = time.time_ns()
+        with self._lock:
+            s = self.stages.setdefault(stage, StageStat())
+            s.seconds += seconds
+            s.rows += int(rows)
+            s.count += 1
+            count = s.count
+            self.last_activity_ns = now_ns
+        tracer = self.trace.tracer
+        if tracer is not None:
+            tracer._observe_stage(stage, seconds)
+        k = self.trace.window_sample
+        if k and (count - 1) % k == 0:
+            attrs = {"interval": count - 1}
+            if rows:
+                attrs["rows"] = int(rows)
+            self.trace._add_span(Span(
+                name=f"window.{stage}",
+                trace_id=self.trace.trace_id,
+                parent_id=self.span.span_id,
+                start_unix_nano=now_ns - int(seconds * 1e9),
+                end_unix_nano=now_ns,
+                attributes=attrs,
+            ))
+
+    def finish(self, end_ns: int) -> None:
+        """Seal the fragment span (trace end): end timestamp = last
+        host-side activity, attributes = the final counters."""
+        with self._lock:
+            self.span.end_unix_nano = min(
+                max(self.last_activity_ns, self.span.start_unix_nano), end_ns
+            ) or end_ns
+            self.span.attributes.update({
+                "windows": self.windows,
+                "rows_in": self.rows_in,
+                "rows_out": self.rows_out,
+            })
+            for k, v in self.stages.items():
+                self.span.attributes[f"{k}_seconds"] = round(v.seconds, 6)
+
+
+class TraceStats(QueryStats):
+    """The trace's stats spine — what the engine sees as
+    ``_query_stats``. ``sync`` False = always-on tracing (no device
+    fence); True = analyze detail level."""
+
+    def __init__(self, trace: "QueryTrace", sync: bool = False):
+        super().__init__(sync=sync)
+        self.trace = trace
+
+    def new_fragment(self, ops) -> TracedFragment:
+        fs = TracedFragment(
+            tuple(type(o).__name__ for o in ops), self.trace, self.sync
+        )
+        self.fragments.append(fs)
+        return fs
+
+
+class QueryTrace:
+    """One query's lifecycle: ids, status, span tree, stats spine."""
+
+    def __init__(self, tracer: "Tracer | None", script: str = "",
+                 analyze: bool = False, kind: str = "query"):
+        self.tracer = tracer
+        self.trace_id = _new_id(16)
+        self.script = script or ""
+        self.script_hash = hashlib.sha256(
+            self.script.encode()
+        ).hexdigest()[:12]
+        self.kind = kind  # "query" | "stream"
+        self.status = "running"
+        self.error = ""
+        self.start_unix_nano = time.time_ns()
+        self.end_unix_nano = 0
+        self._t0 = time.perf_counter()
+        self.duration_s = 0.0
+        self.window_sample = int(get_flag("trace_window_sample"))
+        self.pipeline: dict | None = None  # engine.last_pipeline snapshot
+        self.dropped_spans = 0
+        self._lock = threading.Lock()
+        self.root = Span(
+            "query", self.trace_id, start_unix_nano=self.start_unix_nano
+        )
+        self.spans: list[Span] = [self.root]
+        self.stats = TraceStats(self, sync=analyze)
+
+    # -- span plumbing -------------------------------------------------------
+    def _new_span(self, name: str, parent: Span | None) -> Span:
+        s = Span(
+            name, self.trace_id,
+            parent_id=parent.span_id if parent is not None else "",
+        )
+        self._add_span(s)
+        return s
+
+    def _add_span(self, span: Span) -> None:
+        with self._lock:
+            if len(self.spans) >= MAX_SPANS_PER_TRACE:
+                self.dropped_spans += 1
+                return
+            self.spans.append(span)
+
+    def span(self, name: str, parent: Span | None = None) -> _SpanCtx:
+        """``with trace.span("compile"): ...`` — stamps start/end."""
+        return _SpanCtx(self, name, parent if parent is not None else self.root)
+
+    # -- derived views -------------------------------------------------------
+    @property
+    def rows_in(self) -> int:
+        return sum(f.rows_in for f in self.stats.fragments)
+
+    @property
+    def rows_out(self) -> int:
+        return sum(f.rows_out for f in self.stats.fragments)
+
+    @property
+    def windows(self) -> int:
+        return sum(f.windows for f in self.stats.fragments)
+
+    def _finalize(self, status: str, error: str) -> None:
+        self.status = status
+        self.error = error
+        self.end_unix_nano = time.time_ns()
+        self.duration_s = time.perf_counter() - self._t0
+        self.stats.total_seconds = self.duration_s
+        self.root.end_unix_nano = self.end_unix_nano
+        self.root.attributes.update({
+            "status": status,
+            "script_hash": self.script_hash,
+            "kind": self.kind,
+            "rows_in": self.rows_in,
+            "rows_out": self.rows_out,
+        })
+        if error:
+            self.root.attributes["error"] = error
+        if self.pipeline:
+            self.root.attributes["pipeline_stall_seconds"] = round(
+                self.pipeline.get("stall_secs", 0.0), 6
+            )
+        for f in self.stats.fragments:
+            if isinstance(f, TracedFragment):
+                f.finish(self.end_unix_nano)
+
+    def to_dict(self) -> dict:
+        """The /debug/queryz row (and slow-query log body)."""
+        d = {
+            "id": self.trace_id,
+            "kind": self.kind,
+            "script_hash": self.script_hash,
+            "query": self.script[:200],
+            "status": self.status,
+            "start_unix_nano": self.start_unix_nano,
+            "duration_ms": round(
+                (self.duration_s if self.end_unix_nano
+                 else time.perf_counter() - self._t0) * 1e3, 3
+            ),
+            "rows_in": self.rows_in,
+            "rows_out": self.rows_out,
+            "windows": self.windows,
+            "spans": len(self.spans),
+            "fragments": [f.to_dict() for f in self.stats.fragments],
+        }
+        if self.error:
+            d["error"] = self.error
+        if self.pipeline:
+            d["pipeline"] = {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in self.pipeline.items()
+            }
+        if self.dropped_spans:
+            d["dropped_spans"] = self.dropped_spans
+        return d
+
+    def to_otlp(self) -> dict:
+        """OTLP-JSON ResourceSpans payload — the exact shape
+        ``OTLPHttpExporter`` POSTs to ``/v1/traces``."""
+        from .otel import _attr_kvs
+
+        return {
+            "resourceSpans": [{
+                "resource": {
+                    "attributes": _attr_kvs([
+                        ("service.name", "pixie-tpu-engine"),
+                        ("query.script_hash", self.script_hash),
+                    ])
+                },
+                "scopeSpans": [{
+                    "scope": {"name": "pixie_tpu.exec.trace"},
+                    "spans": [s.to_otlp() for s in self.spans],
+                }],
+            }]
+        }
+
+
+class Tracer:
+    """Per-engine trace sink: bounded ring of finished traces, the
+    in-flight set, histogram/counter recording, slow-query log, and the
+    optional OTLP push. All methods are thread-safe."""
+
+    def __init__(self, registry=None, ring_size: int | None = None):
+        self._registry = registry  # lazy: services import at first use
+        self._ring: deque = deque(
+            maxlen=int(ring_size or get_flag("trace_ring_size"))
+        )
+        self._inflight: dict[str, QueryTrace] = {}
+        self._lock = threading.Lock()
+        self._metrics: dict | None = None
+        self._stage_hist: dict = {}  # stage -> bound Histogram
+        self._exporter = None
+        self._exporter_url = None
+
+    # -- metrics -------------------------------------------------------------
+    @property
+    def registry(self):
+        if self._registry is None:
+            from ..services.observability import default_registry
+
+            self._registry = default_registry
+        return self._registry
+
+    def _m(self) -> dict:
+        if self._metrics is None:
+            reg = self.registry
+            self._metrics = {
+                "queries": reg.counter(
+                    "pixie_queries_total",
+                    "Queries finished, by terminal status",
+                ),
+                "duration": reg.histogram(
+                    "pixie_query_duration_seconds",
+                    "End-to-end query wall time (compile + execute)",
+                ),
+                "stage": reg.histogram(
+                    "pixie_window_stage_seconds",
+                    "Per-window host-side stage intervals (stage/compute/"
+                    "stall/finalize/materialize; timestamps, not device "
+                    "sync)",
+                    buckets=STAGE_BUCKETS,
+                ),
+                "stall": reg.histogram(
+                    "pixie_pipeline_stall_seconds",
+                    "Per-query total window-pipeline stall",
+                ),
+                "slow": reg.counter(
+                    "pixie_slow_queries_total",
+                    "Queries over slow_query_threshold_ms",
+                ),
+                "export_errors": reg.counter(
+                    "pixie_trace_export_errors_total",
+                    "Failed OTLP trace pushes (trace_export_url)",
+                ),
+            }
+        return self._metrics
+
+    def _observe_stage(self, stage: str, seconds: float) -> None:
+        h = self._stage_hist.get(stage)
+        if h is None:
+            h = self._stage_hist[stage] = self._m()["stage"].labels(
+                stage=stage
+            )
+        h.observe(seconds)
+
+    # -- lifecycle -----------------------------------------------------------
+    def begin_query(self, script: str = "", analyze: bool = False,
+                    kind: str = "query") -> QueryTrace:
+        tr = QueryTrace(self, script=script, analyze=analyze, kind=kind)
+        with self._lock:
+            self._inflight[tr.trace_id] = tr
+        return tr
+
+    def end_query(self, trace: QueryTrace, status: str = "ok",
+                  error: str = "") -> None:
+        """Finalize a trace: seal spans, move it to the ring, record
+        metrics, run the slow-query log and the OTLP export. Idempotent
+        (a second end is a no-op) so both StreamingQuery.run's finally
+        and an explicit close() can call it."""
+        with self._lock:
+            if self._inflight.pop(trace.trace_id, None) is None:
+                return  # already ended (or foreign trace)
+        trace._finalize(status, error)
+        with self._lock:
+            self._ring.append(trace)
+        m = self._m()
+        m["queries"].labels(status=status).inc()
+        m["duration"].labels(status=status).observe(trace.duration_s)
+        if trace.pipeline:
+            m["stall"].observe(trace.pipeline.get("stall_secs", 0.0))
+        self._slow_query_check(trace, m)
+        self._export(trace, m)
+
+    def _slow_query_check(self, trace: QueryTrace, m: dict) -> None:
+        thresh_ms = float(get_flag("slow_query_threshold_ms"))
+        if thresh_ms <= 0 or trace.duration_s * 1e3 < thresh_ms:
+            return
+        m["slow"].inc()
+        logger.warning(
+            "slow query (%.1fms > %.1fms): %s",
+            trace.duration_s * 1e3, thresh_ms,
+            json.dumps(trace.to_dict(), default=str),
+        )
+
+    def _export(self, trace: QueryTrace, m: dict) -> None:
+        url = str(get_flag("trace_export_url"))
+        if not url:
+            return
+        if self._exporter is None or self._exporter_url != url:
+            from .otel import OTLPHttpExporter
+
+            self._exporter = OTLPHttpExporter(url)
+            self._exporter_url = url
+        try:
+            self._exporter(trace.to_otlp())
+        except Exception:
+            # Telemetry must never fail the query; the counter is the
+            # operator's signal that the collector is down.
+            m["export_errors"].inc()
+
+    # -- accessors (the /debug/queryz surface) -------------------------------
+    def in_flight(self) -> list:
+        with self._lock:
+            traces = sorted(
+                self._inflight.values(), key=lambda t: t.start_unix_nano
+            )
+        return [t.to_dict() for t in traces]
+
+    def recent(self) -> list:
+        with self._lock:
+            traces = list(self._ring)
+        return [t.to_dict() for t in reversed(traces)]
+
+    def get(self, trace_id: str) -> QueryTrace | None:
+        with self._lock:
+            tr = self._inflight.get(trace_id)
+            if tr is not None:
+                return tr
+            for t in self._ring:
+                if t.trace_id == trace_id:
+                    return t
+        return None
+
+    def last(self) -> QueryTrace | None:
+        """Most recently finished trace (None if the ring is empty)."""
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+
+def plan_script(plan) -> str:
+    """Stable pseudo-script for direct ``execute_plan`` calls (no PxL
+    source): the op-type chain in topo order, so equal plans share a
+    script hash in /debug/queryz."""
+    try:
+        ops = [type(plan.nodes[nid].op).__name__ for nid in plan.topo_order()]
+    except Exception:
+        return "<plan>"
+    return "plan:" + ">".join(ops)

@@ -173,7 +173,7 @@ class DistributedEngine(Engine):
         return self.mesh is self._base_mesh
 
     def execute_plan(self, plan, bridge_inputs=None, analyze=False,
-                     materialize=True, cancel=None):
+                     materialize=True, cancel=None, trace=None):
         """Replan against the live agent set before executing (the
         reference pulls DistributedState fresh per query —
         ``query_executor.go:415``).
@@ -186,7 +186,7 @@ class DistributedEngine(Engine):
         if self.distributed_state is None:
             return super().execute_plan(
                 plan, bridge_inputs=bridge_inputs, analyze=analyze,
-                materialize=materialize, cancel=cancel,
+                materialize=materialize, cancel=cancel, trace=trace,
             )
 
         from ..exec.engine import QueryError
@@ -222,7 +222,7 @@ class DistributedEngine(Engine):
             try:
                 return super().execute_plan(
                     plan, bridge_inputs=bridge_inputs, analyze=analyze,
-                    materialize=materialize, cancel=cancel,
+                    materialize=materialize, cancel=cancel, trace=trace,
                 )
             finally:
                 self.mesh, self.n_devices = saved

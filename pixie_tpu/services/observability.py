@@ -6,26 +6,62 @@ table-store counters) and the shared Go service handlers
 (``src/shared/services/``: ``healthz``, ``statusz``, prometheus
 ``metrics``). Transport is stdlib http.server (no external deps); the
 text exposition follows the Prometheus format so standard scrapers work.
+
+Metric kinds: ``counter`` (monotonic), ``gauge``, and ``histogram``
+(fixed buckets; cumulative ``_bucket{le=...}`` + ``_sum``/``_count``
+exposition, prometheus-cpp Histogram analog). The query-lifecycle
+tracer (``exec/trace.py``) records ``pixie_query_duration_seconds``,
+``pixie_window_stage_seconds`` and ``pixie_pipeline_stall_seconds``
+histograms here; ``/debug/queryz`` lists its in-flight + recent traces.
 """
 
 from __future__ import annotations
 
+import bisect
 import http.server
 import json
 import threading
 from dataclasses import dataclass, field
 
+#: Prometheus client default latency buckets (seconds).
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
 
 @dataclass
 class _Metric:
     name: str
-    kind: str  # "counter" | "gauge"
+    kind: str  # "counter" | "gauge" | "histogram"
     help: str
-    values: dict = field(default_factory=dict)  # labels tuple -> float
+    values: dict = field(default_factory=dict)  # labels tuple -> value
+    # histogram only: ascending finite upper bounds (le); +Inf implicit.
+    buckets: tuple = ()
+
+
+def _esc_label(v) -> str:
+    """Exposition-format label-value escaping."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _esc_help(v) -> str:
+    """HELP text escaping (the format escapes backslash + newline only;
+    quotes are legal in HELP)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_bound(b: float) -> str:
+    """Bucket bound rendering: 0.005 -> '0.005', 1.0 -> '1'."""
+    return format(b, "g")
 
 
 class MetricsRegistry:
-    """Process-wide named counters/gauges with label support."""
+    """Process-wide named counters/gauges/histograms with label support."""
 
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
@@ -42,38 +78,128 @@ class MetricsRegistry:
             m = self._metrics.setdefault(name, _Metric(name, "gauge", help))
         return Gauge(m, self._lock)
 
+    def histogram(self, name: str, help: str = "",
+                  buckets=DEFAULT_BUCKETS) -> "Histogram":
+        bk = tuple(sorted(float(b) for b in buckets))
+        with self._lock:
+            m = self._metrics.setdefault(
+                name, _Metric(name, "histogram", help, buckets=bk)
+            )
+        return Histogram(m, self._lock)
+
     def register_collector(self, fn) -> None:
         """``fn(registry)`` runs before each render — pull-style metrics
         (table stats, cache bytes) refresh here."""
         self._collectors.append(fn)
 
     def render(self) -> str:
+        # A raising collector must not 500 the whole scrape: count it
+        # and keep rendering the rest (prometheus-cpp Collect contract).
+        failed = []
         for fn in list(self._collectors):
-            fn(self)
-
-        def esc(v) -> str:  # exposition-format label escaping
-            return (
-                str(v)
-                .replace("\\", "\\\\")
-                .replace('"', '\\"')
-                .replace("\n", "\\n")
+            try:
+                fn(self)
+            except Exception:
+                failed.append(getattr(fn, "__name__", repr(fn)))
+        if failed:
+            c = self.counter(
+                "pixie_collector_errors_total",
+                "Metric collector callbacks that raised during a render",
             )
+            for name in failed:
+                c.labels(collector=name).inc()
 
         lines = []
         with self._lock:
             for m in sorted(self._metrics.values(), key=lambda m: m.name):
                 if m.help:
-                    lines.append(f"# HELP {m.name} {m.help}")
+                    lines.append(f"# HELP {m.name} {_esc_help(m.help)}")
                 lines.append(f"# TYPE {m.name} {m.kind}")
+                if m.kind == "histogram":
+                    self._render_histogram(m, lines)
+                    continue
                 for labels, v in sorted(m.values.items()):
                     if labels:
                         lbl = ",".join(
-                            f'{k}="{esc(val)}"' for k, val in labels
+                            f'{k}="{_esc_label(val)}"' for k, val in labels
                         )
                         lines.append(f"{m.name}{{{lbl}}} {v}")
                     else:
                         lines.append(f"{m.name} {v}")
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_histogram(m: _Metric, lines: list) -> None:
+        for labels, st in sorted(m.values.items()):
+            base = ",".join(
+                f'{k}="{_esc_label(val)}"' for k, val in labels
+            )
+
+            def series(name, extra=""):
+                lbl = ",".join(x for x in (base, extra) if x)
+                return f"{name}{{{lbl}}}" if lbl else name
+
+            cum = 0
+            for b, c in zip(m.buckets, st["counts"]):
+                cum += c
+                lines.append(
+                    f'{series(m.name + "_bucket", f_le(b))} {cum}'
+                )
+            cum += st["counts"][-1]
+            lines.append(f'{series(m.name + "_bucket", LE_INF)} {cum}')
+            lines.append(f'{series(m.name + "_sum")} {st["sum"]}')
+            lines.append(f'{series(m.name + "_count")} {st["count"]}')
+
+    def quantiles(self, name: str, qs=(0.5, 0.95, 0.99), **labels):
+        """Approximate quantiles of a histogram metric from its buckets
+        (prometheus ``histogram_quantile`` linear interpolation; the
+        +Inf bucket clamps to the highest finite bound). Label kwargs
+        filter; observations are summed across all matching label sets.
+        Returns {q: value} or None when the metric is missing/empty."""
+        want = set(labels.items())
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None or m.kind != "histogram":
+                return None
+            counts = [0] * (len(m.buckets) + 1)
+            total = 0
+            for lbls, st in m.values.items():
+                if want and not want <= set(lbls):
+                    continue
+                for i, c in enumerate(st["counts"]):
+                    counts[i] += c
+                total += st["count"]
+            bounds = m.buckets
+        if total == 0:
+            return None
+        out = {}
+        for q in qs:
+            rank = q * total
+            cum = 0.0
+            val = bounds[-1] if bounds else 0.0
+            for i, c in enumerate(counts):
+                if c == 0:
+                    cum += c
+                    continue
+                if cum + c >= rank:
+                    if i >= len(bounds):  # +Inf bucket
+                        val = bounds[-1] if bounds else 0.0
+                    else:
+                        lo = bounds[i - 1] if i > 0 else 0.0
+                        hi = bounds[i]
+                        val = lo + (hi - lo) * max(rank - cum, 0.0) / c
+                    break
+                cum += c
+            out[q] = val
+        return out
+
+
+def f_le(b: float) -> str:
+    """le="..." label fragment for one finite bucket bound."""
+    return f'le="{_fmt_bound(b)}"'
+
+
+LE_INF = 'le="+Inf"'
 
 
 class _Bound:
@@ -88,6 +214,11 @@ class _Bound:
 
 class Counter(_Bound):
     def inc(self, v: float = 1.0) -> None:
+        if v < 0:
+            raise ValueError(
+                f"counter {self._m.name} cannot decrease (inc {v}); "
+                "Prometheus counters are monotonic — use a gauge"
+            )
         with self._lock:
             self._m.values[self._labels] = (
                 self._m.values.get(self._labels, 0.0) + v
@@ -99,19 +230,52 @@ class Gauge(_Bound):
         with self._lock:
             self._m.values[self._labels] = float(v)
 
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._m.values[self._labels] = (
+                self._m.values.get(self._labels, 0.0) + v
+            )
+
+    def dec(self, v: float = 1.0) -> None:
+        self.inc(-v)
+
+
+class Histogram(_Bound):
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            st = self._m.values.get(self._labels)
+            if st is None:
+                st = self._m.values[self._labels] = {
+                    "counts": [0] * (len(self._m.buckets) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            # le semantics: an observation equal to a bound counts in
+            # that bound's bucket (bisect_left finds the first bound
+            # >= v); past the last bound -> the implicit +Inf slot.
+            st["counts"][bisect.bisect_left(self._m.buckets, v)] += 1
+            st["sum"] += v
+            st["count"] += 1
+
 
 #: Default process registry (metrics.h GetMetricsRegistry analog).
 default_registry = MetricsRegistry()
 
 
 class ObservabilityServer:
-    """healthz / statusz / metrics endpoints for one service process."""
+    """healthz / statusz / metrics / debug endpoints for one service
+    process. Wire a ``tracer`` (``exec.trace.Tracer``, e.g.
+    ``engine.tracer``) to serve ``/debug/queryz`` — the in-flight +
+    recent query-trace listing (Carnot's per-query
+    OperatorExecutionStats surface, made always-on)."""
 
     def __init__(self, registry: MetricsRegistry | None = None,
-                 statusz_fn=None, health_fn=None):
+                 statusz_fn=None, health_fn=None, tracer=None):
         self.registry = registry or default_registry
         self.statusz_fn = statusz_fn  # () -> dict
         self.health_fn = health_fn  # () -> (bool, str)
+        self.tracer = tracer  # exec.trace.Tracer | None
         self._httpd = None
 
     def handle(self, path: str) -> tuple[int, str, str]:
@@ -136,6 +300,18 @@ class ObservabilityServer:
             return (200, "application/json", json.dumps(version_info()))
         if path == "/metrics":
             return (200, "text/plain; version=0.0.4", self.registry.render())
+        if path == "/debug/queryz":
+            if self.tracer is None:
+                return (404, "text/plain", "no tracer wired\n")
+            body = json.dumps(
+                {
+                    "in_flight": self.tracer.in_flight(),
+                    "recent": self.tracer.recent(),
+                },
+                indent=1,
+                default=str,
+            )
+            return (200, "application/json", body)
         return (404, "text/plain", "not found\n")
 
     def start(self, port: int = 0) -> int:

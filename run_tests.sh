@@ -5,10 +5,19 @@
 # late. See .claude/skills/verify/SKILL.md.
 #
 # Modes:
-#   ./run_tests.sh [pytest args...]   plain pytest passthrough
-#   ./run_tests.sh --fast [args...]   skip slow + stress markers
-#   ./run_tests.sh --tier1            the ROADMAP.md tier-1 command verbatim
+#   ./run_tests.sh [pytest args...]    plain pytest passthrough
+#   ./run_tests.sh --fast [args...]    skip slow + stress markers
+#   ./run_tests.sh --tier1             the ROADMAP.md tier-1 command verbatim
+#   ./run_tests.sh --lint-metrics      metrics-name lint only (fast gate:
+#                                      every registered metric must match
+#                                      ^pixie_[a-z0-9_]+$ / valid Prometheus
+#                                      naming; see tests/test_metrics_lint.py)
 case "$1" in
+  --lint-metrics)
+    shift
+    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest -q tests/test_metrics_lint.py "$@"
+    ;;
   --fast)
     shift
     [ $# -eq 0 ] && set -- tests/

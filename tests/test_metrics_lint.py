@@ -1,0 +1,88 @@
+"""Metrics-name lint (``run_tests.sh --lint-metrics``).
+
+Every metric the engine's collectors and tracer register must follow
+Prometheus naming (``^pixie_[a-z0-9_]+$``, valid label names, known
+kinds) — exposition regressions fail here fast instead of at scrape
+time. Exercises the full registration surface: a query through the
+trace spine, the engine collector, and a render.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from pixie_tpu.exec import Engine
+from pixie_tpu.exec.trace import Tracer
+from pixie_tpu.services.observability import (
+    MetricsRegistry,
+    engine_collector,
+)
+
+METRIC_RE = re.compile(r"^pixie_[a-z0-9_]+$")
+LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+VALID_KINDS = {"counter", "gauge", "histogram"}
+RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _exercised_registry() -> MetricsRegistry:
+    """A registry holding everything the engine stack registers."""
+    reg = MetricsRegistry()
+    eng = Engine(window_rows=1 << 10)
+    eng.tracer = Tracer(registry=reg)
+    n = 3000
+    eng.append_data("t", {
+        "time_": np.arange(n, dtype=np.int64),
+        "k": np.arange(n, dtype=np.int64) % 5,
+        "v": np.arange(n, dtype=np.int64),
+    })
+    eng.execute_query(
+        "import px\ndf = px.DataFrame(table='t')\n"
+        "df = df.groupby('k').agg(n=('v', px.count))\npx.display(df)\n"
+    )
+    reg.register_collector(engine_collector(eng))
+    reg.render()  # collectors register their gauges here
+    return reg
+
+
+def test_registered_metric_names_follow_convention():
+    reg = _exercised_registry()
+    metrics = list(reg._metrics.values())
+    assert len(metrics) >= 8  # tracer + collector surface actually ran
+    for m in metrics:
+        assert METRIC_RE.match(m.name), (
+            f"metric {m.name!r} violates ^pixie_[a-z0-9_]+$"
+        )
+        assert m.kind in VALID_KINDS, f"{m.name}: unknown kind {m.kind!r}"
+        # Base names must not collide with histogram series suffixes.
+        if m.kind != "histogram":
+            assert not m.name.endswith(RESERVED_SUFFIXES), (
+                f"{m.name}: reserved Prometheus suffix on a {m.kind}"
+            )
+        for labels in m.values:
+            for k, _v in labels:
+                assert LABEL_RE.match(k), f"{m.name}: bad label {k!r}"
+                assert k != "le", f"{m.name}: 'le' is histogram-reserved"
+
+
+def test_default_registry_names_follow_convention():
+    from pixie_tpu.services.observability import default_registry
+
+    for name in default_registry._metrics:
+        assert METRIC_RE.match(name), (
+            f"default_registry metric {name!r} violates ^pixie_[a-z0-9_]+$"
+        )
+
+
+def test_exposition_parses_as_prometheus_text():
+    """Every rendered line is a comment or `name{labels} value`."""
+    reg = _exercised_registry()
+    line_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+        r"[-+]?([0-9.]+([eE][-+]?[0-9]+)?|inf|nan)$"
+    )
+    for line in reg.render().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert line_re.match(line), f"unparseable exposition line: {line!r}"

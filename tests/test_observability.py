@@ -249,6 +249,160 @@ class TestObservabilityServer:
         assert code == 503 and "expired" in body
 
 
+class TestMetricsRegistry:
+    """ISSUE-3 satellite coverage: histogram exposition, HELP escaping,
+    collector robustness, counter monotonicity, gauge inc/dec."""
+
+    def test_histogram_exposition_format(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("pixie_test_seconds", "latency",
+                          buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.05, 0.5, 1.0, 99.0):  # 1.0 lands in le="1"
+            h.observe(v)
+        body = reg.render()
+        lines = body.splitlines()
+        assert "# TYPE pixie_test_seconds histogram" in lines
+        # Buckets are CUMULATIVE; an observation equal to a bound counts
+        # in that bound's bucket; +Inf equals _count.
+        assert 'pixie_test_seconds_bucket{le="0.1"} 2' in lines
+        assert 'pixie_test_seconds_bucket{le="1"} 4' in lines
+        assert 'pixie_test_seconds_bucket{le="10"} 4' in lines
+        assert 'pixie_test_seconds_bucket{le="+Inf"} 5' in lines
+        assert "pixie_test_seconds_count 5" in lines
+        (sum_line,) = [x for x in lines if x.startswith("pixie_test_seconds_sum")]
+        assert abs(float(sum_line.split()[-1]) - 100.6) < 1e-9
+
+    def test_histogram_labels(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("pixie_test_seconds", "", buckets=(1.0,))
+        h.labels(stage="a").observe(0.5)
+        h.labels(stage="b").observe(2.0)
+        body = reg.render()
+        assert 'pixie_test_seconds_bucket{stage="a",le="1"} 1' in body
+        assert 'pixie_test_seconds_bucket{stage="b",le="1"} 0' in body
+        assert 'pixie_test_seconds_bucket{stage="b",le="+Inf"} 1' in body
+        assert 'pixie_test_seconds_count{stage="a"} 1' in body
+
+    def test_histogram_quantiles(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("pixie_test_seconds", "", buckets=(1.0, 2.0, 4.0))
+        for v in np.linspace(0.1, 3.9, 100):
+            h.observe(float(v))
+        q = reg.quantiles("pixie_test_seconds", (0.5, 0.99))
+        assert 1.5 < q[0.5] < 2.5
+        assert 3.0 < q[0.99] <= 4.0
+        assert reg.quantiles("pixie_nope") is None
+
+    def test_help_text_escaped(self):
+        reg = MetricsRegistry()
+        reg.counter("pixie_weird_total", "line1\nline2 \\ backslash").inc()
+        body = reg.render()
+        assert "# HELP pixie_weird_total line1\\nline2 \\\\ backslash" in body
+        # Exactly one HELP line — the newline must not split the comment.
+        assert len([x for x in body.splitlines()
+                    if x.startswith("# HELP pixie_weird_total")]) == 1
+
+    def test_raising_collector_does_not_kill_render(self):
+        reg = MetricsRegistry()
+        reg.counter("pixie_good_total", "survives").inc(2)
+
+        def bad_collector(r):
+            raise RuntimeError("boom")
+
+        def good_collector(r):
+            r.gauge("pixie_pulled", "").set(7)
+
+        reg.register_collector(bad_collector)
+        reg.register_collector(good_collector)
+        body = reg.render()
+        assert "pixie_good_total 2" in body
+        assert "pixie_pulled 7" in body
+        assert 'pixie_collector_errors_total{collector="bad_collector"} 1' in body
+        # Counted per failing render.
+        assert 'collector="bad_collector"} 2' in reg.render()
+
+    def test_counter_rejects_negative(self):
+        reg = MetricsRegistry()
+        c = reg.counter("pixie_mono_total", "")
+        c.inc(3)
+        with pytest.raises(ValueError, match="monotonic"):
+            c.inc(-1)
+        assert "pixie_mono_total 3" in reg.render()
+
+    def test_gauge_inc_dec(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("pixie_inflight", "")
+        g.inc()
+        g.inc(4)
+        g.dec(2)
+        assert "pixie_inflight 3" in reg.render()
+        g.labels(pool="a").inc()
+        assert 'pixie_inflight{pool="a"} 1' in reg.render()
+
+
+class TestConcurrentScrapes:
+    def test_metrics_scrapes_race_engine_loop(self):
+        """ThreadingHTTPServer /metrics scrapes must stay clean while the
+        engine executes queries (collector reads racing table/tracer
+        writes) — every response parses, no 500s, no lost updates."""
+        e = Engine(window_rows=1 << 10)
+        n = 4096
+        e.append_data("t", {"time_": np.arange(n, dtype=np.int64),
+                            "k": np.arange(n, dtype=np.int64) % 3,
+                            "v": np.arange(n, dtype=np.int64)})
+        reg = MetricsRegistry()
+        from pixie_tpu.exec.trace import Tracer
+
+        e.tracer = Tracer(registry=reg)
+        reg.register_collector(engine_collector(e))
+        srv = ObservabilityServer(registry=reg, tracer=e.tracer)
+        port = srv.start(0)
+        stop = threading.Event()
+        errors = []
+
+        def query_loop():
+            q = ("import px\ndf = px.DataFrame(table='t')\n"
+                 "df = df.groupby('k').agg(n=('v', px.count))\npx.display(df)\n")
+            while not stop.is_set():
+                try:
+                    e.execute_query(q)
+                except Exception as ex:  # pragma: no cover
+                    errors.append(ex)
+                    return
+
+        def scrape_loop():
+            for _ in range(20):
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=10
+                    ) as r:
+                        assert r.status == 200
+                        body = r.read().decode()
+                    assert "pixie_table_rows" in body
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/debug/queryz", timeout=10
+                    ) as r:
+                        json.loads(r.read().decode())
+                except Exception as ex:  # pragma: no cover
+                    errors.append(ex)
+                    return
+
+        qt = threading.Thread(target=query_loop)
+        scrapers = [threading.Thread(target=scrape_loop) for _ in range(4)]
+        qt.start()
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=60)
+        stop.set()
+        qt.join(timeout=60)
+        srv.stop()
+        assert not errors, errors[:1]
+        # The scrape actually saw the trace spine's histograms.
+        body = reg.render()
+        assert "pixie_query_duration_seconds_bucket" in body
+
+
 class TestCrashHandler:
     """services/crash.py: signal_action.h analog — hard-fault stack
     dumps, uncaught-exception recording, fatal-handler last gasps."""
