@@ -27,6 +27,24 @@ class ScriptExecutionError(RuntimeError):
     pass
 
 
+class ScriptResults(dict):
+    """``{table: pydict-of-columns}`` plus the distributed-execution
+    metadata as attributes — plain-dict compatible for existing callers.
+
+    - ``partial``: True when >=1 planned data agent was lost and the
+      tables cover only the survivors (graceful degradation)
+    - ``missing_agents``: the lost agents' ids
+    - ``qid`` / ``agent_stats``: execution identity + per-agent timings
+    """
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.partial = False
+        self.missing_agents: list = []
+        self.qid = None
+        self.agent_stats: dict = {}
+
+
 class TableRecordHandler:
     """Row-wise consumer of one output table (pxapi TableRecordHandler)."""
 
@@ -75,20 +93,30 @@ class Client:
         timeout_s: float = 30.0,
         max_output_rows: int = 10_000,
         handler_factory: Optional[Callable[[str], TableRecordHandler]] = None,
+        require_complete: Optional[bool] = None,
     ):
-        """Run a script; returns {table: pydict-of-columns}.
+        """Run a script; returns a ``ScriptResults``
+        ({table: pydict-of-columns} with partial/missing_agents/qid/
+        agent_stats attributes).
 
         With ``handler_factory``, each output table's rows additionally
         stream through a ``TableRecordHandler`` (the pxapi consumption
-        model); the return value is unchanged.
+        model); the return value is unchanged. ``require_complete=True``
+        fails instead of returning partial results when a data agent is
+        lost mid-query.
         """
+        req = {"query": pxl, "timeout_s": timeout_s,
+               "max_output_rows": max_output_rows}
+        if require_complete is not None:
+            req["require_complete"] = bool(require_complete)
         res = self._request(
-            "broker.execute",
-            {"query": pxl, "timeout_s": timeout_s,
-             "max_output_rows": max_output_rows},
-            timeout_s=timeout_s + 5,
+            "broker.execute", req, timeout_s=timeout_s + 5,
         )
-        out = {}
+        out = ScriptResults()
+        out.partial = bool(res.get("partial"))
+        out.missing_agents = list(res.get("missing_agents", []))
+        out.qid = res.get("qid")
+        out.agent_stats = dict(res.get("agent_stats", {}))
         for name, hb in sorted(res["tables"].items()):
             d = hb.to_pydict()
             out[name] = d
@@ -108,12 +136,16 @@ class Client:
         pxl: str,
         on_update: Callable[[dict], None],
         poll_interval_s: float = 0.25,
+        require_complete: Optional[bool] = None,
     ) -> "StreamSubscription":
         """Subscribe to a live query (the reference's StreamResults /
         live-view flow): ``on_update`` receives
         {table, rows: pydict, seq, mode} as the cluster's tables grow —
         mode "append" carries only new rows, "replace" the full updated
-        aggregate — until ``.cancel()``. Errors arrive as {error}.
+        aggregate — until ``.cancel()``. Errors arrive as {error};
+        a data agent lost mid-stream arrives as a
+        {stream_degraded, partial, missing_agents} update (or as
+        {error}, with ``require_complete=True``).
         """
         import uuid as _uuid
 
@@ -131,13 +163,13 @@ class Client:
             else:
                 on_update(msg)
 
+        req = {"query": pxl, "update_topic": topic,
+               "poll_interval_s": poll_interval_s}
+        if require_complete is not None:
+            req["require_complete"] = bool(require_complete)
         sub = self._bus.subscribe(topic, _relay)
         try:
-            res = self._request(
-                "broker.execute_stream",
-                {"query": pxl, "update_topic": topic,
-                 "poll_interval_s": poll_interval_s},
-            )
+            res = self._request("broker.execute_stream", req)
         except Exception:
             sub.unsubscribe()
             raise

@@ -89,19 +89,27 @@ def cmd_run(args) -> int:
     if args.broker:
         from .api import ScriptExecutionError
 
+        req = {"query": query, "timeout_s": args.timeout,
+               "max_output_rows": args.max_rows}
+        if args.require_complete:
+            req["require_complete"] = True
         with _client(args.broker) as client:
             try:
                 res = client._request(
-                    "broker.execute",
-                    {"query": query, "timeout_s": args.timeout,
-                     "max_output_rows": args.max_rows},
-                    timeout_s=args.timeout + 5,
+                    "broker.execute", req, timeout_s=args.timeout + 5,
                 )
             except ScriptExecutionError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 1
         for name, hb in sorted(res["tables"].items()):
             _print_batch(name, hb, args.output)
+        if res.get("partial"):
+            missing = ", ".join(res.get("missing_agents", []))
+            print(
+                f"warning: PARTIAL results — data agent(s) lost "
+                f"mid-query: {missing}",
+                file=sys.stderr,
+            )
         if args.output == "table":
             stats = res.get("agent_stats", {})
             if stats:
@@ -152,6 +160,14 @@ def cmd_live(args) -> int:
             seen["failed"] = True
             done.set()
             return
+        if u.get("stream_degraded"):
+            missing = ", ".join(u.get("missing_agents", []))
+            print(
+                f"warning: live view degraded — {u.get('reason', '')} "
+                f"(missing: {missing})",
+                file=sys.stderr,
+            )
+            return
         seen["n"] += 1
         mode = u.get("mode", "")
         print(f"-- update {seen['n']} ({mode}) --")
@@ -164,7 +180,8 @@ def cmd_live(args) -> int:
 
     with _client(args.broker) as client:
         sub = client.stream_script(
-            query, on_update, poll_interval_s=args.interval
+            query, on_update, poll_interval_s=args.interval,
+            require_complete=args.require_complete or None,
         )
         try:
             done.wait(timeout=args.timeout if args.timeout else None)
@@ -225,9 +242,10 @@ def cmd_agents(args) -> int:
     with _client(args.broker) as client:
         agents = client.agents()
     for a in agents:
+        q = "  QUARANTINED" if a.get("quarantined") else ""
         print(
             f"{a['agent_id']:14s} asid={a['asid']:<4d} {a['kind']:6s} "
-            f"hb={a['last_heartbeat_s']:.1f}s tables={a['num_tables']}"
+            f"hb={a['last_heartbeat_s']:.1f}s tables={a['num_tables']}{q}"
         )
     return 0
 
@@ -267,6 +285,9 @@ def main(argv=None) -> int:
                      help="generate an N-row synthetic replay (local)")
     run.add_argument("--timeout", type=float, default=30.0)
     run.add_argument("--max-rows", type=int, default=10_000)
+    run.add_argument("--require-complete", action="store_true",
+                     help="fail instead of returning partial results "
+                          "when a data agent is lost mid-query")
     run.add_argument("-o", "--output", choices=("table", "json", "csv"),
                      default="table")
     run.set_defaults(fn=cmd_run)
@@ -278,6 +299,9 @@ def main(argv=None) -> int:
                     help="agent poll cadence (seconds)")
     lv.add_argument("--rounds", type=int, default=0,
                     help="stop after N updates (0 = until interrupted)")
+    lv.add_argument("--require-complete", action="store_true",
+                    help="abort the live view instead of degrading "
+                         "when a data agent is lost")
     lv.add_argument("--timeout", type=float, default=0.0,
                     help="stop after this many seconds (0 = none)")
     lv.set_defaults(fn=cmd_live)

@@ -181,6 +181,40 @@ define_flag(
     "(single-trust-domain deployments).",
 )
 
+# -- fault tolerance (services/query_broker.py, tracker.py) ------------------
+define_flag(
+    "dispatch_retries", 3,
+    "Re-publishes of an un-acked fragment dispatch before the broker "
+    "declares the agent lost (0 = a single un-acked attempt is lost).",
+)
+define_flag(
+    "dispatch_backoff_ms", 50.0,
+    "Initial ack-wait/backoff for fragment dispatch retries; doubles "
+    "per attempt (capped at 2s) with +0..25% jitter.",
+)
+define_flag(
+    "require_complete", False,
+    "Fail a distributed query as soon as a participating data agent is "
+    "lost, instead of completing with partial results from the "
+    "survivors (the pre-fault-tolerance fail-closed behavior).",
+)
+define_flag(
+    "agent_flap_threshold", 3,
+    "Expirations within agent_flap_window_s that quarantine an agent "
+    "out of distributed query planning.",
+)
+define_flag(
+    "agent_flap_window_s", 300.0,
+    "Sliding window (seconds) for counting agent expirations toward "
+    "the flap threshold.",
+)
+define_flag(
+    "agent_quarantine_s", 120.0,
+    "Cooldown during which a quarantined (flapping) agent is excluded "
+    "from distributed_state() planning; it may re-register and "
+    "heartbeat meanwhile.",
+)
+
 # -- query-lifecycle tracing (exec/trace.py) ---------------------------------
 define_flag(
     "trace_ring_size", 128,

@@ -54,7 +54,11 @@ def run_broker() -> int:
         statusz_fn=lambda: {
             "agents": tracker.agents_info(),
             "tables": sorted(tracker.schemas()),
-        }
+            "quarantined": tracker.quarantined(),
+        },
+        # Broker-side distributed-query traces (dispatch/retry/failover
+        # spans) back /debug/queryz on this role.
+        tracer=broker.tracer,
     )
     obs_port = obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "6101")))
     print(

@@ -32,6 +32,10 @@ class AgentInfo:
 @dataclass
 class DistributedState:
     agents: list[AgentInfo] = field(default_factory=list)
+    # Live agents excluded from planning by the tracker's flap
+    # quarantine (services/tracker.py): visible for statusz/debugging,
+    # never scheduled.
+    quarantined: list[str] = field(default_factory=list)
 
     @property
     def pems(self) -> list[AgentInfo]:

@@ -10,13 +10,22 @@ where the reference streams protobuf over gRPC.
 """
 
 from .agent import Agent, KelvinAgent, PEMAgent
-from .msgbus import MessageBus
-from .query_broker import QueryBroker, QueryResultForwarder, QueryTimeout
+from .faults import FaultInjector
+from .msgbus import BusTimeout, MessageBus
+from .query_broker import (
+    AgentLost,
+    QueryBroker,
+    QueryResultForwarder,
+    QueryTimeout,
+)
 from .tracker import AgentTracker
 
 __all__ = [
     "Agent",
+    "AgentLost",
     "AgentTracker",
+    "BusTimeout",
+    "FaultInjector",
     "KelvinAgent",
     "MessageBus",
     "PEMAgent",

@@ -69,6 +69,17 @@ class Agent:
         # cancelled query must be dropped, not backlogged forever).
         self._cancelled: "dict[str, None]" = {}
         self._max_cancelled = 1024
+        # Bounded memory of (qid, kind) dispatches already accepted: the
+        # broker RETRIES un-acked dispatches (and the bus may duplicate
+        # under fault injection), so every fragment handler must be
+        # idempotent — a repeat re-acks (the first ack may be the lost
+        # message) and is otherwise dropped.
+        self._seen_dispatch: "dict[tuple, None]" = {}
+        # Bounded qid -> reduced data-agent set from merge_update events
+        # that arrived BEFORE the (one-shot or streaming) merge install:
+        # cross-topic delivery order is unordered, so the install
+        # consults this parking lot.
+        self._parked_keep: "dict[str, set]" = {}
         # qid -> threading.Event for fragments currently executing: a
         # cancel mid-stream aborts between windows (ExecState keep_running).
         self._running: "dict[str, object]" = {}
@@ -95,8 +106,22 @@ class Agent:
                 f"agent.{a}.stream_bridge", self._on_stream_bridge
             ),
             self.bus.subscribe(f"agent.{a}.tracepoint", self._on_tracepoint),
+            self.bus.subscribe(
+                f"agent.{a}.merge_update", self._on_merge_update
+            ),
             self.bus.subscribe("query.cancel", self._on_cancel),
         ]
+        # Dispatch acks ride a DEDICATED subscription per fragment kind:
+        # each subscription has its own dispatcher thread, so receipt is
+        # acknowledged immediately even while the main handler is busy
+        # executing an earlier fragment — otherwise a retried dispatch's
+        # re-ack would queue behind the running query and the broker
+        # would declare a live, working agent lost.
+        for kind in ("execute", "merge", "stream_execute", "stream_merge"):
+            self._subs.append(self.bus.subscribe(
+                f"agent.{a}.{kind}",
+                lambda m, k=kind: self._ack_receipt(m, k),
+            ))
         self._register()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
@@ -214,20 +239,50 @@ class Agent:
         self.collector.flush()
 
     # -- query execution -----------------------------------------------------
+    def _bounded_put(self, d: dict, key, value=None) -> None:
+        """Insert into one of the bounded bookkeeping dicts
+        (``_cancelled`` / ``_seen_dispatch`` / ``_parked_keep`` /
+        per-stream row dedup), evicting insertion-oldest entries past
+        ``_max_cancelled``. Caller holds ``self._lock``."""
+        d[key] = value
+        while len(d) > self._max_cancelled:
+            d.pop(next(iter(d)))
+
     def _on_cancel(self, msg):
         with self._lock:
-            self._cancelled[msg["qid"]] = None
-            while len(self._cancelled) > self._max_cancelled:
-                self._cancelled.pop(next(iter(self._cancelled)))
+            self._bounded_put(self._cancelled, msg["qid"])
             self._pending_merges.pop(msg["qid"], None)
             self._streaming_merges.pop(msg["qid"], None)
             ev = self._running.get(msg["qid"])
         if ev is not None:
             ev.set()
 
+    def _ack_receipt(self, msg: dict, kind: str) -> None:
+        """Ack a fragment dispatch on ``query.{qid}.ack`` — every
+        receipt, including retried/duplicated copies (the first ack may
+        be the message that was lost)."""
+        self.bus.publish(
+            f"query.{msg['qid']}.ack",
+            {"ack": kind, "agent": self.agent_id},
+        )
+
+    def _dedup_dispatch_locked(self, qid: str, kind: str) -> bool:
+        """True when this (qid, kind) dispatch was already accepted:
+        retried or fault-duplicated dispatches must not re-run. Caller
+        holds ``self._lock``."""
+        dup = (qid, kind) in self._seen_dispatch
+        self._bounded_put(self._seen_dispatch, (qid, kind))
+        return dup
+
+    def _dedup_dispatch(self, qid: str, kind: str) -> bool:
+        with self._lock:
+            return self._dedup_dispatch_locked(qid, kind)
+
     def _on_execute(self, msg):
         """Run a data fragment; ship bridge payloads to the merge agent."""
         qid, plan = msg["qid"], msg["plan"]
+        if self._dedup_dispatch(qid, "execute"):
+            return
         import threading as _threading
 
         ev = _threading.Event()
@@ -280,22 +335,41 @@ class Agent:
             {"agent": self.agent_id, "exec_time_s": elapsed},
         )
 
+    @staticmethod
+    def _new_pending_merge() -> dict:
+        # "keep" narrows the participating data-agent set when the
+        # broker fails over a lost agent (None = everyone expected).
+        return {"plan": None, "expect": None, "got": {}, "got_keys": set(),
+                "keep": None}
+
     def _on_merge(self, msg):
         """Install a merge fragment; runs once all bridge payloads land."""
         qid = msg["qid"]
-        if qid in self._cancelled:
-            return
         with self._lock:
+            # Dedup marking and record install must be ONE critical
+            # section: _on_bridge/_on_merge_update read "(qid, merge)
+            # seen + no record" as "merge already ran" — a gap between
+            # the two here would make them drop a live query's chunk.
+            if self._dedup_dispatch_locked(qid, "merge"):
+                return
+            if qid in self._cancelled:
+                return
             # Bridge payloads may already be backlogged for this query —
             # merge the plan into the existing record, never replace it.
             pm = self._pending_merges.setdefault(
-                qid, {"plan": None, "expect": None, "got": {}, "got_keys": set()}
+                qid, self._new_pending_merge()
             )
+            parked = self._parked_keep.get(qid)
+            if parked is not None:
+                pm["keep"] = (
+                    parked if pm["keep"] is None else (pm["keep"] & parked)
+                )
             pm["plan"] = msg["plan"]
             pm["expect"] = {
                 (bid, aid)
                 for bid in msg["bridge_ids"]
                 for aid in msg["data_agents"]
+                if pm["keep"] is None or aid in pm["keep"]
             }
         self._maybe_finish_merge(qid)
 
@@ -306,27 +380,85 @@ class Agent:
                 return
             pm = self._pending_merges.get(qid)
             if pm is None:
+                if (qid, "merge") in self._seen_dispatch:
+                    return  # merge already ran; a late duplicate chunk
                 # Bridge chunks can arrive before the merge plan (the
                 # GRPCRouter backlogs early TransferResultChunks).
                 pm = self._pending_merges.setdefault(
-                    qid, {"plan": None, "expect": None, "got": {}, "got_keys": set()}
+                    qid, self._new_pending_merge()
                 )
-            pm["got"].setdefault(msg["bridge_id"], []).append(msg["payload"])
-            pm["got_keys"].add((msg["bridge_id"], msg["from_agent"]))
+            key = (msg["bridge_id"], msg["from_agent"])
+            if key in pm["got_keys"]:
+                return  # duplicate delivery (retry / injected dup)
+            if pm["keep"] is not None and msg["from_agent"] not in pm["keep"]:
+                return  # late chunk from an agent already failed over
+            pm["got"].setdefault(msg["bridge_id"], []).append(
+                (msg["from_agent"], msg["payload"])
+            )
+            pm["got_keys"].add(key)
         self._maybe_finish_merge(qid)
+
+    def _on_merge_update(self, msg):
+        """The broker failed over a lost data agent: shrink the expected
+        set to ``data_agents`` and discard the lost agents' (possibly
+        incomplete) contributions so the merge runs from survivors only
+        — the partial-aggregation path (Taurus-style best-effort
+        scatter-gather). The reduced set is also PARKED: the update can
+        beat the (retried) merge/stream_merge install on another
+        dispatcher thread, and the install must still see it."""
+        qid, keep = msg["qid"], set(msg["data_agents"])
+        with self._lock:
+            if qid in self._cancelled:
+                return
+            parked = self._parked_keep.get(qid)
+            keep = keep if parked is None else (parked & keep)
+            self._bounded_put(self._parked_keep, qid, keep)
+            pm = self._pending_merges.get(qid)
+            if pm is not None:
+                pm["keep"] = (
+                    keep if pm["keep"] is None else (pm["keep"] & keep)
+                )
+                if pm["expect"] is not None:
+                    pm["expect"] = {
+                        (b, a) for (b, a) in pm["expect"] if a in pm["keep"]
+                    }
+            st = self._streaming_merges.get(qid)
+            if st is not None:
+                st["keep"] = (
+                    keep if st["keep"] is None else (st["keep"] & keep)
+                )
+                if st["expect"] is not None:
+                    st["expect"] = {
+                        (b, a) for (b, a) in st["expect"] if a in st["keep"]
+                    }
+                st["latest"] = {
+                    k: v for k, v in st["latest"].items() if k[1] in st["keep"]
+                }
+        self._maybe_finish_merge(qid)
+        self._maybe_stream_remerge(qid)
 
     def _maybe_finish_merge(self, qid):
         with self._lock:
             pm = self._pending_merges.get(qid)
             if (
                 pm is None
+                or pm["plan"] is None
                 or pm["expect"] is None
                 or not pm["expect"] <= pm["got_keys"]
             ):
                 return
             del self._pending_merges[qid]
+        keep = pm["keep"]
+        bridge_inputs = {}
+        for bid, contributions in pm["got"].items():
+            payloads = [p for (a, p) in contributions
+                        if keep is None or a in keep]
+            if payloads:
+                bridge_inputs[bid] = payloads
         try:
-            outputs = self.engine.execute_plan(pm["plan"], bridge_inputs=pm["got"])
+            outputs = self.engine.execute_plan(
+                pm["plan"], bridge_inputs=bridge_inputs
+            )
         except Exception as e:
             self.bus.publish(
                 f"query.{qid}.results",
@@ -351,6 +483,8 @@ class Agent:
         from ..exec.streaming import StreamingQuery
 
         qid, plan = msg["qid"], msg["plan"]
+        if self._dedup_dispatch(qid, "stream_execute"):
+            return
         merge_agent = msg.get("merge_agent")
         interval = float(msg.get("poll_interval_s", 0.25))
         ev = threading.Event()
@@ -408,8 +542,10 @@ class Agent:
             {
                 "plan": None,
                 "expect": None,
+                "keep": None,  # reduced agent set after failover
                 "latest": {},
                 "pending_rows": [],  # chunks that beat the plan install
+                "seen_rows": {},  # (bid, agent, seq) dedup, bounded
                 "seq": 0,
                 "dirty": False,
                 "merging": False,
@@ -422,15 +558,23 @@ class Agent:
         re-merge into an updated result (incremental view maintenance —
         the reference re-runs live views from scratch on every poll)."""
         qid = msg["qid"]
+        if self._dedup_dispatch(qid, "stream_merge"):
+            return
         with self._lock:
             if qid in self._cancelled:
                 return
             st = self._stream_state(qid)
+            parked = self._parked_keep.get(qid)
+            if parked is not None:
+                st["keep"] = (
+                    parked if st["keep"] is None else (st["keep"] & parked)
+                )
             st["plan"] = msg["plan"]
             st["expect"] = {
                 (bid, aid)
                 for bid in msg["bridge_ids"]
                 for aid in msg["data_agents"]
+                if st["keep"] is None or aid in st["keep"]
             }
             backlog = st["pending_rows"]
             st["pending_rows"] = []
@@ -449,9 +593,23 @@ class Agent:
             if qid in self._cancelled:
                 return
             st = self._stream_state(qid)
+            if (
+                st["keep"] is not None
+                and msg["from_agent"] not in st["keep"]
+            ):
+                return  # chunk from an agent already failed over
             if isinstance(payload, RowsPayload):
                 # Row-gather bridges append: every chunk flows through the
-                # merge plan once, independently.
+                # merge plan once, independently — so a DUPLICATED
+                # delivery (retry / at-least-once transport / injected
+                # dup) would double-count rows in the live view. Dedup
+                # by the producer's per-cursor sequence number.
+                chunk_key = (
+                    msg["bridge_id"], msg["from_agent"], msg.get("seq")
+                )
+                if chunk_key in st["seen_rows"]:
+                    return
+                self._bounded_put(st["seen_rows"], chunk_key)
                 st["latest"][(msg["bridge_id"], msg["from_agent"])] = None
                 if st["plan"] is None:
                     st["pending_rows"].append((msg["bridge_id"], payload))

@@ -18,8 +18,14 @@ from dataclasses import dataclass, field
 class LoadReport:
     queries: int = 0
     errors: int = 0
+    partials: int = 0  # queries that returned with partial=True
+    errors_by_type: dict = field(default_factory=dict)
     latencies_s: list = field(default_factory=list)
     wall_s: float = 0.0
+
+    @property
+    def failure_rate(self) -> float:
+        return self.errors / self.queries if self.queries else 0.0
 
     def percentile(self, p: float) -> float:
         """Nearest-rank percentile: ceil(p/100 * N)-th smallest."""
@@ -35,6 +41,9 @@ class LoadReport:
         return {
             "queries": self.queries,
             "errors": self.errors,
+            "failure_rate": round(self.failure_rate, 4),
+            "errors_by_type": dict(self.errors_by_type),
+            "partials": self.partials,
             "qps": (
                 round(self.queries / self.wall_s, 2) if self.wall_s else 0.0
             ),
@@ -64,18 +73,27 @@ def run_load(
     def worker():
         for _ in range(per_worker):
             t0 = time.perf_counter()
-            ok = True
+            err = None
+            partial = False
             try:
-                execute(query, timeout_s)
-            except Exception:
-                ok = False
+                res = execute(query, timeout_s)
+                partial = bool(
+                    isinstance(res, dict) and res.get("partial")
+                )
+            except Exception as e:
+                err = type(e).__name__
             dt = time.perf_counter() - t0
             with lock:
                 report.queries += 1
-                if ok:
+                if err is None:
                     report.latencies_s.append(dt)
+                    if partial:
+                        report.partials += 1
                 else:
                     report.errors += 1
+                    report.errors_by_type[err] = (
+                        report.errors_by_type.get(err, 0) + 1
+                    )
 
     t_start = time.perf_counter()
     threads = [threading.Thread(target=worker) for _ in range(workers)]
@@ -91,7 +109,7 @@ def broker_executor(broker):
     """Adapter for an in-process QueryBroker."""
 
     def execute(query, timeout_s):
-        broker.execute_script(query, timeout_s=timeout_s)
+        return broker.execute_script(query, timeout_s=timeout_s)
 
     return execute
 
@@ -110,6 +128,7 @@ def remote_executor(host: str, port: int):
         )
         if not res.get("ok"):
             raise RuntimeError(res.get("error", "unknown broker error"))
+        return res
 
     execute.close = bus.close  # type: ignore[attr-defined]
     return execute

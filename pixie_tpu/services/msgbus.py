@@ -49,11 +49,22 @@ class Subscription:
 _CLOSE = object()
 
 
+class BusTimeout(TimeoutError):
+    """Uniform request/reply timeout across bus transports.
+
+    Both ``MessageBus.request`` and ``netbus.RemoteBus.request`` raise
+    THIS (never a bare ``TimeoutError``) so broker/agent retry logic can
+    catch one exception type regardless of transport."""
+
+
 class MessageBus:
     def __init__(self):
         self._lock = threading.Lock()
         self._subs: dict[str, list[Subscription]] = {}
         self.handler_errors: list[tuple[str, Exception]] = []
+        # Optional faults.FaultInjector consulted on every publish
+        # (drop/delay/duplicate + trigger hooks); None = no faults.
+        self.fault_injector = None
 
     def subscribe(self, topic: str, fn: Callable) -> Subscription:
         sub = Subscription(self, topic, fn)
@@ -62,7 +73,26 @@ class MessageBus:
         return sub
 
     def publish(self, topic: str, msg: dict) -> int:
-        """Fan out to all subscribers; returns the number delivered to."""
+        """Fan out to all subscribers; returns the number delivered to.
+
+        With a fault injector attached, the injector decides the
+        delivery plan (drop/delay/duplicate); the returned count is the
+        SUBSCRIBER count regardless — a NATS publisher can't observe
+        in-flight loss either."""
+        inj = self.fault_injector
+        if inj is not None:
+            for delay_s in inj.intercept(topic, msg):
+                if delay_s <= 0:
+                    self._fanout(topic, msg)
+                else:
+                    t = threading.Timer(delay_s, self._fanout, (topic, msg))
+                    t.daemon = True
+                    t.start()
+            with self._lock:
+                return len(self._subs.get(topic, []))
+        return self._fanout(topic, msg)
+
+    def _fanout(self, topic: str, msg: dict) -> int:
         with self._lock:
             subs = list(self._subs.get(topic, []))
         for s in subs:
@@ -81,10 +111,12 @@ class MessageBus:
         try:
             n = self.publish(topic, {**msg, "_reply_to": inbox})
             if n == 0:
-                raise TimeoutError(f"no responder on {topic!r}")
+                raise BusTimeout(f"no responder on {topic!r}")
             return q.get(timeout=timeout_s)
         except _queue.Empty:
-            raise TimeoutError(f"no reply from {topic!r} in {timeout_s}s") from None
+            raise BusTimeout(
+                f"no reply from {topic!r} in {timeout_s}s"
+            ) from None
         finally:
             sub.unsubscribe()
 

@@ -18,7 +18,7 @@ import socket
 import struct
 import threading
 
-from .msgbus import MessageBus
+from .msgbus import BusTimeout, MessageBus
 from .wire import WireError, decode, encode
 
 _LEN = struct.Struct("<I")
@@ -338,6 +338,10 @@ class RemoteBus:
         self._handlers: dict[int, object] = {}  # sid -> callable
         self._next_sid = 1
         self._closed = threading.Event()
+        # Optional faults.FaultInjector consulted on every publish
+        # (mirrors MessageBus.fault_injector; netbus frames are the
+        # injection point for remote-agent fault tests).
+        self.fault_injector = None
         # Mint a token from the shared secret when the caller brings
         # none (deploy processes share the bus_secret flag/env).
         if token is None and get_flag("bus_secret"):
@@ -371,8 +375,34 @@ class RemoteBus:
         return sub
 
     def publish(self, topic: str, msg: dict) -> int:
+        inj = self.fault_injector
+        if inj is not None:
+            for delay_s in inj.intercept(topic, msg):
+                if delay_s <= 0:
+                    self._send({"op": "pub", "topic": topic, "msg": msg})
+                else:
+                    t = threading.Timer(
+                        delay_s, self._send,
+                        ({"op": "pub", "topic": topic, "msg": msg},),
+                    )
+                    t.daemon = True
+                    t.start()
+            return 1
         self._send({"op": "pub", "topic": topic, "msg": msg})
         return 1
+
+    def sever(self) -> None:
+        """Hard-cut the connection WITHOUT the orderly close bookkeeping
+        a caller would run — the fault-injection analog of a mid-flight
+        network partition. The read loop sees EOF/reset and reaps."""
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
     def request(self, topic: str, msg: dict, timeout_s: float = 5.0) -> dict:
         """Request/reply over the bridge (MessageBus.request mirror).
@@ -390,7 +420,7 @@ class RemoteBus:
             self.publish(topic, {**msg, "_reply_to": inbox})
             return q.get(timeout=timeout_s)
         except _queue.Empty:
-            raise TimeoutError(
+            raise BusTimeout(
                 f"no reply from {topic!r} in {timeout_s}s"
             ) from None
         finally:

@@ -263,6 +263,13 @@ class Histogram(_Bound):
 default_registry = MetricsRegistry()
 
 
+def default_counter(name: str, help: str = "") -> Counter:
+    """Bound counter on the process-wide default registry. Registration
+    is idempotent and binding is cheap — call at the increment site, no
+    per-caller lazy-cache dance needed."""
+    return default_registry.counter(name, help)
+
+
 class ObservabilityServer:
     """healthz / statusz / metrics / debug endpoints for one service
     process. Wire a ``tracer`` (``exec.trace.Tracer``, e.g.

@@ -11,7 +11,9 @@ client with producer/consumer watchdog timeouts and cancellation.
 from __future__ import annotations
 
 import queue
+import random
 import threading
+import time
 import uuid
 
 from ..exec.engine import QueryError
@@ -22,72 +24,280 @@ from ..udf.registry import Registry, default_registry
 from .msgbus import MessageBus
 from .tracker import AgentTracker
 
+#: Dispatch-retry backoff hard cap (seconds) — dispatch_backoff_ms
+#: doubles per attempt up to here.
+MAX_DISPATCH_BACKOFF_S = 2.0
+
 
 class QueryTimeout(QueryError):
     pass
 
 
+class AgentLost(QueryError):
+    """A query participant died (expired / never acked its dispatch)
+    while ``require_complete`` forbids degrading to partial results, or
+    the participant was the un-substitutable merge agent."""
+
+
 class QueryResultForwarder:
-    """Per-query result stream assembly with watchdog timeouts."""
+    """Per-query result stream assembly with watchdog timeouts,
+    failure-driven failover, and partial-result accounting.
+
+    A registered query knows its expected data-agent IDS (not just a
+    count) and its merge agent; ``agent.expired`` events and
+    dispatch-retry exhaustion (``query.{qid}.agent_lost``) feed the same
+    wait loop as results, so a dying agent fails a query over
+    immediately instead of waiting out the watchdog
+    (query_result_forwarder.go's producer-streams teardown)."""
 
     def __init__(self, bus: MessageBus):
         self.bus = bus
         self._lock = threading.Lock()
         self._active: dict[str, dict] = {}
 
-    def register_query(self, qid: str, expected_data_agents: int):
+    def register_query(
+        self,
+        qid: str,
+        expected_data_agents,
+        merge_agent: str = "",
+        require_complete: bool = False,
+        trace=None,
+    ):
+        """``expected_data_agents`` is the iterable of agent IDs the
+        query was planned onto — IDS, not a count: failover, the
+        missing-set in timeout diagnostics, and per-agent dispatch
+        state all key on them."""
+        agents = list(expected_data_agents)
+        from .tracker import TOPIC_EXPIRED
+
         q: queue.Queue = queue.Queue()
-        sub = self.bus.subscribe(f"query.{qid}.results", q.put)
-        done_sub = self.bus.subscribe(f"query.{qid}.agent_done", q.put)
+        subs = [
+            self.bus.subscribe(f"query.{qid}.results", q.put),
+            self.bus.subscribe(f"query.{qid}.agent_done", q.put),
+            self.bus.subscribe(f"query.{qid}.ack", q.put),
+            self.bus.subscribe(f"query.{qid}.agent_lost", q.put),
+            self.bus.subscribe(
+                TOPIC_EXPIRED,
+                lambda m: q.put({
+                    "_expired": m.get("agent_id"),
+                    "_reason": m.get("reason", "expired"),
+                }),
+            ),
+        ]
+        dispatch = {f"{aid}:execute": "dispatched" for aid in agents}
+        if merge_agent:
+            dispatch[f"{merge_agent}:merge"] = "dispatched"
         with self._lock:
             self._active[qid] = {
                 "queue": q,
-                "subs": [sub, done_sub],
-                "expected": expected_data_agents,
+                "subs": subs,
+                "expected": set(agents),
+                "merge_agent": merge_agent,
+                "require_complete": require_complete,
+                "dispatch": dispatch,
+                "missing": {},  # aid -> reason
+                "trace": trace,
             }
 
     def wait(self, qid: str, timeout_s: float) -> dict:
         """Blocks until eos/error/timeout. Returns {table: HostBatch} plus
-        per-agent exec stats; raises on error or watchdog expiry."""
+        per-agent exec stats and the partial-result marker; raises on
+        error, merge-agent loss, require_complete violation, or watchdog
+        expiry. The watchdog is an INACTIVITY timeout: any message
+        resets it (the reference's producer watchdog)."""
         with self._lock:
             st = self._active[qid]
         outputs: dict = {}
         stats: dict = {}
         eos = False
+        grace_deadline = None
+        # Inactivity watchdog: only QUERY-RELEVANT activity pushes the
+        # deadline out — unrelated cluster churn (another query's agent
+        # expiring) must not postpone a hung query's timeout forever.
+        deadline = time.monotonic() + timeout_s
         try:
             while True:
-                if eos and len(stats) >= st["expected"]:
-                    return {"tables": outputs, "agent_stats": stats}
-                # After eos, per-agent stats may still be in flight on
-                # their own dispatcher threads — drain with a short grace
-                # window instead of returning a partial stats map.
-                wait_s = min(timeout_s, 1.0) if eos else timeout_s
+                if eos and self._complete(st, stats):
+                    return self._result(st, outputs, stats)
+                now = time.monotonic()
+                if eos:
+                    # After eos, per-agent stats may still be in flight
+                    # on their own dispatcher threads — drain them under
+                    # ONE total grace budget (a per-message wait would
+                    # let a trickle of stragglers extend the drain by
+                    # ~1s × expected agents).
+                    if grace_deadline is None:
+                        grace_deadline = now + min(timeout_s, 1.0)
+                    wait_s = grace_deadline - now
+                    if wait_s <= 0:
+                        return self._result(st, outputs, stats)
+                else:
+                    wait_s = deadline - now
+                    if wait_s <= 0:
+                        self.cancel(qid)
+                        raise QueryTimeout(
+                            self._timeout_message(qid, st, stats, timeout_s)
+                        )
                 try:
                     msg = st["queue"].get(timeout=wait_s)
                 except queue.Empty:
                     if eos:
-                        return {"tables": outputs, "agent_stats": stats}
+                        return self._result(st, outputs, stats)
                     # Watchdog fired (query_result_forwarder.go:241):
                     # cancel the query everywhere and fail the stream.
                     self.cancel(qid)
                     raise QueryTimeout(
-                        f"query {qid} timed out after {timeout_s}s "
-                        f"(stats so far: {sorted(stats)})"
+                        self._timeout_message(qid, st, stats, timeout_s)
                     ) from None
                 if "error" in msg:
                     self.cancel(qid)
                     raise QueryError(msg["error"])
-                if "exec_time_s" in msg:
+                if "ack" in msg:
+                    st["dispatch"][
+                        f"{msg.get('agent')}:{msg['ack']}"
+                    ] = "acked"
+                elif "_expired" in msg:
+                    aid = msg["_expired"]
+                    if (
+                        aid != st["merge_agent"]
+                        and aid not in st["expected"]
+                    ):
+                        continue  # another query's churn: no reset
+                    if not msg.get("_requeued"):
+                        # One-shot deferral: the dead agent may have
+                        # DELIVERED everything already, with its
+                        # agent_done/eos still sitting in this queue
+                        # (separate dispatcher threads enqueue in
+                        # nondeterministic order). Re-enqueueing puts
+                        # the expiry behind whatever was already in
+                        # flight, so delivered data is never discarded.
+                        st["queue"].put({**msg, "_requeued": True})
+                        continue
+                    if eos:
+                        # The merge already emitted complete results; at
+                        # most stop waiting for this agent's stats.
+                        st["expected"].discard(aid)
+                        continue
+                    self._agent_lost(
+                        qid, st, stats, aid,
+                        msg.get("_reason", "expired"),
+                    )
+                elif "agent_lost" in msg:
+                    if not msg.get("_requeued"):
+                        # Same one-shot deferral as _expired: a late ack
+                        # (or delivered results) may already sit in this
+                        # queue behind the verdict.
+                        st["queue"].put({**msg, "_requeued": True})
+                        continue
+                    # A retry-exhaustion verdict is advisory: if the
+                    # ack DID reach this queue (the retry manager merely
+                    # raced its own timeout under load), the agent
+                    # demonstrably holds the fragment — keep waiting;
+                    # real death is caught by expiry.
+                    kind = msg.get("kind", "execute")
+                    key = f"{msg['agent_lost']}:{kind}"
+                    if (
+                        msg.get("unacked")
+                        and st["dispatch"].get(key) == "acked"
+                    ):
+                        continue
+                    if eos:
+                        st["expected"].discard(msg["agent_lost"])
+                        continue
+                    self._agent_lost(
+                        qid, st, stats, msg["agent_lost"],
+                        msg.get("reason", "lost"),
+                    )
+                elif "exec_time_s" in msg:
                     stats[msg["agent"]] = {"exec_time_s": msg["exec_time_s"]}
                 elif msg.get("eos"):
                     eos = True
                 elif "table" in msg:
                     outputs[msg["table"]] = msg["batch"]
+                deadline = time.monotonic() + timeout_s
         finally:
             self._deregister(qid)
 
+    @staticmethod
+    def _complete(st: dict, stats: dict) -> bool:
+        return st["expected"] <= set(stats)
+
+    def _agent_lost(self, qid: str, st: dict, stats: dict, aid: str,
+                    reason: str) -> None:
+        """One participant is gone: fail over (partial results), or fail
+        fast when degradation is impossible (merge agent) or forbidden
+        (require_complete)."""
+        if aid == st["merge_agent"]:
+            self.cancel(qid)
+            raise AgentLost(
+                f"merge agent {aid} {reason}; query {qid} failed"
+            )
+        if aid not in st["expected"] or aid in stats:
+            return  # not a participant / already finished its fragment
+        if st["require_complete"]:
+            self.cancel(qid)
+            raise AgentLost(
+                f"data agent {aid} {reason} and require_complete is set; "
+                f"missing_agents: ['{aid}']"
+            )
+        st["expected"].discard(aid)
+        st["missing"][aid] = reason
+        st["dispatch"][f"{aid}:execute"] = f"lost ({reason})"
+        tr = st.get("trace")
+        if tr is not None:
+            with tr.span("failover") as sp:
+                sp.attributes.update({"agent": aid, "reason": reason})
+        if not st["expected"]:
+            self.cancel(qid)
+            raise AgentLost(
+                f"all data agents lost for query {qid}: "
+                f"{sorted(st['missing'])}"
+            )
+        # Tell the merge agent to finish from the survivors: without
+        # this, _maybe_finish_merge waits forever on the dead agent's
+        # bridge payloads.
+        if st["merge_agent"]:
+            self.bus.publish(
+                f"agent.{st['merge_agent']}.merge_update",
+                {"qid": qid, "data_agents": sorted(st["expected"])},
+            )
+
+    @staticmethod
+    def _timeout_message(qid: str, st: dict, stats: dict,
+                         timeout_s: float) -> str:
+        missing = sorted(st["expected"] - set(stats))
+        return (
+            f"query {qid} timed out after {timeout_s}s "
+            f"(reported: {sorted(stats)}; missing: {missing}; "
+            f"dispatch: {dict(sorted(st['dispatch'].items()))})"
+        )
+
+    def _result(self, st: dict, outputs: dict, stats: dict) -> dict:
+        res = {
+            "tables": outputs,
+            "agent_stats": stats,
+            "partial": bool(st["missing"]),
+            "missing_agents": sorted(st["missing"]),
+        }
+        if st["missing"]:
+            res["missing_reasons"] = dict(st["missing"])
+            from .observability import default_counter
+
+            default_counter(
+                "pixie_query_partial_total",
+                "Distributed queries completed with partial results "
+                "(>=1 data agent lost mid-query)",
+            ).inc()
+        return res
+
     def cancel(self, qid: str):
         self.bus.publish("query.cancel", {"qid": qid})
+
+    def is_active(self, qid: str) -> bool:
+        """True while ``qid`` is registered and not yet deregistered
+        (the dispatch-retry loop's liveness check)."""
+        with self._lock:
+            return qid in self._active
 
     def _deregister(self, qid: str):
         with self._lock:
@@ -102,10 +312,13 @@ class StreamHandle:
     streaming cursors and detaches the subscriber."""
 
     def __init__(self, qid: str, broker: "QueryBroker", sub,
-                 merge_agent: str = "", data_agents: tuple = ()):
+                 merge_agent: str = "", data_agents: tuple = (),
+                 require_complete: bool = False):
         self.qid = qid
         self.merge_agent = merge_agent
         self.data_agents = tuple(data_agents)
+        self.require_complete = require_complete
+        self.missing_agents: tuple = ()
         self._broker = broker
         self._sub = sub
 
@@ -139,6 +352,12 @@ class QueryBroker:
         )
         self.forwarder = QueryResultForwarder(bus)
         self.planner = DistributedPlanner(self.registry)
+        # Broker-side query-lifecycle traces (exec/trace.py Tracer):
+        # dispatch / retry / failover spans per distributed query,
+        # served as /debug/queryz on the broker role.
+        from ..exec.trace import Tracer
+
+        self.tracer = Tracer()
         # Dynamic-tracing support (the MutationExecutor dependency,
         # mutation_executor.go:84); wire a TracepointRegistry to enable.
         self.tracepoints = None
@@ -149,14 +368,16 @@ class QueryBroker:
         # on a forever-silent subscription (reference: the forwarder's
         # producer watchdog, query_result_forwarder.go).
         self._live_streams: dict = {}
+        # Serializes degrade decisions: two agents expiring at once (on
+        # separate dispatcher threads) must not lose each other's
+        # handle.data_agents update — a lost update would leave a dead
+        # agent in the merge's keep-set and stall the view forever.
+        self._degrade_lock = threading.Lock()
 
         from .tracker import TOPIC_EXPIRED, TOPIC_REGISTER
 
         self._expiry_sub = self.bus.subscribe(
-            TOPIC_EXPIRED,
-            lambda msg: self._abort_streams_of(
-                msg.get("agent_id"), "expired"
-            ),
+            TOPIC_EXPIRED, self._on_agent_expired
         )
         # A RE-registration of a PLANNED agent means a new incarnation
         # (restart): the old process's stream state — merge carries on
@@ -201,6 +422,151 @@ class QueryBroker:
             )
             handle.cancel()  # idempotent (entry already popped)
 
+    def _on_agent_expired(self, msg: dict) -> None:
+        """Tracker expiry: merge-agent death aborts the stream (its
+        state is unrecoverable); data-agent death degrades the stream to
+        the survivors (or aborts, under require_complete). One-shot
+        queries get the same event through their forwarder
+        registration."""
+        aid = msg.get("agent_id")
+        self._abort_streams_of(aid, "expired")
+        self._degrade_streams_of(aid, msg.get("reason", "expired"))
+
+    def _degrade_streams_of(self, agent_id, why: str) -> None:
+        with self._degrade_lock:
+            for qid, handle in list(self._live_streams.items()):
+                self._degrade_one_locked(qid, handle, agent_id, why)
+
+    def _degrade_one_stream(self, qid: str, agent_id, why: str) -> None:
+        """Qid-scoped degrade (the per-query dispatch-loss path: the
+        verdict only says THIS query's dispatch went missing, so other
+        live streams on the same agent must be untouched)."""
+        with self._degrade_lock:
+            handle = self._live_streams.get(qid)
+            if handle is not None:
+                self._degrade_one_locked(qid, handle, agent_id, why)
+
+    def _degrade_one_locked(self, qid: str, handle, agent_id,
+                            why: str) -> None:
+        if (
+            agent_id not in handle.data_agents
+            or handle.merge_agent == agent_id
+        ):
+            return
+        survivors = tuple(
+            a for a in handle.data_agents if a != agent_id
+        )
+        if handle.require_complete or not survivors:
+            # Nothing to degrade to (or degradation forbidden): a
+            # sourceless live stream would sit silent forever —
+            # error it out like a merge-agent death instead.
+            if self._live_streams.pop(qid, None) is None:
+                return
+            cause = (
+                "require_complete" if handle.require_complete
+                else "no data agents left"
+            )
+            self.bus.publish(
+                f"query.{qid}.results",
+                {"error": f"data agent {agent_id} {why}; live query "
+                          f"{qid} aborted ({cause})"},
+            )
+            handle.cancel()
+            return
+        handle.data_agents = survivors
+        handle.missing_agents = handle.missing_agents + (agent_id,)
+        # Shrink the live merge's expected set so re-merges keep
+        # flowing from the survivors (and the dead agent's stale
+        # last state is dropped, not frozen into the view forever).
+        self.bus.publish(
+            f"agent.{handle.merge_agent}.merge_update",
+            {"qid": qid, "data_agents": list(handle.data_agents)},
+        )
+        self.bus.publish(
+            f"query.{qid}.results",
+            {"stream_degraded": True, "partial": True, "qid": qid,
+             "missing_agents": sorted(handle.missing_agents),
+             "reason": f"data agent {agent_id} {why}"},
+        )
+
+    def _dispatch_with_retry(self, qid: str, dispatches: dict,
+                             trace=None, on_lost=None,
+                             live=None) -> None:
+        """Publish every dispatch in ``dispatches`` ({(aid, kind):
+        (topic, msg)}, in order), then — on a background thread —
+        re-publish any still un-acked with capped exponential backoff +
+        jitter (``dispatch_retries`` × ``dispatch_backoff_ms``). A
+        dispatch that never acks publishes ``query.{qid}.agent_lost``
+        (the forwarder turns it into failover or fail-fast) or, when
+        ``on_lost(aid, kind)`` is given (streaming path), calls that
+        instead. ``live()`` gates the loop; default: the forwarder
+        registration is still active."""
+        from ..config import get_flag
+
+        retries = int(get_flag("dispatch_retries"))
+        base_s = float(get_flag("dispatch_backoff_ms")) / 1e3
+        if live is None:
+            live = lambda: self.forwarder.is_active(qid)  # noqa: E731
+        acked: set = set()
+        all_acked = threading.Event()
+        keys = set(dispatches)
+
+        def on_ack(m):
+            acked.add((m.get("agent"), m.get("ack")))
+            if keys <= acked:
+                all_acked.set()
+
+        ack_sub = self.bus.subscribe(f"query.{qid}.ack", on_ack)
+        for topic, msg in dispatches.values():
+            self.bus.publish(topic, msg)
+
+        def run():
+            rng = random.Random()  # jitter only shapes timing
+            try:
+                for attempt in range(retries + 1):
+                    wait_s = min(
+                        base_s * (2 ** attempt), MAX_DISPATCH_BACKOFF_S
+                    ) * (1.0 + 0.25 * rng.random())
+                    if all_acked.wait(wait_s):
+                        return
+                    if not live():
+                        return  # query already finished/failed
+                    if attempt >= retries:
+                        break
+                    from .observability import default_counter
+
+                    retries_total = default_counter(
+                        "pixie_dispatch_retries_total",
+                        "Un-acked fragment dispatches re-published by "
+                        "the broker",
+                    )
+                    for (aid, kind) in keys - acked:
+                        topic, msg = dispatches[(aid, kind)]
+                        self.bus.publish(topic, msg)
+                        retries_total.inc()
+                        if trace is not None:
+                            with trace.span("dispatch.retry") as sp:
+                                sp.attributes.update({
+                                    "agent": aid, "kind": kind,
+                                    "attempt": attempt + 1,
+                                })
+                for (aid, kind) in sorted(keys - acked):
+                    if on_lost is not None:
+                        on_lost(aid, kind)
+                        continue
+                    self.bus.publish(
+                        f"query.{qid}.agent_lost",
+                        {"agent_lost": aid, "kind": kind, "unacked": True,
+                         "reason": f"{kind} dispatch un-acked after "
+                                   f"{retries} retries"},
+                    )
+            finally:
+                ack_sub.unsubscribe()
+
+        threading.Thread(
+            target=run, name=f"dispatch-{qid}", daemon=True
+        ).start()
+
     def close(self) -> None:
         """Detach the broker from the bus: watchdog subscriptions, the
         served API topics (if serve() ran), and any still-live streams.
@@ -222,6 +588,7 @@ class QueryBroker:
         now_ns: int = 0,
         max_output_rows: int = 10_000,
         mutation_timeout_s: float = 10.0,
+        require_complete: bool | None = None,
     ) -> dict:
         """The VizierService.ExecuteScript flow, end to end.
 
@@ -229,7 +596,43 @@ class QueryBroker:
         tracepoints deploy and the broker waits until their tables are
         schema-ready before compiling the query phase — so a script may
         query the very table its tracepoint creates.
+
+        ``require_complete`` (default: the flag): True fails the query
+        as soon as a data agent is lost; False completes from the
+        survivors with ``partial=True`` + ``missing_agents``.
         """
+        from ..config import get_flag
+
+        if require_complete is None:
+            require_complete = bool(get_flag("require_complete"))
+        trace = self.tracer.begin_query(script=query, kind="distributed")
+        try:
+            result = self._execute_script_inner(
+                query, timeout_s, now_ns, max_output_rows,
+                mutation_timeout_s, require_complete, trace,
+            )
+        except Exception as e:
+            self.tracer.end_query(
+                trace, status="error",
+                error=f"{type(e).__name__}: {e}"[:300],
+            )
+            raise
+        self.tracer.end_query(
+            trace,
+            status="partial" if result.get("partial") else "ok",
+        )
+        return result
+
+    def _execute_script_inner(
+        self,
+        query: str,
+        timeout_s: float,
+        now_ns: int,
+        max_output_rows: int,
+        mutation_timeout_s: float,
+        require_complete: bool,
+        trace,
+    ) -> dict:
         compiler_state = CompilerState(
             schemas=self.tracker.schemas(),
             registry=self.registry,
@@ -275,7 +678,8 @@ class QueryBroker:
                 max_output_rows=max_output_rows,
             )
         state = self.tracker.distributed_state()  # fresh per query
-        compiled = compile_pxl(query, compiler_state)
+        with trace.span("compile"):
+            compiled = compile_pxl(query, compiler_state)
         if mutations and not compiled.outputs and not compiled.n_exports:
             return {
                 "mutations": mutation_states,
@@ -293,21 +697,30 @@ class QueryBroker:
         if not dplan.kelvin_agent_ids:
             raise QueryError("no live agent available to run the query")
         merge_agent = dplan.kelvin_agent_ids[0]
-        self.forwarder.register_query(qid, len(data_agents))
+        self.forwarder.register_query(
+            qid, data_agents, merge_agent=merge_agent,
+            require_complete=require_complete, trace=trace,
+        )
 
         # LaunchQuery: merge fragment first (so the router can accept
-        # early bridge chunks), then the per-agent data fragments.
-        self.bus.publish(
-            f"agent.{merge_agent}.merge",
-            {
-                "qid": qid,
-                "plan": dplan.merge_plan,
-                "bridge_ids": [b.bridge_id for b in dplan.split.bridges],
-                "data_agents": data_agents,
-            },
-        )
+        # early bridge chunks), then the per-agent data fragments —
+        # every dispatch acked on receipt and retried with backoff
+        # before the agent is declared lost.
+        dispatches: dict = {
+            (merge_agent, "merge"): (
+                f"agent.{merge_agent}.merge",
+                {
+                    "qid": qid,
+                    "plan": dplan.merge_plan,
+                    "bridge_ids": [
+                        b.bridge_id for b in dplan.split.bridges
+                    ],
+                    "data_agents": data_agents,
+                },
+            ),
+        }
         for aid in data_agents:
-            self.bus.publish(
+            dispatches[(aid, "execute")] = (
                 f"agent.{aid}.execute",
                 {
                     "qid": qid,
@@ -315,6 +728,12 @@ class QueryBroker:
                     "merge_agent": merge_agent,
                 },
             )
+        with trace.span("dispatch") as sp:
+            sp.attributes.update({
+                "data_agents": ",".join(data_agents),
+                "merge_agent": merge_agent,
+            })
+            self._dispatch_with_retry(qid, dispatches, trace=trace)
         result = self.forwarder.wait(qid, timeout_s)
         result["qid"] = qid
         result["distributed_plan"] = dplan
@@ -328,6 +747,7 @@ class QueryBroker:
         on_update,
         poll_interval_s: float = 0.25,
         now_ns: int = 0,
+        require_complete: bool | None = None,
     ) -> "StreamHandle":
         """Live ExecuteScript (StreamResults analog,
         ``query_result_forwarder.go:470``): dispatch streaming fragments
@@ -336,8 +756,15 @@ class QueryBroker:
 
         ``on_update`` receives dicts {table, batch, seq, mode, agent}
         where mode is "append" (new rows) or "replace" (full updated
-        aggregate). Errors arrive as {error}.
+        aggregate). Errors arrive as {error}. When a data agent dies
+        mid-stream the view degrades to the survivors and a
+        {stream_degraded, partial, missing_agents} update is delivered
+        (unless ``require_complete``, which aborts with {error}).
         """
+        from ..config import get_flag
+
+        if require_complete is None:
+            require_complete = bool(get_flag("require_complete"))
         compiler_state = CompilerState(
             schemas=self.tracker.schemas(),
             registry=self.registry,
@@ -373,7 +800,8 @@ class QueryBroker:
 
         sub = self.bus.subscribe(f"query.{qid}.results", _relay)
         handle = StreamHandle(qid, self, sub, merge_agent=merge_agent,
-                              data_agents=data_agents)
+                              data_agents=data_agents,
+                              require_complete=require_complete)
         cell["handle"] = handle
         self._live_streams[qid] = handle
         # Close the planning window: if the merge agent expired between
@@ -383,17 +811,21 @@ class QueryBroker:
         if not self.tracker.has_agent(merge_agent):
             self._abort_streams_of(merge_agent, "expired during planning")
             return handle
-        self.bus.publish(
-            f"agent.{merge_agent}.stream_merge",
-            {
-                "qid": qid,
-                "plan": dplan.merge_plan,
-                "bridge_ids": [b.bridge_id for b in dplan.split.bridges],
-                "data_agents": data_agents,
-            },
-        )
+        dispatches: dict = {
+            (merge_agent, "stream_merge"): (
+                f"agent.{merge_agent}.stream_merge",
+                {
+                    "qid": qid,
+                    "plan": dplan.merge_plan,
+                    "bridge_ids": [
+                        b.bridge_id for b in dplan.split.bridges
+                    ],
+                    "data_agents": data_agents,
+                },
+            ),
+        }
         for aid in data_agents:
-            self.bus.publish(
+            dispatches[(aid, "stream_execute")] = (
                 f"agent.{aid}.stream_execute",
                 {
                     "qid": qid,
@@ -402,6 +834,39 @@ class QueryBroker:
                     "poll_interval_s": poll_interval_s,
                 },
             )
+
+        def _stream_dispatch_lost(aid, kind):
+            # Scoped to THIS qid: the verdict only says this query's
+            # dispatch went missing — other live streams on the same
+            # agent are demonstrably fine (they acked theirs).
+            why = f"unreachable ({kind} dispatch un-acked)"
+            if kind == "stream_merge":
+                # No merge installed = the stream can never produce:
+                # abort loudly rather than degrade.
+                h = self._live_streams.pop(qid, None)
+                if h is None:
+                    return
+                self.bus.publish(
+                    f"query.{qid}.results",
+                    {"error": f"merge agent {aid} {why}; live query "
+                              f"{qid} aborted"},
+                )
+                h.cancel()
+            else:
+                self._degrade_one_stream(qid, aid, why)
+
+        self._dispatch_with_retry(
+            qid, dispatches, on_lost=_stream_dispatch_lost,
+            live=lambda: qid in self._live_streams,
+        )
+        # Close the DATA-agent planning window symmetrically: an agent
+        # that expired between the tracker snapshot and the stream
+        # registration fired its one-shot expiry event before we could
+        # hear it — degrade (or abort) now instead of leaving the live
+        # merge waiting on a dead agent's states forever.
+        for aid in list(handle.data_agents):
+            if not self.tracker.has_agent(aid):
+                self._degrade_streams_of(aid, "expired during planning")
         return handle
 
     # -- bus API (the VizierService gRPC surface analog) ---------------------
@@ -453,17 +918,21 @@ class QueryBroker:
 
         def _on_execute(msg):
             try:
+                rc = msg.get("require_complete")
                 res = self.execute_script(
                     msg["query"],
                     timeout_s=float(msg.get("timeout_s", 30.0)),
                     now_ns=int(msg.get("now_ns", 0)),
                     max_output_rows=int(msg.get("max_output_rows", 10_000)),
+                    require_complete=None if rc is None else bool(rc),
                 )
                 _reply(msg, {
                     "ok": True,
                     "qid": res.get("qid"),
                     "tables": res.get("tables", {}),
                     "agent_stats": res.get("agent_stats", {}),
+                    "partial": res.get("partial", False),
+                    "missing_agents": res.get("missing_agents", []),
                     "mutations": res.get("mutations"),
                 })
             except Exception as e:  # errors cross the wire as data
@@ -487,12 +956,14 @@ class QueryBroker:
                         if h is not None:
                             h.cancel()
 
+                rc = msg.get("require_complete")
                 handle_box: dict = {}
                 handle = self.execute_script_streaming(
                     msg["query"],
                     on_update=_push,
                     poll_interval_s=float(msg.get("poll_interval_s", 0.25)),
                     now_ns=int(msg.get("now_ns", 0)),
+                    require_complete=None if rc is None else bool(rc),
                 )
                 handle_box["qid"] = handle.qid
                 _reply(msg, {"ok": True, "qid": handle.qid})

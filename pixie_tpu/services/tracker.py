@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 from ..planner.distributed import AgentInfo, DistributedState
 from .msgbus import MessageBus
@@ -20,9 +21,15 @@ from .msgbus import MessageBus
 TOPIC_REGISTER = "agent.register"
 TOPIC_HEARTBEAT = "agent.heartbeat"
 TOPIC_EXPIRED = "agent.expired"
+TOPIC_QUARANTINED = "agent.quarantined"
 
 DEFAULT_EXPIRY_S = 60.0
 DEFAULT_CHECK_INTERVAL_S = 5.0
+
+#: Bound on per-agent flap-history entries kept by the tracker: with
+#: ephemeral agent ids (pod-suffixed names churning for weeks) the
+#: bookkeeping must not grow without limit.
+MAX_FLAP_TRACKED = 1024
 
 
 class _Record:
@@ -38,10 +45,34 @@ class AgentTracker:
         bus: MessageBus,
         expiry_s: float = DEFAULT_EXPIRY_S,
         check_interval_s: float = DEFAULT_CHECK_INTERVAL_S,
+        flap_threshold: int | None = None,
+        flap_window_s: float | None = None,
+        quarantine_s: float | None = None,
     ):
+        from ..config import get_flag
+
         self.bus = bus
         self.expiry_s = expiry_s
         self.check_interval_s = check_interval_s
+        # Flap detection: an agent expiring `flap_threshold` times within
+        # `flap_window_s` is quarantined out of distributed_state()
+        # planning for `quarantine_s` — it may re-register and heartbeat
+        # (schemas stay visible) but no new queries are scheduled to it
+        # until the cooldown passes.
+        self.flap_threshold = (
+            int(get_flag("agent_flap_threshold"))
+            if flap_threshold is None else int(flap_threshold)
+        )
+        self.flap_window_s = (
+            float(get_flag("agent_flap_window_s"))
+            if flap_window_s is None else float(flap_window_s)
+        )
+        self.quarantine_s = (
+            float(get_flag("agent_quarantine_s"))
+            if quarantine_s is None else float(quarantine_s)
+        )
+        self._expiry_history: dict[str, deque] = {}
+        self._quarantine_until: dict[str, float] = {}  # aid -> monotonic
         self._lock = threading.Lock()
         self._agents: dict[str, _Record] = {}
         self._next_asid = 1
@@ -109,6 +140,9 @@ class AgentTracker:
                     ),
                     "last_heartbeat_s": now - rec.last_heartbeat,
                     "num_tables": len(rec.schemas),
+                    "quarantined": (
+                        self._quarantine_until.get(aid, 0.0) > now
+                    ),
                 }
                 for aid, rec in sorted(self._agents.items())
             ]
@@ -132,13 +166,106 @@ class AgentTracker:
                     del self._agents[aid]
                     expired.append(aid)
         for aid in expired:
-            self.bus.publish(TOPIC_EXPIRED, {"agent_id": aid})
+            self._publish_expiry(aid, "expired (silent)")
         return expired
+
+    def force_expire(self, agent_id: str, reason: str = "killed") -> bool:
+        """Expire ``agent_id`` NOW, without waiting out the silence
+        window — the deterministic failure-detection path used by fault
+        injection and by operators reaping a known-dead node. Returns
+        True when the agent was registered."""
+        with self._lock:
+            existed = self._agents.pop(agent_id, None) is not None
+        if existed:
+            self._publish_expiry(agent_id, reason)
+        return existed
+
+    def _publish_expiry(self, agent_id: str, reason: str) -> None:
+        """Flap bookkeeping + the ``agent.expired`` event every query
+        subscriber (broker, forwarder) keys failover on."""
+        now = time.monotonic()
+        quarantined = False
+        with self._lock:
+            hist = self._expiry_history.setdefault(agent_id, deque())
+            hist.append(now)
+            while hist and now - hist[0] > self.flap_window_s:
+                hist.popleft()
+            if (
+                len(hist) >= self.flap_threshold
+                and self._quarantine_until.get(agent_id, 0.0) <= now
+            ):
+                self._quarantine_until[agent_id] = now + self.quarantine_s
+                quarantined = True
+            # Bound the bookkeeping: drop histories whose window has
+            # fully lapsed (agents that died and never came back) and
+            # lapsed quarantines — insertion order approximates LRU for
+            # any overflow beyond that.
+            if len(self._expiry_history) > MAX_FLAP_TRACKED:
+                for aid, h in list(self._expiry_history.items()):
+                    if aid == agent_id:
+                        continue
+                    if not h or now - h[-1] > self.flap_window_s:
+                        del self._expiry_history[aid]
+                    if len(self._expiry_history) <= MAX_FLAP_TRACKED:
+                        break
+                while len(self._expiry_history) > MAX_FLAP_TRACKED:
+                    self._expiry_history.pop(
+                        next(iter(self._expiry_history))
+                    )
+            for aid, until in list(self._quarantine_until.items()):
+                if until <= now:
+                    del self._quarantine_until[aid]
+        self.bus.publish(TOPIC_EXPIRED, {"agent_id": agent_id,
+                                         "reason": reason})
+        if quarantined:
+            self._count_quarantine(agent_id)
+            self.bus.publish(
+                TOPIC_QUARANTINED,
+                {"agent_id": agent_id, "cooldown_s": self.quarantine_s},
+            )
+
+    def _count_quarantine(self, agent_id: str) -> None:
+        from .observability import default_counter
+
+        # Deliberately unlabeled: ephemeral agent ids would be an
+        # unbounded label cardinality on a long-lived broker. The
+        # WHICH is on the agent.quarantined event + /statusz.
+        default_counter(
+            "pixie_agent_quarantined_total",
+            "Flapping agents quarantined out of query planning",
+        ).inc()
+
+    # -- quarantine ----------------------------------------------------------
+    def is_quarantined(self, agent_id: str) -> bool:
+        with self._lock:
+            return self._quarantine_until.get(agent_id, 0.0) > time.monotonic()
+
+    def quarantined(self) -> dict[str, float]:
+        """{agent_id: cooldown remaining (s)} for active quarantines;
+        lapsed entries are dropped."""
+        now = time.monotonic()
+        with self._lock:
+            for aid, until in list(self._quarantine_until.items()):
+                if until <= now:
+                    del self._quarantine_until[aid]
+            return {
+                aid: round(until - now, 3)
+                for aid, until in self._quarantine_until.items()
+            }
 
     # -- queries -------------------------------------------------------------
     def distributed_state(self) -> DistributedState:
+        now = time.monotonic()
         with self._lock:
-            return DistributedState(agents=[r.info for r in self._agents.values()])
+            agents, quarantined = [], []
+            for aid, rec in self._agents.items():
+                if self._quarantine_until.get(aid, 0.0) > now:
+                    quarantined.append(aid)
+                else:
+                    agents.append(rec.info)
+            return DistributedState(
+                agents=agents, quarantined=sorted(quarantined)
+            )
 
     def schemas(self) -> dict:
         """Union of table schemas across live agents."""

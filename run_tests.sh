@@ -8,11 +8,27 @@
 #   ./run_tests.sh [pytest args...]    plain pytest passthrough
 #   ./run_tests.sh --fast [args...]    skip slow + stress markers
 #   ./run_tests.sh --tier1             the ROADMAP.md tier-1 command verbatim
+#   ./run_tests.sh --faults [args...]  deterministic fault-injection suite
+#                                      across a fixed seed matrix
+#                                      (PIXIE_TPU_FAULT_SEED; see
+#                                      tests/test_fault_injection.py and
+#                                      docs/RESILIENCE.md)
 #   ./run_tests.sh --lint-metrics      metrics-name lint only (fast gate:
 #                                      every registered metric must match
 #                                      ^pixie_[a-z0-9_]+$ / valid Prometheus
 #                                      naming; see tests/test_metrics_lint.py)
 case "$1" in
+  --faults)
+    shift
+    rc=0
+    for seed in 0 7 1337; do
+      echo "== fault-injection suite, seed $seed =="
+      env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        PIXIE_TPU_FAULT_SEED=$seed \
+        python -m pytest -q tests/test_fault_injection.py "$@" || rc=$?
+    done
+    exit $rc
+    ;;
   --lint-metrics)
     shift
     exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
