@@ -1,0 +1,35 @@
+"""Static analysis: plan-time verification + source lint framework.
+
+Two subsystems, one goal — fail fast on plan bugs and concurrency/JAX
+hazards *before* a fragment blows up on-device mid-query:
+
+- ``verifier``: an always-on pass between ``planner/compiler.py`` and
+  ``exec/engine.py`` that walks compiled logical and distributed plans
+  doing schema propagation, column binding, dtype checking of every
+  expression against ``udf/registry.py`` signatures, and
+  distributed-plan invariants. Diagnostics carry plan-node provenance
+  (node id + operator) instead of a device-side shape error.
+- ``lint``: a reusable AST-rule engine (driven by ``tools/pxlint.py``)
+  with JAX- and concurrency-aware rules over the source tree.
+
+See docs/ANALYSIS.md for the rule catalog, suppression syntax, and the
+baseline workflow.
+"""
+
+from .diagnostics import Diagnostic, PlanCheckError, Severity
+from .verifier import (
+    check_plan,
+    verify_dispatch_sets,
+    verify_distributed_plan,
+    verify_plan,
+)
+
+__all__ = [
+    "Diagnostic",
+    "PlanCheckError",
+    "Severity",
+    "check_plan",
+    "verify_dispatch_sets",
+    "verify_distributed_plan",
+    "verify_plan",
+]

@@ -1,0 +1,165 @@
+"""Plan verification over the six bench shapes (``run_tests.sh
+--analyze``).
+
+Compiles every bench shape's query (the same shipped library scripts
+``bench.py`` runs) against the bench replay schemas, with the always-on
+plan verifier active, then splits each through the DistributedPlanner
+(2 PEMs + 1 Kelvin) and runs the full distributed schema walk. Any
+diagnostic is a regression: these six plans are the repo's
+performance-critical shapes and must stay statically clean.
+
+Also reports verifier overhead relative to compile time — the pass
+rides inside the ``compile`` span, budgeted at <5% of its p50
+(ISSUE 7 acceptance; ``bench.py`` measures the span itself).
+
+Schemas mirror the replay builders in ``bench.py`` (``_http_replay``,
+``_shape_net_flow_graph``, ``_shape_sql_stats``,
+``_shape_perf_flamegraph``, ``_shape_device_join``); a column drift
+there will fail here with an unbound-column diagnostic, which is the
+point.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from ..types.dtypes import DataType
+from ..types.relation import Relation
+
+T, I, F, S = (
+    DataType.TIME64NS, DataType.INT64, DataType.FLOAT64, DataType.STRING,
+)
+
+#: shape -> (tables, query source loader). Queries load lazily so a
+#: missing script surfaces as THIS shape's failure, not an import error.
+SHAPE_SCHEMAS = {
+    "http_stats": {
+        "http_events": Relation([
+            ("time_", T), ("latency_ns", I), ("resp_status", I),
+            ("service", S), ("req_path", S),
+        ]),
+    },
+    "service_stats": {
+        "http_events": Relation([
+            ("time_", T), ("latency_ns", I), ("resp_status", I),
+            ("service", S), ("req_path", S),
+        ]),
+    },
+    "net_flow_graph": {
+        "conn_stats": Relation([
+            ("time_", T), ("src_addr", S), ("src_pod", S),
+            ("remote_addr", S), ("bytes_sent", I), ("bytes_recv", I),
+        ]),
+    },
+    "sql_stats": {
+        "mysql_events": Relation([
+            ("time_", T), ("query_str", S), ("latency_ns", I),
+        ]),
+    },
+    "perf_flamegraph": {
+        "stack_traces.beta": Relation([
+            ("time_", T), ("stack_trace", S), ("count", I),
+        ]),
+    },
+    "device_join": {
+        "conn_l": Relation([("time_", T), ("k", I), ("b", I)]),
+        "conn_r": Relation([("time_", T), ("k", I), ("v", I)]),
+    },
+}
+
+# bench.py's _shape_device_join query, verbatim (the one shape whose
+# query is inline rather than a shipped script).
+_DEVICE_JOIN_QUERY = """
+import px
+l = px.DataFrame(table='conn_l')
+r = px.DataFrame(table='conn_r')
+g = l.merge(r, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+out = g.groupby('b').agg(n=('v', px.count), s=('v', px.sum))
+px.display(out)
+"""
+
+
+def _shape_query(shape: str) -> str:
+    if shape == "device_join":
+        return _DEVICE_JOIN_QUERY
+    from ..scripts import load_script
+
+    return load_script(f"px/{shape}").pxl
+
+
+def check_bench_shapes(verbose: bool = True) -> int:
+    """Compile + verify all six shapes; returns the number of failing
+    shapes (0 = green)."""
+    from ..planner import CompilerState, compile_pxl
+    from ..planner.distributed import DistributedPlanner
+    from ..planner.distributed.distributed_state import DistributedState
+    from ..udf.registry import default_registry
+    from .diagnostics import PlanCheckError, Severity
+    from .verifier import verify_distributed_plan, verify_plan
+
+    registry = default_registry()
+    dstate = DistributedState.homogeneous(2, 1)
+    failures = 0
+    compile_total = verify_total = 0.0
+    for shape, schemas in SHAPE_SCHEMAS.items():
+        state = CompilerState(schemas=dict(schemas), registry=registry)
+        try:
+            t0 = time.perf_counter()
+            compiled = compile_pxl(_shape_query(shape), state)
+            t1 = time.perf_counter()
+            # Re-run the verifier standalone to time it (inside
+            # compile_pxl it already ran once, included in t1-t0).
+            diags = verify_plan(compiled.plan, schemas, registry)
+            dplan = DistributedPlanner(registry).plan(
+                compiled.plan, dstate
+            )
+            diags += verify_distributed_plan(dplan, schemas, registry)
+            t2 = time.perf_counter()
+        except PlanCheckError as e:
+            failures += 1
+            if verbose:
+                print(f"[analyze] {shape}: FAIL\n{e}", file=sys.stderr)
+            continue
+        compile_total += t1 - t0
+        verify_total += t2 - t1
+        errors = [d for d in diags if d.severity == Severity.ERROR]
+        if errors:
+            failures += 1
+            if verbose:
+                print(f"[analyze] {shape}: FAIL", file=sys.stderr)
+                for d in errors:
+                    print(f"  {d.render()}", file=sys.stderr)
+        elif verbose:
+            print(
+                f"[analyze] {shape}: ok "
+                f"({len(compiled.plan.nodes)} logical nodes, "
+                f"{len(dplan.split.before_blocking.nodes)}+"
+                f"{len(dplan.split.after_blocking.nodes)} split)",
+                file=sys.stderr,
+            )
+    if verbose and compile_total > 0:
+        # verify_total counts a FULL standalone re-verify + the whole
+        # distributed split+walk; the in-compile incremental cost is
+        # smaller still.
+        print(
+            f"[analyze] compile {compile_total * 1e3:.1f}ms, "
+            f"standalone verify+split {verify_total * 1e3:.1f}ms "
+            f"({verify_total / compile_total:.1%} of compile)",
+            file=sys.stderr,
+        )
+    return failures
+
+
+def main() -> int:
+    failures = check_bench_shapes()
+    if failures:
+        print(f"[analyze] {failures} bench shape(s) failed verification",
+              file=sys.stderr)
+        return 1
+    print("[analyze] all six bench shapes verify clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,789 @@
+"""pxlint: a reusable AST-rule engine with JAX/concurrency-aware rules.
+
+One lint framework for the tree (``tools/pxlint.py`` drives it; the
+metrics-name gate of ``run_tests.sh --lint-metrics`` is a rule here
+too). Rules are pure AST visitors — no imports of the linted modules,
+so linting never executes device code.
+
+Rules:
+
+- ``host-sync-hot-path``: no ``block_until_ready`` / ``.item()`` /
+  ``np.asarray`` / ``jax.device_get`` inside registered hot regions
+  (the per-window execution path). A host sync per window serializes
+  the pipelined executor (docs/EXECUTOR.md) and on the TPU tunnel
+  costs a full round trip per call. Hot regions are *registered* by
+  the modules that own them via a module-level
+  ``PXLINT_HOT_REGIONS = ("path-suffix:qualname-glob", ...)``
+  assignment (``exec/pipeline.py`` registers the window path).
+- ``jit-recompile-hazard``: a Python ``if``/``while`` on a traced
+  argument inside a ``@jax.jit`` function — every distinct runtime
+  value forces a retrace+recompile (closure constants and shape/dtype
+  attributes are static and stay allowed).
+- ``thread-shared-state``: an attribute mutated both from a thread
+  context (``Thread(target=...)`` entry methods and bus
+  ``subscribe`` callbacks, transitively through same-class calls) and
+  from a public method, with at least one side not holding a lock.
+- ``metrics-naming``: metric names registered via
+  ``.counter/.gauge/.histogram`` must match ``^pixie_[a-z0-9_]+$``
+  and must not end in a Prometheus histogram-series suffix.
+
+Suppression: append ``# pxlint: disable=<rule>[,<rule>...]`` to the
+offending line (or the line directly above). Known-legacy findings live
+in ``pixie_tpu/analysis/baseline.json``; see docs/ANALYSIS.md for the
+baseline workflow.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+_SUPPRESS_RE = re.compile(r"#\s*pxlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_HOT_REGION_ATTR = "PXLINT_HOT_REGIONS"
+# Metric-name policy — the single source for both the static rule here
+# and the dynamic registration checks in tests/test_metrics_lint.py.
+METRIC_RE = re.compile(r"^pixie_[a-z0-9_]+$")
+RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    symbol: str  # enclosing qualname ("<module>" at top level)
+
+    def key(self) -> tuple:
+        """Baseline identity: line numbers drift, these don't."""
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message} " \
+               f"[{self.symbol}]"
+
+
+class FileCtx:
+    """One parsed file: AST with parent/qualname info + suppressions."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.suppress: dict[int, set] = {}
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.suppress[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+        self._qual: dict[int, str] = {}  # id(node) -> qualname
+        self._annotate(self.tree, [])
+
+    def _annotate(self, node, stack):
+        for child in ast.iter_child_nodes(node):
+            self._qual[id(child)] = ".".join(stack) or "<module>"
+            named = isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            )
+            if named:
+                stack.append(child.name)
+            self._annotate(child, stack)
+            if named:
+                stack.pop()
+
+    def qualname(self, node) -> str:
+        """Qualname of the scope CONTAINING node (for a def node, its
+        own dotted name)."""
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            outer = self._qual.get(id(node), "<module>")
+            return node.name if outer == "<module>" else \
+                f"{outer}.{node.name}"
+        return self._qual.get(id(node), "<module>")
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            rules = self.suppress.get(ln)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+
+#: Modules known to register hot regions, parsed even when the lint
+#: path set does not include them (linting a single edited file must
+#: not silently turn the host-sync rule into a no-op).
+_KNOWN_REGISTRARS = ("pixie_tpu/exec/pipeline.py",)
+
+
+def _hot_regions(ctxs, repo_root=None) -> list[tuple[str, str]]:
+    """Collect (path-suffix, qualname-glob) hot-region registrations
+    from every scanned module's ``PXLINT_HOT_REGIONS`` assignment,
+    plus the known registrar modules under ``repo_root``."""
+    ctxs = list(ctxs)
+    scanned = {ctx.relpath for ctx in ctxs}
+    if repo_root:
+        for rel in _KNOWN_REGISTRARS:
+            if rel in scanned:
+                continue
+            path = os.path.join(repo_root, rel)
+            try:
+                with open(path) as f:
+                    ctxs.append(FileCtx(path, rel, f.read()))
+            except (OSError, SyntaxError, UnicodeDecodeError):
+                continue
+    regions: list[tuple[str, str]] = []
+    for ctx in ctxs:
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == _HOT_REGION_ATTR
+                for t in node.targets
+            ):
+                continue
+            try:
+                entries = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                continue
+            for e in entries:
+                if isinstance(e, str) and ":" in e:
+                    suffix, glob = e.split(":", 1)
+                    regions.append((suffix, glob))
+    return regions
+
+
+# -- rule: host-sync-hot-path -------------------------------------------------
+
+class HostSyncHotPathRule:
+    name = "host-sync-hot-path"
+    description = (
+        "no block_until_ready/.item()/np.asarray/jax.device_get inside "
+        "registered hot regions (PXLINT_HOT_REGIONS)"
+    )
+
+    def __init__(self):
+        self.regions: list[tuple[str, str]] = []
+
+    def prepare(self, ctxs, repo_root=None):
+        self.regions = _hot_regions(ctxs, repo_root)
+
+    def _hot_globs(self, relpath: str) -> list[str]:
+        # Anchored at a path-component boundary: "somexec/engine.py"
+        # must not match the "exec/engine.py" registration.
+        return [
+            g for suffix, g in self.regions
+            if relpath == suffix or relpath.endswith("/" + suffix)
+        ]
+
+    def check(self, ctx: FileCtx):
+        globs = self._hot_globs(ctx.relpath)
+        if not globs:
+            return
+        scanned: list[str] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qn = ctx.qualname(node)
+            if not any(fnmatch.fnmatch(qn, g) for g in globs):
+                continue
+            # A nested def inside an already-scanned hot function was
+            # covered by the enclosing scan (ast.walk descends into
+            # nested bodies) — scanning it again would double-report.
+            if any(qn.startswith(outer + ".") for outer in scanned):
+                continue
+            scanned.append(qn)
+            yield from self._check_fn(ctx, node, qn)
+
+    def _check_fn(self, ctx, fn, qn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            msg = None
+            if isinstance(f, ast.Attribute):
+                if f.attr == "block_until_ready":
+                    msg = "block_until_ready() forces a device sync"
+                elif f.attr == "item" and not node.args:
+                    msg = ".item() forces a device-to-host readback"
+                elif (
+                    f.attr == "asarray"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy", "onp")
+                ):
+                    msg = ("np.asarray() on a device value forces a "
+                           "host readback")
+                elif (
+                    f.attr == "device_get"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "jax"
+                ):
+                    msg = "jax.device_get() forces a host readback"
+            if msg:
+                yield Finding(
+                    rule=self.name,
+                    path=ctx.relpath,
+                    line=node.lineno,
+                    message=f"{msg} inside hot region",
+                    symbol=qn,
+                )
+
+
+# -- rule: jit-recompile-hazard -----------------------------------------------
+
+_SAFE_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+_SAFE_CALLS = frozenset({"len", "isinstance", "type"})
+
+
+def _is_jit_decorator(dec) -> bool:
+    """@jax.jit / @jit / @partial(jax.jit, ...) / @functools.partial(jit)."""
+
+    def is_jit_name(n):
+        return (isinstance(n, ast.Name) and n.id == "jit") or (
+            isinstance(n, ast.Attribute) and n.attr == "jit"
+        )
+
+    if is_jit_name(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if is_jit_name(dec.func):
+            return True
+        f = dec.func
+        if (
+            (isinstance(f, ast.Name) and f.id == "partial")
+            or (isinstance(f, ast.Attribute) and f.attr == "partial")
+        ) and dec.args:
+            return is_jit_name(dec.args[0])
+    return False
+
+
+def _traced_name_refs(expr, params: set) -> list:
+    """Param Name nodes referenced in ``expr`` outside static contexts
+    (len/isinstance calls, shape/ndim/dtype/size attributes)."""
+    hits: list = []
+
+    def walk(e):
+        if isinstance(e, ast.Call):
+            f = e.func
+            if isinstance(f, ast.Name) and f.id in _SAFE_CALLS:
+                return
+        if isinstance(e, ast.Attribute) and e.attr in _SAFE_ATTRS:
+            return
+        if isinstance(e, ast.Name) and e.id in params:
+            hits.append(e)
+            return
+        for child in ast.iter_child_nodes(e):
+            walk(child)
+
+    walk(expr)
+    return hits
+
+
+class JitRecompileHazardRule:
+    name = "jit-recompile-hazard"
+    description = (
+        "python if/while on a traced argument inside a @jax.jit "
+        "function recompiles per distinct value"
+    )
+
+    def prepare(self, ctxs, repo_root=None):
+        pass
+
+    def check(self, ctx: FileCtx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(_is_jit_decorator(d) for d in node.decorator_list):
+                continue
+            params = {
+                a.arg
+                for a in (
+                    node.args.posonlyargs + node.args.args
+                    + node.args.kwonlyargs
+                )
+                if a.arg != "self"
+            }
+            qn = ctx.qualname(node)
+            for inner in ast.walk(node):
+                if isinstance(inner, (ast.If, ast.While)):
+                    for ref in _traced_name_refs(inner.test, params):
+                        yield Finding(
+                            rule=self.name,
+                            path=ctx.relpath,
+                            line=inner.lineno,
+                            message=(
+                                f"python branch on traced argument "
+                                f"{ref.id!r} in jitted function — each "
+                                "distinct value retraces and recompiles"
+                            ),
+                            symbol=qn,
+                        )
+
+
+# -- rule: thread-shared-state ------------------------------------------------
+
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"})
+
+#: Method calls that mutate their receiver in place (self.x.append(...)
+#: is a write to self.x just as much as self.x = ... is).
+_MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "remove", "discard", "clear",
+})
+
+
+@dataclass
+class _AttrWrite:
+    attr: str
+    line: int
+    locked: bool
+    method: str
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    qualname: str
+    methods: dict = field(default_factory=dict)  # name -> FunctionDef
+    lock_attrs: set = field(default_factory=set)
+    thread_entries: set = field(default_factory=set)  # method names
+    # method -> nested defs used as thread targets/callbacks
+    nested_thread_bodies: dict = field(default_factory=dict)
+    calls: dict = field(default_factory=dict)  # method -> {self.m called}
+    writes: dict = field(default_factory=dict)  # method -> [_AttrWrite]
+
+
+def _self_attr(node) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class ThreadSharedStateRule:
+    name = "thread-shared-state"
+    description = (
+        "attribute mutated from both a thread context (Thread target / "
+        "bus subscribe callback) and a public method without a lock"
+    )
+
+    def prepare(self, ctxs, repo_root=None):
+        pass
+
+    def check(self, ctx: FileCtx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    # -- per-class analysis ---------------------------------------------------
+    def _check_class(self, ctx: FileCtx, cls: ast.ClassDef):
+        info = _ClassInfo(name=cls.name, qualname=ctx.qualname(cls))
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[item.name] = item
+        # Pass 1: lock attrs from EVERY method, so a lock assigned in a
+        # textually-later method (e.g. __init__ not first in the class
+        # body) still counts when earlier methods' writes are scanned.
+        for fn in info.methods.values():
+            self._collect_lock_attrs(info, fn)
+        for name, fn in info.methods.items():
+            self._scan_method(info, name, fn)
+
+        # Each Thread target / bus subscription runs on its OWN
+        # dispatcher thread (services/msgbus.py Subscription), so two
+        # different entry roots = two concurrent threads. Compute, per
+        # method, which entry roots can reach it through same-class
+        # self.m() calls.
+        method_roots: dict[str, set] = {}
+        for entry in info.thread_entries:
+            seen = {entry}
+            frontier = [entry]
+            while frontier:
+                m = frontier.pop()
+                method_roots.setdefault(m, set()).add(entry)
+                for callee in info.calls.get(m, ()):
+                    if callee in info.methods and callee not in seen:
+                        seen.add(callee)
+                        frontier.append(callee)
+
+        threaded = set(method_roots)
+        public = {
+            m for m in info.methods
+            if not m.startswith("_") and m not in threaded
+        }
+
+        by_attr: dict[str, dict] = {}
+        for m, writes in info.writes.items():
+            side = (
+                "thread" if m in threaded
+                else "public" if m in public
+                else None
+            )
+            if side is None:
+                continue
+            for w in writes:
+                by_attr.setdefault(
+                    w.attr, {"thread": [], "public": []}
+                )[side].append(w)
+
+        for attr, sides in sorted(by_attr.items()):
+            tw, pw = sides["thread"], sides["public"]
+            t_unlocked = [w for w in tw if not w.locked]
+            p_unlocked = [w for w in pw if not w.locked]
+            t_roots = set()
+            for w in tw:
+                t_roots |= method_roots.get(w.method, set())
+            # Hazard 1: written by a thread AND a public (caller-thread)
+            # method, with at least one side not holding a lock.
+            hazard = tw and pw and (t_unlocked or p_unlocked)
+            detail = "thread context and public method"
+            # Hazard 2: unlocked writes reachable from two DIFFERENT
+            # thread entries — two dispatcher threads racing each other.
+            if not hazard and len(t_roots) >= 2 and t_unlocked:
+                hazard = True
+                detail = "two different dispatcher threads"
+            if not hazard:
+                continue
+            t_m = sorted({x.method for x in tw})
+            p_m = sorted({x.method for x in pw})
+            writers = ", ".join(t_m + p_m)
+            # One finding PER unlocked write: suppressing one site (the
+            # engine applies `# pxlint: disable` per line) must not
+            # hide a future unlocked write to the same attribute.
+            for w in t_unlocked + p_unlocked:
+                yield Finding(
+                    rule=self.name,
+                    path=ctx.relpath,
+                    line=w.line,
+                    message=(
+                        f"attribute self.{attr} is written from "
+                        f"{detail} ({writers}) with at least one write "
+                        "not holding a lock"
+                    ),
+                    symbol=f"{info.qualname}.{w.method}",
+                )
+
+    def _collect_lock_attrs(self, info: _ClassInfo, fn) -> None:
+        """Record self.X = threading.Lock()/RLock()/... assignments."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                vf = node.value.func
+                ctor = (
+                    vf.attr if isinstance(vf, ast.Attribute)
+                    else vf.id if isinstance(vf, ast.Name) else None
+                )
+                if ctor in _LOCK_CTORS:
+                    for t in node.targets:
+                        a = _self_attr(t)
+                        if a:
+                            info.lock_attrs.add(a)
+
+    def _scan_method(self, info: _ClassInfo, name: str, fn):
+        writes: list[_AttrWrite] = []
+        calls: set = set()
+        nested_defs = {
+            n.name: n for n in ast.walk(fn)
+            if isinstance(n, ast.FunctionDef) and n is not fn
+        }
+        thread_nested: set = set()
+
+        def register_target(arg):
+            a = _self_attr(arg)
+            if a is not None:
+                info.thread_entries.add(a)
+            elif isinstance(arg, ast.Name) and arg.id in nested_defs:
+                thread_nested.add(arg.id)
+            elif isinstance(arg, ast.Call):
+                # Wrapped handler: subscribe(t, guard(self._on_x)) /
+                # subscribe(t, _guarded(_on_execute)) — the wrapped
+                # callable still runs on the dispatcher thread.
+                for inner in list(arg.args) + [
+                    kw.value for kw in arg.keywords
+                ]:
+                    register_target(inner)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                # threading.Thread(target=...) / Thread(target=...)
+                is_thread = (
+                    isinstance(f, ast.Name) and f.id == "Thread"
+                ) or (isinstance(f, ast.Attribute) and f.attr == "Thread")
+                if is_thread:
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            register_target(kw.value)
+                # bus.subscribe(topic, self._on_x): callbacks run on the
+                # subscription's dispatcher thread (services/msgbus.py)
+                if isinstance(f, ast.Attribute) and f.attr == "subscribe":
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        register_target(arg)
+                # self.m(...) intra-class call graph
+                a = _self_attr(f)
+                if a is not None:
+                    calls.add(a)
+
+        self._collect_writes(info, name, fn, writes, under_lock=False)
+        info.calls[name] = calls
+        info.writes[name] = writes
+        for nd in thread_nested:
+            # Writes inside a nested thread body count as thread-side.
+            nwrites: list = []
+            self._collect_writes(
+                info, name, nested_defs[nd], nwrites, under_lock=False
+            )
+            key = f"{name}.<{nd}>"
+            info.writes[key] = nwrites
+            info.calls[key] = set()
+            info.nested_thread_bodies[key] = nd
+            # the nested body may call self.m too
+            for node in ast.walk(nested_defs[nd]):
+                if isinstance(node, ast.Call):
+                    a = _self_attr(node.func)
+                    if a is not None:
+                        info.calls[key].add(a)
+            info.thread_entries.add(key)
+
+    def _collect_writes(self, info, method, node, out, under_lock):
+        """Record self.X writes, tracking `with self.<lock>:` scopes."""
+        if isinstance(node, ast.With):
+            locked = under_lock or any(
+                _self_attr(item.context_expr) in info.lock_attrs
+                or (
+                    isinstance(item.context_expr, ast.Call)
+                    and _self_attr(item.context_expr.func) in info.lock_attrs
+                )
+                for item in node.items
+            )
+            for child in node.body:
+                self._collect_writes(info, method, child, out, locked)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._note_write(info, method, t, node.lineno, under_lock,
+                                 out)
+        elif isinstance(node, ast.AugAssign):
+            self._note_write(info, method, node.target, node.lineno,
+                             under_lock, out)
+        elif isinstance(node, ast.Call):
+            # Container mutation anywhere (statement or expression):
+            # self.x.append(...) / h = self.x.pop(k, None) / ...
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATOR_METHODS
+            ):
+                self._note_write(info, method, f.value, node.lineno,
+                                 under_lock, out)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs handled separately
+            self._collect_writes(info, method, child, out, under_lock)
+
+    def _note_write(self, info, method, target, line, locked, out):
+        attr = _self_attr(target)
+        # Subscript writes (self.x[k] = v) count against self.x too.
+        if attr is None and isinstance(target, ast.Subscript):
+            attr = _self_attr(target.value)
+        if attr is None or attr in info.lock_attrs:
+            return
+        out.append(_AttrWrite(attr=attr, line=line, locked=locked,
+                              method=method))
+
+
+# -- rule: metrics-naming -----------------------------------------------------
+
+class MetricsNamingRule:
+    name = "metrics-naming"
+    description = (
+        "metric names registered via .counter/.gauge/.histogram must "
+        "match ^pixie_[a-z0-9_]+$ and avoid histogram-series suffixes"
+    )
+
+    _KINDS = frozenset({"counter", "gauge", "histogram"})
+
+    def prepare(self, ctxs, repo_root=None):
+        pass
+
+    def check(self, ctx: FileCtx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr in self._KINDS):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            name = arg.value
+            qn = ctx.qualname(node)
+            if not METRIC_RE.match(name):
+                yield Finding(
+                    rule=self.name,
+                    path=ctx.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"metric name {name!r} violates "
+                        "^pixie_[a-z0-9_]+$"
+                    ),
+                    symbol=qn,
+                )
+            elif f.attr != "histogram" and name.endswith(
+                RESERVED_SUFFIXES
+            ):
+                yield Finding(
+                    rule=self.name,
+                    path=ctx.relpath,
+                    line=node.lineno,
+                    message=(
+                        f"{f.attr} name {name!r} ends in a reserved "
+                        "Prometheus histogram-series suffix"
+                    ),
+                    symbol=qn,
+                )
+
+
+ALL_RULES = (
+    HostSyncHotPathRule,
+    JitRecompileHazardRule,
+    ThreadSharedStateRule,
+    MetricsNamingRule,
+)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> dict:
+    """key -> allowed occurrence count. Counts matter: a key whose
+    occurrences GROW has gained a new violation (same rule, same
+    function, same message) and must fail, not hide behind the old
+    grandfathered finding."""
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        try:
+            data = json.load(f)
+        except json.JSONDecodeError:
+            return {}  # empty/garbage baseline = no baseline
+    out: dict = {}
+    for e in data.get("findings", []):
+        key = (e["rule"], e["path"], e["symbol"], e["message"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def save_baseline(findings, path: str | None = None) -> None:
+    path = path or default_baseline_path()
+    counts: dict = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    with open(path, "w") as fh:
+        json.dump(
+            {
+                "version": 1,
+                "findings": [
+                    {
+                        "rule": r, "path": p, "symbol": s, "message": m,
+                        "count": c,
+                    }
+                    for (r, p, s, m), c in sorted(counts.items())
+                ],
+            },
+            fh,
+            indent=2,
+        )
+        fh.write("\n")
+
+
+@dataclass
+class LintReport:
+    findings: list  # non-suppressed, non-baselined
+    baselined: list
+    suppressed: int
+    files: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [
+                d for d in dirs
+                if d != "__pycache__" and not d.startswith(".")
+            ]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def run_lint(paths, rules=None, baseline_path=None,
+             repo_root=None) -> LintReport:
+    """Lint ``paths`` (files or directories) with ``rules`` (rule name
+    list or None = all), applying inline suppressions and the baseline.
+    """
+    repo_root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    rule_objs = []
+    for cls in ALL_RULES:
+        r = cls()
+        if rules is None or r.name in rules:
+            rule_objs.append(r)
+    ctxs = []
+    for path in _iter_py_files(paths):
+        ap = os.path.abspath(path)
+        rel = os.path.relpath(ap, repo_root)
+        try:
+            with open(ap) as f:
+                src = f.read()
+            ctxs.append(FileCtx(ap, rel, src))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue  # not lintable python (templates, fixtures)
+    for r in rule_objs:
+        r.prepare(ctxs, repo_root)
+    baseline = load_baseline(baseline_path)
+    budget = dict(baseline)  # remaining allowed occurrences per key
+    findings, baselined, suppressed = [], [], 0
+    for ctx in ctxs:
+        for r in rule_objs:
+            for f in r.check(ctx):
+                if ctx.suppressed(f.rule, f.line):
+                    suppressed += 1
+                elif budget.get(f.key(), 0) > 0:
+                    budget[f.key()] -= 1
+                    baselined.append(f)
+                else:
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintReport(
+        findings=findings, baselined=baselined, suppressed=suppressed,
+        files=len(ctxs),
+    )

@@ -564,8 +564,9 @@ class Engine:
             else:
                 state = frag.update_all(
                     state, tuple(pend_cols),
-                    np.asarray(pend_lo, dtype=np.int32),
-                    np.asarray(pend_hi, dtype=np.int32),
+                    # Host int lists, not device buffers — no sync.
+                    np.asarray(pend_lo, dtype=np.int32),  # pxlint: disable=host-sync-hot-path
+                    np.asarray(pend_hi, dtype=np.int32),  # pxlint: disable=host-sync-hot-path
                 )
             pend_cols.clear()
             pend_lo.clear()
@@ -678,6 +679,7 @@ class Engine:
             # produces; the raw fast path handles scalar ops only.
             raw = None
         oob_any = False
+        oob_acc = None  # ONE device scalar, read back ONCE post-loop
         xla_fallback = False  # aborted mid-stream: XLA re-runs the fold
         pipe = self._window_pipeline(stream, stats)
         try:
@@ -726,7 +728,14 @@ class Engine:
                         if not tdigest_hist_call(gids, v, g, hist_shift, w, mw):
                             xla_fallback = True
                             return None
-                    oob_any = oob_any or bool(np.asarray(oob))
+                    # Deferred: a bool() here would force a device sync
+                    # EVERY window, serializing the prefetch pipeline —
+                    # accumulate on device (one scalar, O(1) memory)
+                    # and read back once after the loop.
+                    oob_acc = (
+                        oob if oob_acc is None
+                        else jnp.logical_or(oob_acc, oob)
+                    )
                 if stats is not None:
                     stats.windows += 1
         finally:
@@ -735,6 +744,10 @@ class Engine:
                 # A fallback's windows re-run through the XLA fold's own
                 # pipeline — noting the aborted one would double-count.
                 self._note_pipeline(pipe)
+        if oob_acc is not None:
+            # The one readback for the whole fold (materialization
+            # boundary). # pxlint: disable=host-sync-hot-path
+            oob_any = oob_any or bool(np.asarray(oob_acc))
         carries = {}
         k = 0
         for out_name, treedef, n_leaves in treedefs:

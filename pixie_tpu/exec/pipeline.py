@@ -49,6 +49,22 @@ import time
 #: steady-state hand-offs never hit the timeout.
 _POLL_S = 0.05
 
+#: Hot regions of the per-window execution path, registered for the
+#: ``host-sync-hot-path`` lint (pixie_tpu/analysis/lint.py): a host
+#: sync inside any of these runs once PER WINDOW, serializing the
+#: prefetch overlap this module exists to provide (and costing a full
+#: tunnel round trip per call on TPU). Entries are
+#: "path-suffix:qualname-glob"; the lint engine reads this assignment
+#: statically.
+PXLINT_HOT_REGIONS = (
+    "exec/pipeline.py:WindowPipeline*",
+    "exec/engine.py:Engine._fold_agg_state",
+    "exec/engine.py:Engine._fold_agg_state_native",
+    "exec/engine.py:Engine._staged_windows*",
+    "exec/engine.py:Engine._windows",
+    "exec/engine.py:Engine._stage",
+)
+
 
 class WindowPipeline:
     """Bounded-depth prefetch over a staged-window generator.

@@ -108,6 +108,19 @@ def compile_pxl(query: str, state: CompilerState) -> CompiledScript:
             "call one and display its result)"
         )
     run_rules(builder.plan, state.max_output_rows)
+    # Always-on static verification (see pixie_tpu/analysis): schema
+    # propagation + column/dtype binding + topology invariants over the
+    # rewritten plan, so a bad plan fails HERE with node provenance
+    # instead of as a device-side shape error mid-query. Raises
+    # PlanCheckError (a PxLError) on any error-severity finding; clean
+    # verifications memoize on (script, schemas, registry) — repeat
+    # compiles of one script re-verify for free.
+    from ..analysis.verifier import check_script_plan
+
+    check_script_plan(
+        builder.plan, query, builder.schemas, state.registry,
+        plan_params=(state.max_output_rows, state.max_groups),
+    )
     return CompiledScript(
         plan=builder.plan, outputs=list(builder.sinks), funcs=visitor.funcs,
         mutations=mutations, n_exports=builder.n_exports,

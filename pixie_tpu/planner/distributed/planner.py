@@ -31,6 +31,15 @@ class DistributedPlanner:
         split = self.splitter.split(logical_plan)
         dplan = self.coordinator.assign(split, state)
         self.stitch(dplan, state, mesh=mesh)
+        # Always-on structural verification (pixie_tpu/analysis): bridge
+        # sink/source/spec pairing, no blocking ops in the data
+        # fragment, agg bridges feeding their finalize half — a bad
+        # split fails HERE, not as a hung merge or a device error on an
+        # agent. (The schema walk already ran on the logical plan in
+        # compile_pxl; the broker re-checks dispatch sets per query.)
+        from ...analysis.verifier import check_distributed_plan
+
+        check_distributed_plan(dplan)
         return dplan
 
     def stitch(self, dplan: DistributedPlan, state: DistributedState, mesh=None) -> None:

@@ -537,6 +537,8 @@ class Agent:
         threading.Thread(target=run, daemon=True).start()
 
     def _stream_state(self, qid):
+        # Every caller holds self._lock (the lint is intraprocedural and
+        # cannot see the caller's lock). # pxlint: disable=thread-shared-state
         return self._streaming_merges.setdefault(
             qid,
             {

@@ -200,6 +200,12 @@ class _ClientConn:
         self.server = server
         self.sock = sock
         self._send_lock = threading.Lock()
+        # Guards _subs + _closed: close() can run from any subscription
+        # dispatcher thread (via a _send failure) while the read loop
+        # registers new subscriptions — an unlocked insert racing close
+        # would leak that subscription's dispatcher thread forever.
+        self._subs_lock = threading.Lock()
+        self._closed = False
         self._subs: dict[int, object] = {}  # sid -> Subscription
         self.auth_ctx = None  # AuthContext once authenticated
         self._thread = threading.Thread(
@@ -247,9 +253,18 @@ class _ClientConn:
                     def fwd(msg, _sid=sid, _topic=topic):
                         self._send({"op": "msg", "sid": _sid, "msg": msg})
 
-                    self._subs[sid] = self.server.bus.subscribe(topic, fwd)
+                    sub = self.server.bus.subscribe(topic, fwd)
+                    with self._subs_lock:
+                        if self._closed:
+                            pass  # lost the race; unsubscribe below
+                        else:
+                            self._subs[sid] = sub
+                            sub = None
+                    if sub is not None:
+                        sub.unsubscribe()
                 elif op == "unsub":
-                    sub = self._subs.pop(frame["sid"], None)
+                    with self._subs_lock:
+                        sub = self._subs.pop(frame["sid"], None)
                     if sub is not None:
                         sub.unsubscribe()
         except (ConnectionError, OSError, WireError):
@@ -268,9 +283,12 @@ class _ClientConn:
             self.close()
 
     def close(self) -> None:
-        for sub in list(self._subs.values()):
+        with self._subs_lock:
+            self._closed = True
+            subs = list(self._subs.values())
+            self._subs.clear()
+        for sub in subs:
             sub.unsubscribe()
-        self._subs.clear()
         try:
             self.sock.close()
         except OSError:

@@ -460,6 +460,9 @@ class QueryBroker:
             # Nothing to degrade to (or degradation forbidden): a
             # sourceless live stream would sit silent forever —
             # error it out like a merge-agent death instead.
+            # Caller holds _degrade_lock (both degrade entry points);
+            # the lint is intraprocedural.
+            # pxlint: disable=thread-shared-state
             if self._live_streams.pop(qid, None) is None:
                 return
             cause = (
@@ -488,6 +491,32 @@ class QueryBroker:
              "missing_agents": sorted(handle.missing_agents),
              "reason": f"data agent {agent_id} {why}"},
         )
+
+    def _check_dispatch_sets(self, dplan, dispatches: dict,
+                             merge_agent) -> None:
+        """Static cross-check before any message leaves the broker: the
+        agents the merge fragment will WAIT for must be exactly the
+        agents an execute fragment is SENT to (pixie_tpu/analysis
+        verify_dispatch_sets). An asymmetry is a planner/dispatch bug
+        that would otherwise surface as a query timeout listing agents
+        that were never dispatched — fail at plan time instead."""
+        from ..analysis.verifier import verify_dispatch_sets
+
+        merge_expected: list = []
+        dispatched = []
+        for (aid, kind), (_topic, payload) in dispatches.items():
+            if kind in ("merge", "stream_merge"):
+                merge_expected = payload.get("data_agents", [])
+            else:
+                dispatched.append(aid)
+        diags = verify_dispatch_sets(
+            dplan, merge_expected, dispatched, merge_agent=merge_agent
+        )
+        if diags:
+            raise QueryError(
+                "dispatch verification failed: "
+                + "; ".join(d.render() for d in diags)
+            )
 
     def _dispatch_with_retry(self, qid: str, dispatches: dict,
                              trace=None, on_lost=None,
@@ -573,7 +602,9 @@ class QueryBroker:
         Transient brokers on a shared bus must not keep reacting to
         agent lifecycle events after they're discarded."""
         for qid in list(self._live_streams):
-            handle = self._live_streams.pop(qid, None)
+            # GIL-atomic pop: exactly-once vs a racing aborter, same
+            # protocol as _abort_streams_of (see baseline.json).
+            handle = self._live_streams.pop(qid, None)  # pxlint: disable=thread-shared-state
             if handle is not None:
                 handle.cancel()
         for sub in (self._expiry_sub, self._register_sub):
@@ -697,10 +728,6 @@ class QueryBroker:
         if not dplan.kelvin_agent_ids:
             raise QueryError("no live agent available to run the query")
         merge_agent = dplan.kelvin_agent_ids[0]
-        self.forwarder.register_query(
-            qid, data_agents, merge_agent=merge_agent,
-            require_complete=require_complete, trace=trace,
-        )
 
         # LaunchQuery: merge fragment first (so the router can accept
         # early bridge chunks), then the per-agent data fragments —
@@ -728,6 +755,14 @@ class QueryBroker:
                     "merge_agent": merge_agent,
                 },
             )
+        # Verify BEFORE registering the query: a failing check must not
+        # leak the forwarder's subscriptions/dispatcher threads (they
+        # are only released through wait()'s deregister).
+        self._check_dispatch_sets(dplan, dispatches, merge_agent)
+        self.forwarder.register_query(
+            qid, data_agents, merge_agent=merge_agent,
+            require_complete=require_complete, trace=trace,
+        )
         with trace.span("dispatch") as sp:
             sp.attributes.update({
                 "data_agents": ",".join(data_agents),
@@ -803,7 +838,12 @@ class QueryBroker:
                               data_agents=data_agents,
                               require_complete=require_complete)
         cell["handle"] = handle
-        self._live_streams[qid] = handle
+        # Registered under the degrade lock: an agent-expiry degrade
+        # sweep iterating _live_streams on another dispatcher thread
+        # must either see this stream or run before it exists — an
+        # unlocked insert could land mid-sweep and miss the degrade.
+        with self._degrade_lock:
+            self._live_streams[qid] = handle
         # Close the planning window: if the merge agent expired between
         # the tracker snapshot and this registration, its one-shot
         # expiry event already fired — abort now instead of never (and
@@ -855,6 +895,17 @@ class QueryBroker:
             else:
                 self._degrade_one_stream(qid, aid, why)
 
+        try:
+            self._check_dispatch_sets(dplan, dispatches, merge_agent)
+        except QueryError:
+            # The stream is already registered (the planning-window
+            # close above needs it); a failing check must unwind it or
+            # the phantom stream leaks its results subscription and
+            # stays visible to degrade sweeps forever.
+            with self._degrade_lock:
+                self._live_streams.pop(qid, None)
+            sub.unsubscribe()
+            raise
         self._dispatch_with_retry(
             qid, dispatches, on_lost=_stream_dispatch_lost,
             live=lambda: qid in self._live_streams,
@@ -971,7 +1022,9 @@ class QueryBroker:
                 _reply(msg, {"ok": False, "error": f"{type(e).__name__}: {e}"})
 
         def _on_stream_cancel(msg):
-            handle = self._live_streams.pop(msg.get("qid"), None)
+            # GIL-atomic pop: exactly-once vs a racing aborter, same
+            # protocol as _abort_streams_of (see baseline.json).
+            handle = self._live_streams.pop(msg.get("qid"), None)  # pxlint: disable=thread-shared-state
             if handle is not None:
                 handle.cancel()
             _reply(msg, {"ok": True})

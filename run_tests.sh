@@ -13,11 +13,29 @@
 #                                      (PIXIE_TPU_FAULT_SEED; see
 #                                      tests/test_fault_injection.py and
 #                                      docs/RESILIENCE.md)
-#   ./run_tests.sh --lint-metrics      metrics-name lint only (fast gate:
-#                                      every registered metric must match
-#                                      ^pixie_[a-z0-9_]+$ / valid Prometheus
-#                                      naming; see tests/test_metrics_lint.py)
+#   ./run_tests.sh --lint-metrics      metrics-name lint only: the pxlint
+#                                      metrics-naming rule (static) + the
+#                                      dynamic registration checks in
+#                                      tests/test_metrics_lint.py. Alias of
+#                                      the shared rule engine since the
+#                                      lint framework unification (see
+#                                      docs/ANALYSIS.md).
+#   ./run_tests.sh --analyze           static analysis gate: pxlint over
+#                                      pixie_tpu/ (all rules, baseline
+#                                      applied) + the plan verifier over
+#                                      all six bench shapes' compiled
+#                                      plans. Non-zero exit on any
+#                                      non-baselined finding. Also runs
+#                                      inside --tier1.
 case "$1" in
+  --analyze)
+    shift
+    rc=0
+    python tools/pxlint.py "$@" || rc=$?
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pixie_tpu.analysis.bench_check || rc=$?
+    exit $rc
+    ;;
   --faults)
     shift
     rc=0
@@ -31,8 +49,13 @@ case "$1" in
     ;;
   --lint-metrics)
     shift
-    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-      python -m pytest -q tests/test_metrics_lint.py "$@"
+    rc=0
+    # One lint framework: the static half is the pxlint metrics-naming
+    # rule; the dynamic half exercises the live registration surface.
+    python tools/pxlint.py --rules metrics-naming || rc=$?
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest -q tests/test_metrics_lint.py "$@" || rc=$?
+    exit $rc
     ;;
   --fast)
     shift
@@ -42,8 +65,11 @@ case "$1" in
     ;;
   --tier1)
     export PALLAS_AXON_POOL_IPS=
+    # Static-analysis gate first (fast; see --analyze): a non-baselined
+    # lint finding or a bench-shape verification failure fails tier 1.
+    "$0" --analyze; rc_analyze=$?
     # ROADMAP.md "Tier-1 verify", verbatim:
-    set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+    set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); [ $rc -eq 0 ] && rc=$rc_analyze; exit $rc
     ;;
 esac
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest "$@"

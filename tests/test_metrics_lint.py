@@ -1,10 +1,15 @@
-"""Metrics-name lint (``run_tests.sh --lint-metrics``).
+"""Metrics-name lint, dynamic half (``run_tests.sh --lint-metrics``).
 
 Every metric the engine's collectors and tracer register must follow
 Prometheus naming (``^pixie_[a-z0-9_]+$``, valid label names, known
 kinds) — exposition regressions fail here fast instead of at scrape
 time. Exercises the full registration surface: a query through the
 trace spine, the engine collector, and a render.
+
+The STATIC half of this lint lives in the shared rule engine as the
+pxlint ``metrics-naming`` rule (``pixie_tpu/analysis/lint.py``; gate
+coverage in tests/test_pxlint.py) — ``--lint-metrics`` runs both. See
+docs/ANALYSIS.md.
 """
 
 from __future__ import annotations
@@ -15,15 +20,16 @@ import numpy as np
 
 from pixie_tpu.exec import Engine
 from pixie_tpu.exec.trace import Tracer
+# The naming policy is shared with the static pxlint rule — ONE lint
+# framework, one definition of a valid metric name.
+from pixie_tpu.analysis.lint import METRIC_RE, RESERVED_SUFFIXES
 from pixie_tpu.services.observability import (
     MetricsRegistry,
     engine_collector,
 )
 
-METRIC_RE = re.compile(r"^pixie_[a-z0-9_]+$")
 LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 VALID_KINDS = {"counter", "gauge", "histogram"}
-RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
 def _exercised_registry() -> MetricsRegistry:

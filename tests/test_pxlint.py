@@ -1,0 +1,371 @@
+"""pxlint rule-engine tests: each rule on synthetic sources, the
+suppression + baseline machinery, and the shipped-tree green gate
+(``run_tests.sh --analyze``). See docs/ANALYSIS.md."""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+from pixie_tpu.analysis.lint import (
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_src(tmp_path, name, src, rules=None, extra_files=()):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    for fname, fsrc in extra_files:
+        (tmp_path / fname).parent.mkdir(parents=True, exist_ok=True)
+        (tmp_path / fname).write_text(textwrap.dedent(fsrc))
+    report = run_lint(
+        [str(tmp_path)], rules=rules,
+        baseline_path=str(tmp_path / "no_baseline.json"),
+        repo_root=str(tmp_path),
+    )
+    return report
+
+
+# -- host-sync-hot-path -------------------------------------------------------
+
+_HOT_DECL = """
+    PXLINT_HOT_REGIONS = (
+        "hot_mod.py:Runner._loop*",
+    )
+"""
+
+
+def test_host_sync_rule_flags_registered_regions(tmp_path):
+    report = _lint_src(
+        tmp_path, "hot_mod.py",
+        """
+        import numpy as np
+
+        PXLINT_HOT_REGIONS = (
+            "hot_mod.py:Runner._loop*",
+        )
+
+        class Runner:
+            def _loop(self, xs):
+                for x in xs:
+                    x.block_until_ready()
+                    v = float(x.item())
+                    a = np.asarray(x)
+                return a
+
+            def cold(self, x):
+                return np.asarray(x)  # not a hot region
+        """,
+        rules={"host-sync-hot-path"},
+    )
+    msgs = [f.message for f in report.findings]
+    assert len(msgs) == 3
+    assert any("block_until_ready" in m for m in msgs)
+    assert any(".item()" in m for m in msgs)
+    assert any("np.asarray" in m for m in msgs)
+    assert all(f.symbol == "Runner._loop" for f in report.findings)
+
+
+def test_host_sync_nested_def_reports_once(tmp_path):
+    report = _lint_src(
+        tmp_path, "hot_mod.py",
+        """
+        import numpy as np
+
+        PXLINT_HOT_REGIONS = (
+            "hot_mod.py:Runner._loop*",
+        )
+
+        class Runner:
+            def _loop(self, xs):
+                def stage(x):
+                    return np.asarray(x)  # one violation, one finding
+                return [stage(x) for x in xs]
+        """,
+        rules={"host-sync-hot-path"},
+    )
+    assert len(report.findings) == 1
+    assert report.findings[0].symbol == "Runner._loop"
+
+
+def test_host_sync_registration_is_cross_module(tmp_path):
+    # pipeline-style module registers a region in ANOTHER file.
+    report = _lint_src(
+        tmp_path, "registrar.py",
+        """
+        PXLINT_HOT_REGIONS = ("worker.py:fold",)
+        """,
+        rules={"host-sync-hot-path"},
+        extra_files=[(
+            "worker.py",
+            """
+            import numpy as np
+
+            def fold(xs):
+                return [np.asarray(x) for x in xs]
+            """,
+        )],
+    )
+    assert len(report.findings) == 1
+    assert report.findings[0].path == "worker.py"
+
+
+# -- jit-recompile-hazard -----------------------------------------------------
+
+def test_jit_recompile_rule(tmp_path):
+    report = _lint_src(
+        tmp_path, "jitted.py",
+        """
+        import jax
+        from functools import partial
+
+        @jax.jit
+        def bad(x, n):
+            if n > 3:          # traced arg -> flagged
+                return x
+            return x * n
+
+        @partial(jax.jit, static_argnums=0)
+        def also_checked(n, x):
+            while n:           # flagged (rule is decorator-level)
+                n -= 1
+            return x
+
+        @jax.jit
+        def good(x, flags):
+            if x.shape[0] > 4:     # static: shape attr
+                return x
+            if len(flags) > 1:     # static: len()
+                return x
+            closure_const = 3
+            if closure_const:      # not an argument
+                return x
+            return x
+
+        def not_jitted(x, n):
+            if n:
+                return x
+        """,
+        rules={"jit-recompile-hazard"},
+    )
+    assert [f.symbol for f in report.findings] == ["bad", "also_checked"]
+    assert "retraces and recompiles" in report.findings[0].message
+
+
+# -- thread-shared-state ------------------------------------------------------
+
+_THREADY = """
+    import threading
+
+    class Svc:
+        def __init__(self, bus):
+            self._lock = threading.Lock()
+            self.jobs = {}
+            self.done = []
+            threading.Thread(target=self._worker, daemon=True).start()
+            bus.subscribe("x", self._on_msg)
+
+        def _worker(self):
+            self.jobs["w"] = 1
+
+        def _on_msg(self, m):
+            self.done.append(m)
+
+        def submit(self, j):
+            self.jobs[j.id] = j
+
+        def drain(self):
+            with self._lock:
+                self.done = []
+"""
+
+
+def test_thread_shared_state_rule(tmp_path):
+    report = _lint_src(
+        tmp_path, "svc.py", _THREADY, rules={"thread-shared-state"},
+    )
+    by_attr = {
+        f.message.split("self.")[1].split(" ")[0]: f
+        for f in report.findings
+    }
+    # jobs: thread write + public write, both unlocked -> flagged.
+    assert "jobs" in by_attr
+    # done: thread append unlocked + public write locked -> flagged
+    # (one side holding the lock protects nothing).
+    assert "done" in by_attr
+
+
+def test_thread_shared_state_two_dispatcher_threads(tmp_path):
+    report = _lint_src(
+        tmp_path, "two.py",
+        """
+        import threading
+
+        class Two:
+            def __init__(self, bus):
+                self.state = {}
+                bus.subscribe("a", self._on_a)
+                bus.subscribe("b", self._on_b)
+
+            def _on_a(self, m):
+                self.state["a"] = m
+
+            def _on_b(self, m):
+                self.state["b"] = m
+        """,
+        rules={"thread-shared-state"},
+    )
+    # One finding PER unlocked write site (suppressing one site must
+    # not hide the other).
+    assert [f.symbol for f in report.findings] == [
+        "Two._on_a", "Two._on_b",
+    ]
+    assert "two different dispatcher threads" in report.findings[0].message
+
+
+def test_thread_shared_state_lock_discipline_is_clean(tmp_path):
+    report = _lint_src(
+        tmp_path, "clean.py",
+        """
+        import threading
+
+        class Clean:
+            def __init__(self, bus):
+                self._lock = threading.Lock()
+                self.state = {}
+                bus.subscribe("a", self._on_a)
+
+            def _on_a(self, m):
+                with self._lock:
+                    self.state["a"] = m
+
+            def reset(self):
+                with self._lock:
+                    self.state = {}
+        """,
+        rules={"thread-shared-state"},
+    )
+    assert report.findings == []
+
+
+# -- metrics-naming -----------------------------------------------------------
+
+def test_metrics_naming_rule(tmp_path):
+    report = _lint_src(
+        tmp_path, "metrics.py",
+        """
+        def setup(reg):
+            reg.counter("pixie_good_total", "ok")
+            reg.counter("Bad-Name", "nope")
+            reg.gauge("pixie_thing_count", "reserved suffix")
+            reg.histogram("pixie_lat_seconds", "histograms may _count")
+        """,
+        rules={"metrics-naming"},
+    )
+    msgs = [f.message for f in report.findings]
+    assert len(msgs) == 2
+    assert any("'Bad-Name' violates" in m for m in msgs)
+    assert any(
+        "'pixie_thing_count' ends in a reserved" in m for m in msgs
+    )
+
+
+def test_lock_assigned_in_later_method_still_counts(tmp_path):
+    # _worker is defined textually BEFORE the __init__ that creates the
+    # lock; the class-wide lock pass must still see it.
+    report = _lint_src(
+        tmp_path, "order.py",
+        """
+        import threading
+
+        class Ordered:
+            def _worker(self):
+                with self._lock:
+                    self.state = 1
+
+            def __init__(self, bus):
+                self._lock = threading.Lock()
+                self.state = 0
+                threading.Thread(target=self._worker).start()
+
+            def reset(self):
+                with self._lock:
+                    self.state = 0
+        """,
+        rules={"thread-shared-state"},
+    )
+    assert report.findings == [], \
+        "\n".join(f.render() for f in report.findings)
+
+
+# -- suppression + baseline machinery ----------------------------------------
+
+def test_inline_suppression(tmp_path):
+    report = _lint_src(
+        tmp_path, "sup.py",
+        """
+        def setup(reg):
+            reg.counter("Bad-One", "x")  # pxlint: disable=metrics-naming
+            # pxlint: disable=metrics-naming
+            reg.counter("Bad-Two", "x")
+            reg.counter("Bad-Three", "x")
+        """,
+        rules={"metrics-naming"},
+    )
+    assert len(report.findings) == 1
+    assert "Bad-Three" in report.findings[0].message
+    assert report.suppressed == 2
+
+
+def test_baseline_roundtrip(tmp_path):
+    src = """
+        def setup(reg):
+            reg.counter("Legacy-Metric", "grandfathered")
+    """
+    p = tmp_path / "legacy.py"
+    p.write_text(textwrap.dedent(src))
+    bl = tmp_path / "baseline.json"
+    r1 = run_lint([str(p)], rules={"metrics-naming"},
+                  baseline_path=str(bl), repo_root=str(tmp_path))
+    assert len(r1.findings) == 1
+    save_baseline(r1.findings, str(bl))
+    assert len(load_baseline(str(bl))) == 1
+    r2 = run_lint([str(p)], rules={"metrics-naming"},
+                  baseline_path=str(bl), repo_root=str(tmp_path))
+    assert r2.ok and len(r2.baselined) == 1
+    # Baseline keys ignore line numbers: shifting the file keeps it.
+    p.write_text("\n\n\n" + textwrap.dedent(src))
+    r3 = run_lint([str(p)], rules={"metrics-naming"},
+                  baseline_path=str(bl), repo_root=str(tmp_path))
+    assert r3.ok
+    # Occurrence counts are enforced: a SECOND identical violation in
+    # the same symbol exceeds the baselined count and fails.
+    p.write_text(textwrap.dedent(src)
+                 + '    reg.counter("Legacy-Metric", "again")\n')
+    r4 = run_lint([str(p)], rules={"metrics-naming"},
+                  baseline_path=str(bl), repo_root=str(tmp_path))
+    assert len(r4.findings) == 1 and len(r4.baselined) == 1
+
+
+# -- the shipped tree is green ------------------------------------------------
+
+def test_repo_lints_clean_with_baseline():
+    report = run_lint(
+        [os.path.join(REPO, "pixie_tpu")], repo_root=REPO,
+    )
+    assert report.ok, "\n".join(f.render() for f in report.findings)
+
+
+def test_repo_metrics_naming_has_no_findings_at_all():
+    # The migrated metrics lint must hold with NO baseline escape:
+    # every statically-registered metric name is convention-clean.
+    report = run_lint(
+        [os.path.join(REPO, "pixie_tpu")], rules={"metrics-naming"},
+        baseline_path=os.devnull, repo_root=REPO,
+    )
+    assert report.findings == [], \
+        "\n".join(f.render() for f in report.findings)

@@ -1,0 +1,358 @@
+"""Plan-verifier diagnostics: golden messages for the five bad-plan
+fixtures (unbound column, UDF dtype mismatch, bad UDA arity, dangling
+fragment output, merge/dispatch set mismatch) + acceptance of valid
+compiled plans. See docs/ANALYSIS.md."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pixie_tpu.analysis import (
+    PlanCheckError,
+    Severity,
+    check_plan,
+    verify_dispatch_sets,
+    verify_distributed_plan,
+    verify_plan,
+)
+from pixie_tpu.exec.plan import (
+    AggExpr,
+    AggOp,
+    BridgeSinkOp,
+    ColumnRef,
+    FilterOp,
+    FuncCall,
+    Literal,
+    MapOp,
+    MemorySourceOp,
+    Plan,
+    ResultSinkOp,
+)
+from pixie_tpu.planner.distributed import DistributedPlanner
+from pixie_tpu.planner.distributed.distributed_state import DistributedState
+from pixie_tpu.types.dtypes import DataType
+from pixie_tpu.types.relation import Relation
+from pixie_tpu.udf.registry import Registry, default_registry
+
+
+SCHEMAS = {
+    "t": Relation([
+        ("time_", DataType.TIME64NS),
+        ("a", DataType.INT64),
+        ("s", DataType.STRING),
+    ])
+}
+
+
+def _reg():
+    return default_registry()
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity == Severity.ERROR]
+
+
+def _chain(*ops):
+    """Linear plan source -> ops... -> result sink."""
+    p = Plan()
+    nid = p.add(MemorySourceOp(table="t"))
+    for op in ops:
+        nid = p.add(op, [nid])
+    p.add(ResultSinkOp(name="out"), [nid])
+    return p
+
+
+# -- golden fixture 1: unbound column ----------------------------------------
+
+def test_unbound_column_golden():
+    p = _chain(MapOp(exprs=(("x", ColumnRef("nope")),)))
+    diags = _errors(verify_plan(p, SCHEMAS, _reg()))
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.code == "unbound-column"
+    assert d.node == 1 and d.op == "MapOp"
+    assert d.render() == (
+        "unbound-column: column 'nope' is not in the input relation "
+        "Relation[time_:TIME64NS, a:INT64, s:STRING] "
+        "[node 1: MapOp in logical plan]"
+    )
+
+
+# -- golden fixture 2: dtype mismatch in a UDF call --------------------------
+
+def test_udf_dtype_mismatch_golden():
+    p = _chain(
+        FilterOp(predicate=FuncCall("add", (
+            ColumnRef("s"), Literal(1, DataType.INT64),
+        )))
+    )
+    diags = _errors(verify_plan(p, SCHEMAS, _reg()))
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.code == "udf-signature"
+    assert d.node == 1 and d.op == "FilterOp"
+    assert "no overload of 'add' matches argument types (STRING, INT64)" \
+        in d.message
+    assert "add(col(s), lit(1))" in d.message
+
+
+# -- golden fixture 3: bad UDA state arity -----------------------------------
+
+def test_bad_uda_arity_golden():
+    reg = _reg().clone("test")
+    reg.uda(
+        "badsum", [DataType.INT64], DataType.INT64,
+        init=lambda g: None,
+        update=lambda carry, gids: carry,  # missing (mask, arg) params
+        merge=lambda a, b: a,
+        finalize=lambda c: c,
+    )
+    p = _chain(AggOp(
+        group_cols=("a",),
+        aggs=(AggExpr("x", "badsum", (ColumnRef("a"),)),),
+    ))
+    diags = _errors(verify_plan(p, SCHEMAS, reg))
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.code == "uda-arity"
+    assert d.render() == (
+        "uda-arity: UDA 'badsum' update must accept 4 positional "
+        "argument(s) (update of a segmented UDA over 1 arg column(s)) "
+        "[node 1: AggOp in logical plan]"
+    )
+
+
+# -- golden fixture 4: dangling fragment output ------------------------------
+
+def test_dangling_output_golden():
+    p = Plan()
+    src = p.add(MemorySourceOp(table="t"))
+    p.add(MapOp(exprs=(("a", ColumnRef("a")),)), [src])  # no consumer
+    diags = _errors(verify_plan(p, SCHEMAS, _reg()))
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.code == "dangling-output"
+    assert d.render() == (
+        "dangling-output: MapOp output has no consumer (fragment "
+        "output feeds no sink) [node 1: MapOp in logical plan]"
+    )
+
+
+# -- golden fixture 5: merge/dispatch set mismatch ---------------------------
+
+def _agg_dplan():
+    p = _chain(AggOp(
+        group_cols=("a",),
+        aggs=(AggExpr("n", "count", (ColumnRef("a"),)),),
+    ))
+    state = DistributedState.homogeneous(2, 1)
+    return DistributedPlanner(_reg()).plan(p, state)
+
+
+def test_dispatch_set_mismatch_golden():
+    dplan = _agg_dplan()
+    assert set(dplan.data_agent_ids) == {"pem-0", "pem-1"}
+    diags = verify_dispatch_sets(
+        dplan,
+        merge_expected=["pem-0", "pem-1"],
+        dispatched=["pem-0"],
+        merge_agent="kelvin-0",
+    )
+    assert [d.code for d in diags] == [
+        "dispatch-set-mismatch", "dispatch-set-mismatch",
+    ]
+    assert diags[0].message == (
+        "merge expected-agent set != dispatched set: merge waits for "
+        "['pem-1'] never dispatched; dispatched [] the merge will "
+        "ignore"
+    )
+    # Symmetric case: dispatching an agent the merge will not wait for.
+    diags = verify_dispatch_sets(
+        dplan,
+        merge_expected=["pem-0"],
+        dispatched=["pem-0", "pem-1"],
+        merge_agent="kelvin-0",
+    )
+    assert "dispatched ['pem-1'] the merge will ignore" in diags[0].message
+    # Matching sets: clean.
+    assert verify_dispatch_sets(
+        dplan,
+        merge_expected=["pem-0", "pem-1"],
+        dispatched=["pem-1", "pem-0"],
+        merge_agent="kelvin-0",
+    ) == []
+
+
+# -- acceptance: valid plans verify clean ------------------------------------
+
+def test_valid_compiled_plans_verify_clean():
+    from pixie_tpu.exec.engine import Engine
+    from pixie_tpu.planner import CompilerState, compile_pxl
+
+    eng = Engine(window_rows=1 << 10)
+    n = 512
+    eng.append_data("http_events", {
+        "time_": np.arange(n, dtype=np.int64),
+        "latency_ns": np.arange(n, dtype=np.int64),
+        "resp_status": np.full(n, 200, dtype=np.int64),
+        "service": np.array(["a", "b"] * (n // 2)),
+    })
+    scripts = [
+        # filter + groupby-agg + fused quantile pluck + projection
+        "import px\n"
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.resp_status < 400]\n"
+        "df = df.groupby('service').agg("
+        "n=('latency_ns', px.count), p=('latency_ns', px.quantiles))\n"
+        "df.p50 = px.pluck_float64(df.p, 'p50')\n"
+        "df = df[['service', 'n', 'p50']]\n"
+        "px.display(df)\n",
+        # self-join through an agg
+        "import px\n"
+        "df = px.DataFrame(table='http_events')\n"
+        "agg = df.groupby('service').agg(n=('latency_ns', px.count))\n"
+        "j = df.merge(agg, how='inner', left_on='service', "
+        "right_on='service')\n"
+        "px.display(j)\n",
+    ]
+    state = CompilerState(
+        schemas={name: t.relation for name, t in eng.tables.items()},
+        registry=eng.registry,
+    )
+    for q in scripts:
+        compiled = compile_pxl(q, state)  # check_plan runs inside
+        assert verify_plan(
+            compiled.plan, state.schemas, state.registry
+        ) == []
+        # Execution agrees the plan is fine.
+        eng.execute_query(q)
+
+
+def test_check_plan_raises_plancheckerror():
+    p = _chain(MapOp(exprs=(("x", ColumnRef("nope")),)))
+    with pytest.raises(PlanCheckError) as ei:
+        check_plan(p, SCHEMAS, _reg())
+    assert "unbound-column" in str(ei.value)
+    # PlanCheckError is a PxLError: compile-error handling applies.
+    from pixie_tpu.planner.objects import PxLError
+
+    assert isinstance(ei.value, PxLError)
+    assert ei.value.diagnostics[0].node == 1
+
+
+# -- distributed invariants ---------------------------------------------------
+
+def test_distributed_plan_verifies_clean_with_schemas():
+    dplan = _agg_dplan()
+    assert _errors(
+        verify_distributed_plan(dplan, SCHEMAS, _reg())
+    ) == []
+
+
+def test_distributed_dangling_bridge_source():
+    dplan = _agg_dplan()
+    after = dplan.split.after_blocking
+    from pixie_tpu.exec.plan import BridgeSourceOp
+
+    src_nid = next(
+        nid for nid, n in after.nodes.items()
+        if isinstance(n.op, BridgeSourceOp)
+    )
+    # Sever the merge side: the bridge sink now ships into the void.
+    consumers = [
+        n for n in after.nodes.values() if src_nid in n.inputs
+    ]
+    del after.nodes[src_nid]
+    diags = verify_distributed_plan(dplan)
+    codes = {d.code for d in diags}
+    assert "dangling-bridge" in codes
+    d = next(d for d in diags if d.code == "dangling-bridge")
+    assert "missing its GRPC-source analog (BridgeSourceOp)" in d.message
+    assert consumers  # the severed consumer makes the plan ill-formed
+
+
+def test_distributed_blocking_op_in_data_fragment():
+    dplan = _agg_dplan()
+    before = dplan.split.before_blocking
+    # Plant a full-mode agg in the shard-local fragment.
+    agg_nid = next(
+        nid for nid, n in before.nodes.items()
+        if isinstance(n.op, AggOp)
+    )
+    before.nodes[agg_nid].op = AggOp(
+        group_cols=before.nodes[agg_nid].op.group_cols,
+        aggs=before.nodes[agg_nid].op.aggs,
+        mode="full",
+    )
+    diags = verify_distributed_plan(dplan)
+    d = next(d for d in diags if d.code == "fragment-invariant")
+    assert "blocking operator AggOp (mode=full) in the shard-local " \
+        "data fragment" in d.message
+    assert d.plan == "data"
+
+
+def test_distributed_row_bridge_feeding_finalize_agg():
+    dplan = _agg_dplan()
+    from pixie_tpu.planner.distributed.splitter import ROW_GATHER
+
+    for b in dplan.split.bridges:
+        b.kind = ROW_GATHER
+    diags = verify_distributed_plan(dplan)
+    d = next(d for d in diags if d.code == "bridge-kind")
+    assert "expects mergeable agg carries, not rows" in d.message
+
+
+def test_splitter_output_passes_always_on_check():
+    # DistributedPlanner.plan runs check_distributed_plan internally;
+    # a clean split must not raise.
+    _agg_dplan()
+
+
+def test_dangling_input_and_cycle():
+    p = Plan()
+    src = p.add(MemorySourceOp(table="t"))
+    m = p.add(MapOp(exprs=(("a", ColumnRef("a")),)), [src])
+    p.add(ResultSinkOp(name="out"), [m])
+    p.nodes[m].inputs.append(99)  # nonexistent node
+    diags = verify_plan(p, SCHEMAS, _reg())
+    assert any(d.code == "dangling-input" for d in diags)
+
+    p2 = Plan()
+    a = p2.add(MemorySourceOp(table="t"))
+    b = p2.add(FilterOp(predicate=ColumnRef("a")), [a])
+    c = p2.add(MapOp(exprs=(("a", ColumnRef("a")),)), [b])
+    p2.nodes[b].inputs.append(c)  # cycle b <-> c
+    p2.add(ResultSinkOp(name="out"), [c])
+    diags = verify_plan(p2, SCHEMAS, _reg())
+    assert any(d.code in ("plan-cycle", "bad-arity") for d in diags)
+
+
+def test_filter_not_boolean_and_bad_arity():
+    p = _chain(FilterOp(predicate=ColumnRef("a")))  # INT64 predicate
+    diags = _errors(verify_plan(p, SCHEMAS, _reg()))
+    assert [d.code for d in diags] == ["dtype-mismatch"]
+    assert "filter predicate col(a) has type INT64, want BOOLEAN" in \
+        diags[0].message
+
+    p2 = Plan()
+    p2.add(BridgeSinkOp(bridge_id=0), [])  # sink with no input
+    diags = verify_plan(p2, SCHEMAS, _reg())
+    assert any(d.code == "bad-arity" for d in diags)
+
+
+def test_unknown_table_and_udtf():
+    p = Plan()
+    src = p.add(MemorySourceOp(table="missing"))
+    p.add(ResultSinkOp(name="out"), [src])
+    diags = _errors(verify_plan(p, SCHEMAS, _reg()))
+    assert [d.code for d in diags] == ["unknown-table"]
+    assert "no table named 'missing'" in diags[0].message
+
+    from pixie_tpu.exec.plan import UDTFSourceOp
+
+    p2 = Plan()
+    src = p2.add(UDTFSourceOp(name="NotAUDTF"))
+    p2.add(ResultSinkOp(name="out"), [src])
+    diags = _errors(verify_plan(p2, SCHEMAS, Registry("empty")))
+    assert [d.code for d in diags] == ["unknown-udtf"]

@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""pxlint CLI: run the analysis lint rules over the source tree.
+
+Usage:
+  python tools/pxlint.py [paths...] [--rules r1,r2] [--baseline PATH]
+                         [--update-baseline] [--json] [--list-rules]
+
+Defaults: paths = pixie_tpu/, baseline =
+pixie_tpu/analysis/baseline.json. Exits non-zero on any finding that is
+neither inline-suppressed (``# pxlint: disable=<rule>``) nor baselined.
+See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint_module():
+    """Import analysis/lint.py by path, bypassing pixie_tpu/__init__
+    (which imports jax — pure AST linting must not pay for, or hang
+    on, accelerator-plugin initialization)."""
+    import importlib.util
+
+    path = os.path.join(REPO, "pixie_tpu", "analysis", "lint.py")
+    spec = importlib.util.spec_from_file_location("_pxlint_rules", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclasses resolves cls.__module__
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_lint = _load_lint_module()
+ALL_RULES = _lint.ALL_RULES
+default_baseline_path = _lint.default_baseline_path
+run_lint = _lint.run_lint
+save_baseline = _lint.save_baseline
+
+
+DEFAULT_PATHS = [os.path.join(REPO, "pixie_tpu")]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", default=DEFAULT_PATHS)
+    ap.add_argument("--rules", help="comma-separated rule names (default all)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline path (default {default_baseline_path()})")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline with the current findings")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            r = cls()
+            print(f"{r.name}: {r.description}")
+        return 0
+
+    if args.update_baseline and (
+        args.rules or args.paths is not DEFAULT_PATHS
+    ):
+        # A filtered run sees only a subset of findings; rewriting the
+        # baseline from it would silently drop every entry belonging to
+        # the rules/paths that did not run.
+        print(
+            "pxlint: --update-baseline requires a full run "
+            "(no --rules, no path arguments)",
+            file=sys.stderr,
+        )
+        return 2
+
+    rules = (
+        {r.strip() for r in args.rules.split(",") if r.strip()}
+        if args.rules else None
+    )
+    if rules is not None:
+        known = {cls().name for cls in ALL_RULES}
+        bad = rules - known
+        if bad:
+            print(f"pxlint: unknown rule(s) {sorted(bad)}; "
+                  f"known: {sorted(known)}", file=sys.stderr)
+            return 2
+
+    report = run_lint(
+        args.paths, rules=rules, baseline_path=args.baseline,
+        repo_root=REPO,
+    )
+
+    if args.update_baseline:
+        save_baseline(
+            report.findings + report.baselined,
+            args.baseline or default_baseline_path(),
+        )
+        print(
+            f"pxlint: baseline updated with "
+            f"{len(report.findings) + len(report.baselined)} finding(s)"
+        )
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in report.findings],
+            "baselined": len(report.baselined),
+            "suppressed": report.suppressed,
+            "files": report.files,
+        }, indent=2))
+    else:
+        for f in report.findings:
+            print(f.render())
+        print(
+            f"pxlint: {len(report.findings)} finding(s), "
+            f"{len(report.baselined)} baselined, "
+            f"{report.suppressed} suppressed, {report.files} files",
+            file=sys.stderr,
+        )
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
