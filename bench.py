@@ -54,6 +54,14 @@ SHAPE_DEFS = {
     "sql_stats": ("_shape_sql_stats", 4),
     "perf_flamegraph": ("_shape_perf_flamegraph", 4),
     "device_join": ("_shape_device_join", 4),
+    # Join-distribution shapes (ISSUE 9): skewed keys stress capacity
+    # estimation (zipf fan-out), clustered+selective keys exercise
+    # zone-map window skipping. Both group on columns from BOTH sides,
+    # so eager aggregation cannot rewrite the join away — they measure
+    # the REAL N:M join path the single device_join shape no longer
+    # reaches (it routes to the fused N:1 lookup after the rewrite).
+    "device_join_skew": ("_shape_device_join_skew", 4),
+    "device_join_select": ("_shape_device_join_select", 4),
 }
 ALL_SHAPES = tuple(SHAPE_DEFS)
 
@@ -746,6 +754,171 @@ def _shape_perf_flamegraph(n, window):
     })
 
 
+def _join_report(eng) -> dict | None:
+    """Routing report of the query's materialized join: strategy chosen,
+    build-side swap, THIS query's overflow retries (the decision's own
+    count — the registry counter is process-cumulative across warm runs
+    and would misattribute another run's retries), zone-skipped windows,
+    plus the process-wide counter for the ISSUE 9 acceptance gate
+    (``retries_total`` at 0 on every standard shape's subprocess)."""
+    d = eng.last_join_decision
+    retries_total = eng.tracer.registry.counter(
+        "pixie_join_capacity_retries_total"
+    ).value()
+    if d is None:
+        return {"retries_total": int(retries_total)}
+    return {
+        "strategy": d.strategy, "swap": bool(d.swap),
+        "retries": int(d.retries),
+        "retries_total": int(retries_total),
+        "skipped_windows": int(d.skipped_windows),
+    }
+
+
+def _with_join(res: dict, eng) -> dict:
+    rep = _join_report(eng)
+    if rep is not None:
+        res["join"] = rep
+    return res
+
+
+def _join_two_table_engines(n, window, lk, lb, rk, rc, rv):
+    """Engines over a two-table join replay: l(time_, k, b), r(time_,
+    k, c, v) — shared by the skew/selective join shapes."""
+    from pixie_tpu.exec.engine import Engine
+    from pixie_tpu.types.dtypes import DataType
+    from pixie_tpu.types.relation import Relation
+
+    rel_l = Relation([
+        ("time_", DataType.TIME64NS),
+        ("k", DataType.INT64),
+        ("b", DataType.INT64),
+    ])
+    rel_r = Relation([
+        ("time_", DataType.TIME64NS),
+        ("k", DataType.INT64),
+        ("c", DataType.INT64),
+        ("v", DataType.INT64),
+    ])
+
+    def cols_l(off, m):
+        s = slice(off, off + m)
+        return {"time_": (np.arange(off, off + m, dtype=np.int64),),
+                "k": (lk[s],), "b": (lb[s],)}
+
+    def cols_r(off, m):
+        s = slice(off, off + m)
+        return {"time_": (np.arange(off, off + m, dtype=np.int64),),
+                "k": (rk[s],), "c": (rc[s],), "v": (rv[s],)}
+
+    def build(rows_l, rows_r):
+        e = Engine(window_rows=window)
+        e.create_table("conn_l")
+        e.create_table("conn_r")
+        _push_encoded(e, "conn_l", rel_l, cols_l, rows_l, window, {})
+        _push_encoded(e, "conn_r", rel_r, cols_r, rows_r, window, {})
+        return e
+
+    return build(n, len(rk)), build(min(n, window), min(len(rk), window))
+
+
+_JOIN_BOTH_SIDES_QUERY = """
+import px
+l = px.DataFrame(table='conn_l')
+r = px.DataFrame(table='conn_r')
+g = l.merge(r, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+out = g.groupby(['b', 'c']).agg(n=('v', px.count), s=('v', px.sum))
+px.display(out)
+"""
+
+
+def _check_join_both_sides(out, n_keys, lk, lb, rk, rc, rv):
+    """Verify groupby(b from left, c from right) counts/sums against the
+    numpy replay (per-key histograms contracted over the key axis — the
+    join never materializes in the reference either, so the baseline is
+    as fair as the scan shapes'). Returns the baseline seconds."""
+    t0 = time.perf_counter()
+    nb_, nc_ = 16, 8
+    m_l = np.bincount(lk * nb_ + lb, minlength=n_keys * nb_).reshape(
+        n_keys, nb_
+    ).astype(np.float64)
+    cnt_r = np.bincount(rk * nc_ + rc, minlength=n_keys * nc_).reshape(
+        n_keys, nc_
+    ).astype(np.float64)
+    sum_r = np.bincount(rk * nc_ + rc, weights=rv.astype(np.float64),
+                        minlength=n_keys * nc_).reshape(n_keys, nc_)
+    ref_n = m_l.T @ cnt_r  # [b, c]
+    ref_s = m_l.T @ sum_r
+    base_dt = time.perf_counter() - t0
+
+    got = out["output"].to_pydict()
+    gkey = got["b"].astype(np.int64) * nc_ + got["c"]
+    order = np.argsort(gkey)
+    present = np.nonzero(ref_n.reshape(-1))[0]
+    assert np.array_equal(gkey[order], present), "join_both keys mismatch"
+    np.testing.assert_allclose(
+        got["n"][order], ref_n.reshape(-1)[present], rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        got["s"][order], ref_s.reshape(-1)[present], rtol=1e-9
+    )
+    return base_dt
+
+
+def _shape_device_join_skew(n, window):
+    """Skewed-key N:M join: build keys are zipf-distributed (a handful
+    of keys carry most of the build rows, so per-probe fan-out varies by
+    orders of magnitude), probe keys uniform. Group keys span both
+    sides, so the eager-agg rewrite can't apply — this measures the raw
+    join strategies under the distribution that breaks naive capacity
+    guesses."""
+    rng = np.random.default_rng(23)
+    n_keys = max(n // 2, 1)
+    lk = rng.integers(0, n_keys, n)
+    lb = rng.integers(0, 16, n)
+    # Zipf build keys spread over the id space by a fixed odd multiplier
+    # (keeps skew, decorrelates hot ids from zone ranges).
+    rk = (np.minimum(rng.zipf(1.5, n), n_keys) - 1) * 2654435761 % n_keys
+    rc = rng.integers(0, 8, n)
+    rv = rng.integers(0, 1000, n)
+    eng, warm = _join_two_table_engines(n, window, lk, lb, rk, rc, rv)
+    rps, dt, out = _time_query(eng, _JOIN_BOTH_SIDES_QUERY, 2 * n,
+                               warm_eng=warm)
+    base_dt = _check_join_both_sides(out, n_keys, lk, lb, rk, rc, rv)
+    return _with_join(_with_pipeline({
+        "rows": 2 * n, "rows_per_sec": round(rps), "secs": round(dt, 3),
+        "vs_baseline": round(rps / ((2 * n) / base_dt), 3), "checked": True,
+    }), eng)
+
+
+def _shape_device_join_select(n, window):
+    """Selective clustered join: probe keys ascend with time (each probe
+    window spans a narrow key band — the live-telemetry shape) while the
+    build side only covers the top eighth of the key space, so zone maps
+    prove ~7/8 of probe windows can't match and the driver never stages
+    them (host path: range pre-filter drops the same rows)."""
+    rng = np.random.default_rng(29)
+    n_keys = max(n // 2, 2)
+    lk = (np.arange(n, dtype=np.int64) * n_keys) // n + rng.integers(
+        0, max(n_keys // 256, 1), n
+    )
+    np.minimum(lk, n_keys - 1, out=lk)
+    lb = rng.integers(0, 16, n)
+    n_r = max(n // 4, 1)
+    rk = rng.integers(n_keys - n_keys // 8, n_keys, n_r)
+    rc = rng.integers(0, 8, n_r)
+    rv = rng.integers(0, 1000, n_r)
+    eng, warm = _join_two_table_engines(n, window, lk, lb, rk, rc, rv)
+    rps, dt, out = _time_query(eng, _JOIN_BOTH_SIDES_QUERY, n + n_r,
+                               warm_eng=warm)
+    base_dt = _check_join_both_sides(out, n_keys, lk, lb, rk, rc, rv)
+    return _with_join(_with_pipeline({
+        "rows": n + n_r, "rows_per_sec": round(rps), "secs": round(dt, 3),
+        "vs_baseline": round(rps / ((n + n_r) / base_dt), 3),
+        "checked": True,
+    }), eng)
+
+
 def _shape_device_join(n, window):
     """Bonus shape: RAW pre-agg N:M self-join through the engine's device
     join kernel (VERDICT r02 ask #5 — the five BASELINE joins are all
@@ -816,10 +989,10 @@ px.display(out)
     assert np.array_equal(got["b"][order], present), "join keys mismatch"
     np.testing.assert_allclose(got["n"][order], ref_n[present], rtol=1e-9)
     np.testing.assert_allclose(got["s"][order], ref_s[present], rtol=1e-9)
-    return _with_pipeline({
+    return _with_join(_with_pipeline({
         "rows": 2 * n, "rows_per_sec": round(rps), "secs": round(dt, 3),
         "vs_baseline": round(rps / ((2 * n) / base_dt), 3), "checked": True,
-    })
+    }), eng)
 
 
 def inner() -> int:

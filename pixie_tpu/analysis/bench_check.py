@@ -1,11 +1,11 @@
-"""Plan verification over the six bench shapes (``run_tests.sh
+"""Plan verification over the bench shapes (``run_tests.sh
 --analyze``).
 
 Compiles every bench shape's query (the same shipped library scripts
 ``bench.py`` runs) against the bench replay schemas, with the always-on
 plan verifier active, then splits each through the DistributedPlanner
 (2 PEMs + 1 Kelvin) and runs the full distributed schema walk. Any
-diagnostic is a regression: these six plans are the repo's
+diagnostic is a regression: these plans are the repo's
 performance-critical shapes and must stay statically clean.
 
 Also reports verifier overhead relative to compile time — the pass
@@ -66,10 +66,22 @@ SHAPE_SCHEMAS = {
         "conn_l": Relation([("time_", T), ("k", I), ("b", I)]),
         "conn_r": Relation([("time_", T), ("k", I), ("v", I)]),
     },
+    # The join-distribution shapes (skewed keys / selective clustered
+    # keys) share one query whose group keys span BOTH sides — the
+    # eager-agg rewrite cannot fire, so this verifies the REAL N:M
+    # JoinOp plan the windowed/radix drivers execute.
+    "device_join_skew": {
+        "conn_l": Relation([("time_", T), ("k", I), ("b", I)]),
+        "conn_r": Relation([("time_", T), ("k", I), ("c", I), ("v", I)]),
+    },
+    "device_join_select": {
+        "conn_l": Relation([("time_", T), ("k", I), ("b", I)]),
+        "conn_r": Relation([("time_", T), ("k", I), ("c", I), ("v", I)]),
+    },
 }
 
-# bench.py's _shape_device_join query, verbatim (the one shape whose
-# query is inline rather than a shipped script).
+# bench.py's inline queries, verbatim (the shapes whose queries are not
+# shipped library scripts).
 _DEVICE_JOIN_QUERY = """
 import px
 l = px.DataFrame(table='conn_l')
@@ -79,17 +91,28 @@ out = g.groupby('b').agg(n=('v', px.count), s=('v', px.sum))
 px.display(out)
 """
 
+_JOIN_BOTH_SIDES_QUERY = """
+import px
+l = px.DataFrame(table='conn_l')
+r = px.DataFrame(table='conn_r')
+g = l.merge(r, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+out = g.groupby(['b', 'c']).agg(n=('v', px.count), s=('v', px.sum))
+px.display(out)
+"""
+
 
 def _shape_query(shape: str) -> str:
     if shape == "device_join":
         return _DEVICE_JOIN_QUERY
+    if shape in ("device_join_skew", "device_join_select"):
+        return _JOIN_BOTH_SIDES_QUERY
     from ..scripts import load_script
 
     return load_script(f"px/{shape}").pxl
 
 
 def check_bench_shapes(verbose: bool = True) -> int:
-    """Compile + verify all six shapes; returns the number of failing
+    """Compile + verify every bench shape; returns the number of failing
     shapes (0 = green)."""
     from ..planner import CompilerState, compile_pxl
     from ..planner.distributed import DistributedPlanner
@@ -157,7 +180,8 @@ def main() -> int:
         print(f"[analyze] {failures} bench shape(s) failed verification",
               file=sys.stderr)
         return 1
-    print("[analyze] all six bench shapes verify clean", file=sys.stderr)
+    print(f"[analyze] all {len(SHAPE_SCHEMAS)} bench shapes verify clean",
+          file=sys.stderr)
     return 0
 
 

@@ -141,6 +141,31 @@ define_flag("join_probe_window_rows", 1 << 20,
             "joins: the build side is sorted and staged on device ONCE "
             "per query and probe windows stream through the prefetch "
             "pipeline. 0 = single-shot kernel over the whole probe side.")
+define_flag("ingest_sketches", True,
+            "Maintain per-tablet ingest sketches (row count, HLL NDV, "
+            "zone maps on key columns) on the append path; join routing "
+            "and the planner's eager-aggregation sizing consult them.")
+define_flag("join_strategy", "auto",
+            "N:M join strategy: 'auto' (sketch-guided routing picks "
+            "host-dict / host-hash / single-shot / windowed sorted-probe "
+            "/ windowed radix by shape, backend and sketches), or force "
+            "'host', 'single', 'sorted', 'radix' for testing/bench.")
+define_flag("join_radix_bits", 8,
+            "Radix bits for the partitioned device join: build keys are "
+            "splitmix64-hashed and partitioned by the top bits, so each "
+            "probe row binary-searches ONE partition instead of the "
+            "whole build side. 0 disables the radix strategy entirely.")
+define_flag("join_capacity_safety", 2.0,
+            "Multiplier on the sketch-estimated join output cardinality "
+            "when sizing the initial device-join output capacity (then "
+            "rounded to a power-of-two bucket). Headroom over the "
+            "NDV-based mean fan-out absorbs moderate key skew; an "
+            "overflow retry costs a fresh jit compile mid-query, so "
+            "over-sizing is the cheaper error.")
+define_flag("join_zone_skip", True,
+            "Skip staging probe windows whose key zone map cannot "
+            "intersect the build side's key range (inner/left windowed "
+            "device joins; left windows emit their null rows host-side).")
 define_flag("device_residency", True,
             "Stage full table windows into device memory (HBM) at append "
             "time so steady-state queries run without host transfers.")

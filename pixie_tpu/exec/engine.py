@@ -202,6 +202,17 @@ class Engine:
         # their own engine-state mutations around super().execute_plan().
         self._exec_guard = threading.RLock()
         self.last_table_sinks: dict = {}  # {table: rows} from TableSinkOps
+        # Routing outcome of the most recent materialized JoinOp
+        # (joins.JoinDecision): strategy, build-side swap, capacity,
+        # overflow retries, zone-skipped windows. Bench and tests read
+        # it; None until a query joins.
+        self.last_join_decision = None
+        # Learned join-output capacities, keyed by (mode, plan hash,
+        # node): a repeated query starts at the rung its last run
+        # settled on. Engine-scoped — plan hashes don't capture table
+        # identity, so a shared cache would cross-seed engines running
+        # the same script over different data.
+        self._join_capacity_cache: dict = {}
 
     @property
     def tables(self) -> dict:
@@ -256,6 +267,7 @@ class Engine:
                     registry=self.registry,
                     now_ns=now_ns,
                     max_output_rows=max_output_rows,
+                    table_stats=self._compile_table_stats(),
                 )
                 compiled = compile_pxl(query, state)
         except BaseException as e:
@@ -281,6 +293,24 @@ class Engine:
                 error=f"{type(e).__name__}: {e}",
             )
             raise
+
+    def _compile_table_stats(self) -> dict:
+        """Ingest-sketch stats snapshot for the optimizer
+        (``CompilerState.table_stats``): per-table row counts + per-key-
+        column HLL NDV estimates. A few microseconds per column — the
+        sketches were maintained at append time."""
+        out: dict = {}
+        for n, t in self.tables.items():
+            sk = getattr(t, "sketches", None)
+            if not sk:
+                continue
+            out[n] = {
+                "rows": sk.rows,
+                "ndv": {
+                    c: s.ndv for c, s in sk.cols.items() if s.rows
+                },
+            }
+        return out
 
     def set_metadata_state(self, state) -> None:
         """Attach k8s metadata; rebinds the metadata UDFs to a snapshot of
@@ -358,6 +388,20 @@ class Engine:
                 dict(self.last_pipeline) if self.last_pipeline else None
             )
 
+    @staticmethod
+    def _plan_fingerprint(plan: Plan) -> int:
+        """Structural plan hash (cached on the plan object): keys the
+        joins' learned-capacity cache so a repeated script starts at the
+        output-capacity rung its last run settled on."""
+        fp = getattr(plan, "_fingerprint", None)
+        if fp is None:
+            fp = hash(tuple(
+                (nid, type(n.op).__name__, repr(n.op), tuple(n.inputs))
+                for nid, n in sorted(plan.nodes.items())
+            ))
+            plan._fingerprint = fp
+        return fp
+
     def _execute_plan_inner(
         self, plan: Plan, bridge_inputs: dict | None = None,
         materialize: bool = True,
@@ -431,11 +475,33 @@ class Engine:
             elif isinstance(op, JoinOp):
                 fused = try_fused_join(self, nid, node, results, consumers)
                 if fused is not None:
+                    from .joins import JoinDecision
+
+                    self.last_join_decision = JoinDecision(
+                        strategy="fused",
+                        reason="dense-domain N:1 in-fragment lookup",
+                    )
                     results[nid] = fused
                 else:
+                    from .joins import stream_join_stats
+
+                    # Ingest-sketch stats must be read BEFORE
+                    # materialization (the table provenance dies with
+                    # the stream); they steer build-side choice,
+                    # capacity estimation and zone skipping.
+                    lstats = stream_join_stats(
+                        results[node.inputs[0]], op.left_on
+                    )
+                    rstats = stream_join_stats(
+                        results[node.inputs[1]], op.right_on
+                    )
                     left = mat_input(node.inputs[0])
                     right = mat_input(node.inputs[1])
-                    results[nid] = _join_dispatch(left, right, op, self)
+                    results[nid] = _join_dispatch(
+                        left, right, op, self,
+                        left_stats=lstats, right_stats=rstats,
+                        cap_key=(self._plan_fingerprint(plan), nid),
+                    )
             elif isinstance(op, UnionOp):
                 mats = [mat_input(i) for i in node.inputs]
                 results[nid] = _union_host(mats)

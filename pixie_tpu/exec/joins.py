@@ -3,20 +3,36 @@ fused in-fragment lookup joins.
 
 Reference parity: ``src/carnot/exec/equijoin_node.cc`` (build+probe hash
 join) and ``union_node.cc`` (k-way ordered merge). The TPU redesign
-routes by shape and backend instead of always hash-joining:
+routes by shape, backend and *ingest sketches* instead of always
+hash-joining (see docs/JOINS.md for the full strategy matrix):
 
 - small unique-key inner/left joins run a host dict join,
-- large N:M joins run the sort-based device kernel (TPU) or a
-  vectorized numpy sort+searchsorted join (CPU backend, where XLA sorts
-  are the wrong tool),
+- large N:M joins run a device kernel — single-shot sort-based, or the
+  windowed drivers (sorted-probe / radix-partitioned) that stage the
+  build side once and stream probe windows through the prefetch
+  pipeline — or a native/numpy hash join on the CPU backend (where XLA
+  sorts are the wrong tool),
 - N:1 joins against a dense-domain build side fuse INTO the probe
   stream's fragment as device gathers (``try_fused_join``) so output
   rows never materialize host-side.
+
+Sketch-guided routing (``choose_join_strategy``): the table store's
+ingest sketches (``table_store/sketches.py`` — row counts, HLL NDV,
+zone maps) pick the build side, estimate the join's output cardinality
+to size the initial output capacity (instead of climbing the
+overflow-doubling ladder, one jit compile per rung), choose single-shot
+vs windowed vs radix, and skip probe windows whose key range cannot
+intersect the build side. Final capacities persist per plan hash on
+the engine (``Engine._join_capacity_cache``) so repeated queries start
+at the right rung; ``pixie_join_capacity_retries_total`` counts the
+residual retries.
 """
 
 from __future__ import annotations
 
 import functools
+import threading
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -52,34 +68,320 @@ def _key_tuples(hb: HostBatch, on, remaps):
 # larger inputs and right/outer/N:M joins go to the device kernel.
 DEVICE_JOIN_MIN_ROWS = 1 << 15
 
+# The windowed driver prefers the radix-partitioned probe over the full
+# searchsorted once the build side clears this many rows (below it the
+# partition bookkeeping costs more than the shorter binary search saves).
+RADIX_MIN_BUILD_ROWS = 1 << 16
+
+
+# -- sketch-backed side statistics -------------------------------------------
+@dataclass
+class JoinSideStats:
+    """What routing knows about one join input without touching data.
+
+    ``lo``/``hi``/``ndv`` describe the SINGLE key column when the join
+    key is one single-plane INT64/STRING column (the packed-id fast
+    path); multi-key joins carry rows only. All fields are conservative
+    estimates: rows is an upper bound when the stream filters, NDV is
+    HLL (~3% error), zone bounds never shrink under expiry.
+    """
+
+    rows: int
+    lo: int | None = None
+    hi: int | None = None
+    ndv: int | None = None
+    origin: str = "none"  # 'sketch' | 'scan' | 'none'
+
+
+def _chain_key_sources(chain, on_cols):
+    """Trace join key columns back through a stream's op chain to source
+    table columns, or None when any op rewrites/aggregates them (then
+    ingest sketches no longer describe the key values).
+
+    The chain is in APPLICATION order; tracing an output name back to
+    its source walks it in reverse (the last Map renamed it most
+    recently)."""
+    from .plan import FilterOp, LimitOp, MapOp, trace_map_renames
+
+    mapping = {c: c for c in on_cols}
+    for op in reversed(chain):
+        if isinstance(op, (FilterOp, LimitOp)):
+            continue  # values survive, rows only shrink
+        if isinstance(op, MapOp):
+            mapping = trace_map_renames(op, mapping)
+            if mapping is None:
+                return None
+        else:
+            return None
+    return mapping
+
+
+def stream_join_stats(res, on_cols) -> JoinSideStats | None:
+    """Ingest-sketch stats for one join input (``results[nid]`` BEFORE
+    materialization), or None when the input is not a table-backed
+    stream with the key columns passing through unmodified."""
+    if not isinstance(res, _Stream) or not isinstance(res.source, list):
+        return None
+    mapping = _chain_key_sources(res.chain, on_cols)
+    if mapping is None:
+        return None
+    tablets = [t for t in res.source if getattr(t, "sketches", None)]
+    if not tablets or len(tablets) != len(res.source):
+        return None
+    rows = sum(t.sketches.rows for t in tablets)
+    stats = JoinSideStats(rows=rows, origin="sketch")
+    if len(on_cols) == 1:
+        src = mapping[on_cols[0]]
+        sks = [t.sketches.col(src) for t in tablets]
+        if all(s is not None and s.rows for s in sks):
+            stats.lo = min(s.lo for s in sks)
+            stats.hi = max(s.hi for s in sks)
+            if len(sks) == 1:
+                stats.ndv = sks[0].ndv
+            else:
+                # Cross-tablet NDV: HLL registers merge exactly
+                # (elementwise max) — never sum per-tablet estimates.
+                from ..ops.hll import hll_estimate_np
+
+                reg = sks[0].registers.copy()
+                for s in sks[1:]:
+                    np.maximum(reg, s.registers, out=reg)
+                stats.ndv = max(1, min(hll_estimate_np(reg), rows))
+    return stats
+
+
+def _scan_side_stats(keys: np.ndarray) -> JoinSideStats:
+    """Fallback stats computed from packed key ids: exact zone bounds
+    (one vectorized pass) + HLL NDV (one hash pass). Used when ingest
+    sketches don't cover an input; ~10ms per 4M keys, amortized against
+    the device join it steers."""
+    from ..ops.hll import hll_estimate_np, hll_init_np, hll_update_np
+
+    n = len(keys)
+    if n == 0:
+        return JoinSideStats(rows=0, origin="scan")
+    reg = hll_init_np()
+    hll_update_np(reg, keys)
+    return JoinSideStats(
+        rows=n, lo=int(keys.min()), hi=int(keys.max()),
+        ndv=max(1, min(hll_estimate_np(reg), n)), origin="scan",
+    )
+
+
+# -- learned capacity + retry accounting -------------------------------------
+# (mode, plan-hash, node) -> final (post-overflow) output capacity of a
+# join node, stored on ``Engine._join_capacity_cache``: a repeated query
+# starts at the rung its last run finished on instead of re-climbing the
+# doubling ladder (one jit compile per rung, paid MID-query in the
+# synchronous dispatch regime). Engine-scoped because the plan
+# fingerprint hashes operators, not data — two engines running the same
+# script over different tables must not seed each other's rungs.
+# Engine-less driver calls pass cap_key=None and learn nothing.
+_CAPACITY_LOCK = threading.Lock()
+_CAPACITY_CACHE_MAX = 4096
+
+
+def learned_capacity(engine, cap_key) -> int | None:
+    cache = getattr(engine, "_join_capacity_cache", None)
+    if cap_key is None or cache is None:
+        return None
+    with _CAPACITY_LOCK:
+        return cache.get(cap_key)
+
+
+def remember_capacity(engine, cap_key, capacity: int) -> None:
+    cache = getattr(engine, "_join_capacity_cache", None)
+    if cap_key is None or cache is None:
+        return
+    with _CAPACITY_LOCK:
+        if len(cache) >= _CAPACITY_CACHE_MAX:
+            cache.clear()  # rare; bounded, not LRU-precise
+        cache[cap_key] = capacity
+
+
+def _retry_counter(engine):
+    """pixie_join_capacity_retries_total: overflow-retry kernel re-runs
+    (each costs a fresh jit compile mid-query). The bench gate asserts
+    this stays 0 on the standard shapes — the sketch estimate plus the
+    learned-capacity cache should make retries exceptional."""
+    tracer = getattr(engine, "tracer", None)
+    if tracer is not None:
+        reg = tracer.registry
+    else:  # engine stand-ins (tests) and direct driver calls
+        from ..services.observability import default_registry as reg
+    return reg.counter(
+        "pixie_join_capacity_retries_total",
+        "Device-join output-capacity overflow retries (kernel re-runs "
+        "at a doubled capacity, one fresh jit compile each)",
+    )
+
+
+def estimate_join_capacity(probe_rows: int, build: JoinSideStats | None,
+                           probe: JoinSideStats | None, how: str,
+                           overlap: float | None = None) -> int:
+    """Estimated output rows for ``probe_rows`` probe rows against the
+    build side: fan-out = build_rows / NDV(build), scaled by the zone
+    overlap fraction of the probe range (keys outside the build side's
+    [lo, hi] cannot match). ``overlap`` overrides the fraction when the
+    caller knows it more precisely (the windowed driver passes the WORST
+    surviving window's overlap — the whole-probe fraction would shrink
+    the per-window estimate for clustered probes whose surviving windows
+    each overlap fully). Conservative where sketches are missing."""
+    from ..config import get_flag
+
+    safety = float(get_flag("join_capacity_safety"))
+    if build is None or not build.ndv:
+        # No build stats: the historical default (2x probe rows).
+        return bucket_capacity(max(2 * probe_rows, 1))
+    fanout = build.rows / max(build.ndv, 1)
+    if overlap is None:
+        overlap = 1.0
+        if (
+            probe is not None and probe.lo is not None
+            and probe.hi is not None
+            and build.lo is not None and build.hi is not None
+            and probe.hi > probe.lo
+        ):
+            inter = min(probe.hi, build.hi) - max(probe.lo, build.lo) + 1
+            overlap = max(0.0, min(1.0, inter / (probe.hi - probe.lo + 1)))
+    est = probe_rows * fanout * overlap
+    if how in ("left", "outer"):
+        est = max(est, probe_rows)  # unmatched rows still emit
+    return bucket_capacity(max(int(est * safety) + 1, 1 << 10))
+
+
+# -- strategy choice ---------------------------------------------------------
+@dataclass
+class JoinDecision:
+    """Routing outcome, recorded on ``engine.last_join_decision`` so
+    bench and tests can see which strategy served a query."""
+
+    strategy: str  # degenerate|host_dict|host_hash|single|sorted|radix
+    swap: bool = False  # probe the RIGHT side (inner only)
+    capacity: int | None = None  # initial output capacity (per window)
+    window_rows: int = 0  # probe rows per dispatch (windowed paths)
+    zone_skip: bool = False
+    retries: int = 0  # overflow retries actually paid
+    skipped_windows: int = 0
+    reason: str = ""
+
+
+def choose_join_strategy(left: HostBatch, right: HostBatch, op: JoinOp,
+                         engine=None, left_stats=None, right_stats=None,
+                         device_only: bool = False) -> JoinDecision:
+    """Pick the N:M execution strategy from shape, backend and sketches.
+
+    The host-dict (small unique-key) and degenerate (empty-side) routes
+    are resolved by the dispatcher before this is called; this chooses
+    among the bulk N:M paths. ``device_only`` skips the CPU-backend
+    host-hash route — direct ``_join_device`` callers (tests, forced
+    device runs) always get a device kernel. See docs/JOINS.md for the
+    matrix.
+    """
+    import jax
+
+    from ..config import get_flag
+
+    forced = str(get_flag("join_strategy"))
+    window_rows = int(get_flag("join_probe_window_rows"))
+    radix_bits = int(get_flag("join_radix_bits"))
+    zone_skip = bool(get_flag("join_zone_skip"))
+    tpu = jax.default_backend() == "tpu"
+
+    if not device_only and op.how in ("inner", "left") and (
+        forced == "host" or (forced == "auto" and not tpu)
+    ):
+        # XLA CPU sorts make the device kernels a regression there; the
+        # native build+probe hash join is the CPU-backend fast path.
+        return JoinDecision(
+            strategy="host_hash", zone_skip=zone_skip,
+            reason="cpu backend" if forced == "auto" else "forced",
+        )
+
+    # Build-side swap (inner only: left/right/outer pin the null side).
+    # Cost model: the build side is sorted/partitioned and resident for
+    # the whole query, the probe side streams — so build = the side with
+    # the LOWER rows x log2(NDV) sort cost, with hysteresis (4x) so
+    # near-balanced inputs keep the stable left-probe order.
+    swap = False
+    if op.how == "inner" and right.length > 4 * left.length:
+        import math
+
+        def score(n_rows, st):
+            ndv = st.ndv if st is not None and st.ndv else max(n_rows, 2)
+            return n_rows * math.log2(max(ndv, 2))
+
+        swap = score(left.length, left_stats) < score(right.length,
+                                                      right_stats) / 4
+    probe_rows = right.length if swap else left.length
+
+    windowable = (
+        op.how in ("inner", "left") and window_rows > 0
+        and probe_rows > window_rows
+    )
+    if forced in ("sorted", "radix", "single"):
+        strategy = forced
+        if forced != "single" and op.how not in ("inner", "left"):
+            strategy = "single"  # right/outer need the global kernel
+    elif not windowable:
+        strategy = "single"
+    else:
+        build_rows = left.length if swap else right.length
+        strategy = (
+            "radix"
+            if radix_bits > 0 and build_rows >= RADIX_MIN_BUILD_ROWS
+            else "sorted"
+        )
+    return JoinDecision(
+        strategy=strategy, swap=swap and strategy != "single",
+        window_rows=window_rows, zone_skip=zone_skip,
+        reason="forced" if forced != "auto" else "auto",
+    )
+
 
 def _join_dispatch(left: HostBatch, right: HostBatch, op: JoinOp,
-                   engine=None) -> HostBatch:
-    """Route a join to the host N:1 path or the device N:M kernel.
+                   engine=None, left_stats=None, right_stats=None,
+                   cap_key=None) -> HostBatch:
+    """Route a join: host N:1 dict, native host hash, or a device
+    kernel strategy chosen by ``choose_join_strategy``.
 
     Reference: ``equijoin_node.cc`` always hash-joins; here small unique-
     key inner/left joins (the post-agg common case) stay on host, and
-    everything else uses ``pixie_tpu.ops.join.device_join``. ``engine``
-    (when the call comes from a query) carries the pipeline depth and
-    the per-query cancel handle into the windowed device driver.
+    everything else routes by shape/backend/sketches. ``engine`` (when
+    the call comes from a query) carries the pipeline depth and the
+    per-query cancel handle into the windowed device drivers;
+    ``left_stats``/``right_stats`` are ingest-sketch
+    :class:`JoinSideStats`; ``cap_key`` keys the learned-capacity cache.
     """
     if len(op.left_on) != len(op.right_on):
         raise QueryError("join key arity mismatch")
     small = left.length + right.length < DEVICE_JOIN_MIN_ROWS
     if op.how in ("inner", "left") and small:
         try:
-            return _join_host(left, right, op)
+            out = _join_host(left, right, op)
+            if engine is not None:
+                engine.last_join_decision = JoinDecision(
+                    strategy="host_dict", reason="small unique-key build"
+                )
+            return out
         except _BuildNotUnique:
-            pass  # N:M fan-out -> device kernel
+            pass  # N:M fan-out -> bulk strategies
     if left.length == 0 or right.length == 0:
+        if engine is not None:
+            engine.last_join_decision = JoinDecision(
+                strategy="degenerate", reason="empty side"
+            )
         return _join_degenerate(left, right, op)
-    import jax
 
-    if op.how in ("inner", "left") and jax.default_backend() != "tpu":
-        # XLA CPU sorts make the device kernel a regression there; the
-        # vectorized numpy N:M join is the CPU-backend fast path.
-        return _join_host_nm(left, right, op)
-    return _join_device(left, right, op, engine)
+    decision = choose_join_strategy(
+        left, right, op, engine, left_stats, right_stats
+    )
+    if engine is not None:
+        engine.last_join_decision = decision
+    if decision.strategy == "host_hash":
+        return _join_host_nm(left, right, op, right_stats, decision)
+    return _join_device(left, right, op, engine, decision,
+                        left_stats, right_stats, cap_key)
 
 
 class _BuildNotUnique(Exception):
@@ -234,18 +536,60 @@ def _probe_sorted_cache(n_build_cap, n_probe_cap, capacity, how):
     )
 
 
+@functools.lru_cache(maxsize=64)
+def _radix_probe_cache(n_build_cap, n_probe_cap, capacity, how, radix_bits,
+                       steps):
+    """One jitted radix-partitioned probe kernel per (bucketed shapes,
+    capacity, how, partition count, search depth); the partitioned build
+    keys and offsets are runtime args — see ``_probe_sorted_cache``."""
+    import jax
+
+    from ..ops.join import radix_probe_join
+
+    return jax.jit(
+        lambda sbk, starts, pk, pv: radix_probe_join(
+            sbk, starts, pk, pv, capacity, how, radix_bits, steps
+        )
+    )
+
+
+def _window_zones(keys: np.ndarray, window_rows: int):
+    """(lo[W], hi[W]) per probe window — one vectorized pass (the
+    windowed drivers' exact zone maps; ingest sketches only gate whether
+    this pass is worth running)."""
+    n = len(keys)
+    offs = np.arange(0, n, window_rows)
+    return (
+        np.minimum.reduceat(keys, offs),
+        np.maximum.reduceat(keys, offs),
+    )
+
+
 def _join_device_windowed(left: HostBatch, right: HostBatch, op: JoinOp,
-                          window_rows: int, engine=None) -> HostBatch:
+                          window_rows: int, engine=None, decision=None,
+                          left_stats=None, right_stats=None,
+                          cap_key=None) -> HostBatch:
     """Multi-window device join driver (inner/left N:M).
 
-    The build side is packed to comparable int64 key ids, sorted, and
-    staged on device ONCE per query (the fused-join ``__side__``
-    discipline: a query-constant table rides as a reused runtime arg,
-    never re-``device_put`` per window). Probe windows then stream
-    through the window-prefetch pipeline, so staging window N+1 overlaps
-    the join kernel on window N. Output rows are bit-identical to the
-    single-shot kernel's: windows emit in probe order, and matches
-    within a probe row follow build order on both paths.
+    The build side is packed to comparable int64 key ids, then sorted
+    (``strategy="sorted"``) or radix-partitioned by splitmix64 hash
+    (``strategy="radix"``) and staged on device ONCE per query (the
+    fused-join ``__side__`` discipline: a query-constant table rides as
+    a reused runtime arg, never re-``device_put`` per window). Probe
+    windows then stream through the window-prefetch pipeline, so staging
+    window N+1 overlaps the join kernel on window N. Without a build-
+    side swap, output rows are bit-identical to the single-shot
+    kernel's: windows emit in probe order, and matches within a probe
+    row follow build order on every path (both partitionings are stable
+    on equal keys). A swap emits the same row multiset in build-major
+    order instead — joins carry no row-order contract.
+
+    Sketch guidance: the initial output capacity comes from the learned
+    per-plan cache, else the NDV-based cardinality estimate — NOT a
+    fixed guess climbing the overflow-doubling ladder; windows whose key
+    zone cannot intersect the build side are never staged (inner skips
+    them outright, left emits their null rows host-side); ``decision``
+    may swap probe/build for inner joins.
     """
     import jax
 
@@ -253,6 +597,8 @@ def _join_device_windowed(left: HostBatch, right: HostBatch, op: JoinOp,
     from .pipeline import WindowPipeline
     from .stream import _block_if, _timed
 
+    if decision is None:
+        decision = JoinDecision(strategy="sorted", window_rows=window_rows)
     # Under analyze, the join gets its own stage breakdown (stage /
     # compute / stall) like every other window consumer.
     qstats = getattr(engine, "_query_stats", None) if engine is not None \
@@ -262,32 +608,143 @@ def _join_device_windowed(left: HostBatch, right: HostBatch, op: JoinOp,
     l_remap, r_remap, key_dicts = _align_join_dicts(left, right, op)
     lkeys, rkeys = _packed_key_ids(left, op.left_on, l_remap,
                                    right, op.right_on, r_remap)
-    order = np.argsort(rkeys, kind="stable")
-    rb = len(order)
+    swap = bool(decision.swap)
+    if swap and op.how != "inner":
+        raise QueryError("join build-side swap is inner-only")
+    pkeys, bkeys = (rkeys, lkeys) if swap else (lkeys, rkeys)
+    n_probe = len(pkeys)
+    bstats = left_stats if swap else right_stats
+    if (
+        bstats is None or not bstats.ndv or len(op.left_on) > 1
+        or l_remap or r_remap
+    ):
+        # Multi-plane keys were re-packed into dense ids, and divergent
+        # string dictionaries were remapped into a merged id space —
+        # table sketches describe RAW values, so their zone bounds no
+        # longer apply; rescan the packed ids (rows/NDV survive either
+        # transform, bounds do not).
+        bstats = _scan_side_stats(bkeys)
+    elif bstats.rows > len(bkeys):
+        import dataclasses
+
+        # Sketch rows are table-lifetime counts (expiry/filters shrink
+        # the materialized batch); fan-out comes from live rows.
+        bstats = dataclasses.replace(bstats, rows=len(bkeys))
+
+    rb = len(bkeys)
     nb = bucket_capacity(rb)
     sentinel = np.iinfo(np.int64).max  # sorts past every real key
     sbk = np.full(nb, sentinel, dtype=np.int64)
-    sbk[:rb] = rkeys[order]
-    sbk_dev = jax.device_put(sbk)  # staged once; reused by every window
-    rb_s = np.int32(rb)
+    if decision.strategy == "radix":
+        from ..ops.join import radix_partition_build
 
-    wcap = bucket_capacity(min(window_rows, left.length))
+        radix_bits = int(get_flag("join_radix_bits"))
+        order, part_starts, steps = radix_partition_build(bkeys, radix_bits)
+        sbk[:rb] = bkeys[order]
+        sbk_dev = jax.device_put(sbk)  # staged once; reused by every window
+        starts_dev = jax.device_put(part_starts)
+
+        def probe_fn(cap):
+            fn = _radix_probe_cache(
+                nb, wcap, cap, op.how, radix_bits, steps
+            )
+            return lambda pk_dev, pv_dev: fn(
+                sbk_dev, starts_dev, pk_dev, pv_dev
+            )
+    else:
+        order = np.argsort(bkeys, kind="stable")
+        sbk[:rb] = bkeys[order]
+        sbk_dev = jax.device_put(sbk)
+        rb_s = np.int32(rb)
+
+        def probe_fn(cap):
+            fn = _probe_sorted_cache(nb, wcap, cap, op.how)
+            return lambda pk_dev, pv_dev: fn(sbk_dev, rb_s, pk_dev, pv_dev)
+
+    wcap = bucket_capacity(min(window_rows, n_probe))
+    n_windows = (n_probe + window_rows - 1) // window_rows
+
+    # Zone-map window skipping: a probe window whose [min, max] cannot
+    # intersect the build side's key range joins nothing — inner skips
+    # it outright (the prefetch thread never stages it), left emits its
+    # null rows host-side with zero device work.
+    skip = np.zeros(n_windows, dtype=bool)
+    build_lo = int(bkeys.min()) if rb else 0
+    build_hi = int(bkeys.max()) if rb else 0
+    window_overlap = None  # worst surviving window's zone overlap
+    if n_windows > 1:
+        # Per-window zones feed BOTH decisions (one cheap pass): which
+        # windows to skip (zone_skip flag), and the capacity estimate's
+        # overlap fraction — which must cover the worst WINDOW, not the
+        # probe-wide average (for clustered probes most windows miss
+        # the build range entirely while the live ones overlap it
+        # almost fully; the whole-probe fraction would understate them
+        # whether or not skipping is enabled).
+        wlo, whi = _window_zones(pkeys, window_rows)
+        if decision.zone_skip:
+            skip = (whi < build_lo) | (wlo > build_hi)
+            decision.skipped_windows = int(skip.sum())
+        live = ~skip
+        if live.any():
+            span = np.maximum(whi[live] - wlo[live] + 1, 1)
+            inter = (
+                np.minimum(whi[live], build_hi)
+                - np.maximum(wlo[live], build_lo) + 1
+            )
+            window_overlap = float(
+                np.clip(inter / span, 0.0, 1.0).max()
+            )
+
+    def stage_window(off):
+        m = min(window_rows, n_probe - off)
+        pk = np.full(wcap, sentinel, dtype=np.int64)
+        pk[:m] = pkeys[off:off + m]
+        pv = np.zeros(wcap, dtype=bool)
+        pv[:m] = True
+        return m, jax.device_put(pk), jax.device_put(pv)
 
     def staged_probe_windows():
-        for off in range(0, left.length, window_rows):
-            m = min(window_rows, left.length - off)
+        for w in range(n_windows):
+            if skip[w]:
+                continue
+            off = w * window_rows
+            m = min(window_rows, n_probe - off)
             with _timed(stats, "stage", rows=m):
-                pk = np.full(wcap, sentinel, dtype=np.int64)
-                pk[:m] = lkeys[off:off + m]
-                pv = np.zeros(wcap, dtype=bool)
-                pv[:m] = True
-                pk_dev, pv_dev = jax.device_put(pk), jax.device_put(pv)
+                _, pk_dev, pv_dev = stage_window(off)
                 _block_if(stats, (pk_dev, pv_dev))
             if stats is not None:
                 stats.rows_in += m
             yield off, pk_dev, pv_dev
 
-    parts = []  # (l_idx, l_take, r_idx, r_take) per window
+    # Initial capacity: learned (this plan overflowed before — start at
+    # the rung it settled on), else the sketch estimate. Each overflow
+    # retry costs a fresh jit compile MID-query, so getting this right
+    # is worth more than the capacity estimate's few percent of error.
+    probe_side = JoinSideStats(
+        rows=n_probe,
+        lo=int(pkeys.min()) if n_probe else None,
+        hi=int(pkeys.max()) if n_probe else None,
+        origin="scan",
+    )
+    # Namespace the learned rung by execution mode + window size: a
+    # windowed rung is PER WINDOW, a single-shot rung covers the whole
+    # output — cross-seeding them either overallocates every window or
+    # guarantees a re-climb when the same plan flips paths.
+    cap_key = None if cap_key is None else ("windowed", window_rows, cap_key)
+    capacity = learned_capacity(engine, cap_key)
+    if capacity is None:
+        # Clamp the ESTIMATE (a skew blowup would allocate absurd
+        # expansion buffers); a learned value is never clamped — it was
+        # reached by real doublings and re-clamping would re-climb.
+        capacity = min(
+            estimate_join_capacity(
+                min(window_rows, n_probe), bstats, probe_side, op.how,
+                overlap=window_overlap,
+            ),
+            bucket_capacity(max(2 * window_rows, 1) * 64),
+        )
+
+    parts: dict = {}  # off -> (probe_idx, probe_take, build_row, build_take)
     depth = (
         engine.pipeline_depth if engine is not None
         else get_flag("pipeline_depth")
@@ -296,44 +753,78 @@ def _join_device_windowed(left: HostBatch, right: HostBatch, op: JoinOp,
         staged_probe_windows(), depth,
         cancel=getattr(engine, "_cancel", None), stats=stats,
     )
-    # Capacity persists across windows: once one window's fan-out forces
-    # a doubling, later windows start there instead of re-overflowing.
-    capacity = bucket_capacity(max(2 * window_rows, 1))
+
+    def compact(off, p_idx, p_take, b_idx, b_take, out_valid):
+        sel = np.nonzero(out_valid)[0]
+        parts[off] = (
+            p_idx[sel].astype(np.int64) + off,
+            p_take[sel],
+            order[np.clip(b_idx[sel], 0, max(rb - 1, 0))],
+            b_take[sel],
+        )
+
+    counter = _retry_counter(engine)
     try:
+        run = probe_fn(capacity)
         for off, pk_dev, pv_dev in pipe:
             with _timed(stats, "compute"):
                 while True:
-                    fn = _probe_sorted_cache(nb, wcap, capacity, op.how)
+                    # The per-window readback is the driver's consume
+                    # step: compacting each window host-side bounds
+                    # memory to one window's capacity, and the overflow
+                    # flag rides in the same batch (no extra sync, no
+                    # per-window bool(overflow) readback).
                     p_idx, p_take, b_idx, b_take, out_valid, overflow = (
-                        np.asarray(a)
-                        for a in fn(sbk_dev, rb_s, pk_dev, pv_dev)
+                        np.asarray(a) for a in run(pk_dev, pv_dev)  # pxlint: disable=host-sync-hot-path
                     )
                     if not bool(overflow):
                         break
+                    # Estimate/learned rung was wrong: double, recompile
+                    # (counted — the bench gate wants this at zero), and
+                    # keep the larger capacity for every later window.
                     capacity *= 2
+                    counter.inc()
+                    decision.retries += 1
+                    run = probe_fn(capacity)
             if stats is not None:
                 stats.windows += 1
-            sel = np.nonzero(out_valid)[0]
-            parts.append((
-                p_idx[sel].astype(np.int64) + off,
-                p_take[sel],
-                order[np.clip(b_idx[sel], 0, max(rb - 1, 0))],
-                b_take[sel],
-            ))
+            compact(off, p_idx, p_take, b_idx, b_take, out_valid)
     finally:
         pipe.close()
         if engine is not None:
             engine._note_pipeline(pipe)
 
-    def cat(i, dtype):
-        if not parts:
-            return np.zeros(0, dtype=dtype)
-        return np.concatenate([p[i] for p in parts]).astype(dtype, copy=False)
+    if op.how == "left":
+        # Zone-skipped windows of a left join still emit one null-right
+        # row per probe row — assembled host-side, no device dispatch.
+        for w in np.nonzero(skip)[0]:
+            off = int(w) * window_rows
+            m = min(window_rows, n_probe - off)
+            parts[off] = (
+                np.arange(off, off + m, dtype=np.int64),
+                np.ones(m, dtype=bool),
+                np.zeros(m, dtype=np.int64),
+                np.zeros(m, dtype=bool),
+            )
+    remember_capacity(engine, cap_key, capacity)
 
+    ordered = [parts[off] for off in sorted(parts)]
+
+    def cat(i, dtype):
+        if not ordered:
+            return np.zeros(0, dtype=dtype)
+        return np.concatenate([p[i] for p in ordered]).astype(
+            dtype, copy=False
+        )
+
+    p_all = (cat(0, np.int64), cat(1, bool))
+    b_all = (cat(2, np.int64), cat(3, bool))
+    l_idx, l_take = (b_all if swap else p_all)
+    r_idx, r_take = (p_all if swap else b_all)
     out_rel, src = _join_out_schema(left, right, op)
     out = _assemble_join(
         left, right, op, out_rel, src,
-        cat(0, np.int64), cat(1, bool), cat(2, np.int64), cat(3, bool),
+        l_idx, l_take, r_idx, r_take,
         r_remap=r_remap, key_dicts=key_dicts,
     )
     if stats is not None:
@@ -342,16 +833,29 @@ def _join_device_windowed(left: HostBatch, right: HostBatch, op: JoinOp,
 
 
 def _join_device(left: HostBatch, right: HostBatch, op: JoinOp,
-                 engine=None) -> HostBatch:
+                 engine=None, decision=None, left_stats=None,
+                 right_stats=None, cap_key=None) -> HostBatch:
     """N:M device join: pad to bucketed capacities, run the sort-based
-    kernel, re-run doubled on overflow, gather columns host-side."""
+    kernel at the sketch-estimated (or learned) capacity, re-run doubled
+    on overflow (counted), gather columns host-side. Large windowable
+    probes route to the windowed drivers instead."""
     from ..config import get_flag
 
+    if decision is None or decision.strategy == "host_hash":
+        decision = choose_join_strategy(
+            left, right, op, engine, left_stats, right_stats,
+            device_only=True,
+        )
+        if engine is not None:
+            engine.last_join_decision = decision
     probe_window = get_flag("join_probe_window_rows")
+    probe_rows = right.length if decision.swap else left.length
     if (
-        op.how in ("inner", "left")
+        decision.strategy in ("sorted", "radix")
+        and op.how in ("inner", "left")
         and probe_window > 0
-        and left.length > probe_window
+        and probe_rows > probe_window
+        and left.length > 0
         and right.length > 0
     ):
         # Same key-dtype guard as the single-shot path below — the
@@ -363,9 +867,13 @@ def _join_device(left: HostBatch, right: HostBatch, op: JoinOp,
                     raise QueryError(
                         f"join key dtype mismatch: {rp_.dtype} vs {lp_.dtype}"
                     )
-        # Windowable joins with a big probe side: sorted build staged
-        # once, probe windows pipelined (one dispatch per window).
-        return _join_device_windowed(left, right, op, probe_window, engine)
+        # Windowable joins with a big probe side: sorted/partitioned
+        # build staged once, probe windows pipelined (one dispatch per
+        # window).
+        return _join_device_windowed(
+            left, right, op, probe_window, engine, decision,
+            left_stats, right_stats, cap_key,
+        )
     l_remap, r_remap, key_dicts = _align_join_dicts(left, right, op)
     probe_planes = _join_key_planes(left, op.left_on, l_remap)
     build_planes = _join_key_planes(right, op.right_on, r_remap)
@@ -389,7 +897,44 @@ def _join_device(left: HostBatch, right: HostBatch, op: JoinOp,
     pv = np.zeros(np_, dtype=bool)
     pv[: left.length] = True
 
-    capacity = bucket_capacity(max(left.length + right.length, 1))
+    # Initial capacity: learned rung, else the NDV-based estimate, else
+    # the historical probe+build default. right/outer append one extra
+    # row per unmatched build row past the pair region. The rung is
+    # namespaced: single-shot capacities cover the WHOLE output, never
+    # interchangeable with the windowed drivers' per-window rungs.
+    cap_key = None if cap_key is None else ("single", cap_key)
+    capacity = learned_capacity(engine, cap_key)
+    if capacity is None:
+        if right_stats is not None and right_stats.ndv:
+            import dataclasses
+
+            # Sketch rows are table-LIFETIME counts (expiry never
+            # decrements; filters shrink the batch further) — fan-out
+            # must come from the rows actually materialized, or a
+            # churned streaming table inflates the estimate without
+            # bound. Divergent string dictionaries were remapped into a
+            # merged id space, so the sketches' zone bounds are
+            # raw-space and only the NDV/rows half applies there.
+            remapped = bool(l_remap or r_remap)
+            capacity = estimate_join_capacity(
+                left.length,
+                dataclasses.replace(
+                    right_stats, rows=min(right_stats.rows, right.length)
+                ),
+                left_stats, op.how,
+                overlap=1.0 if remapped else None,
+            )
+            if op.how in ("right", "outer"):
+                capacity = bucket_capacity(capacity + right.length)
+            # Clamp to the theoretical maximum output (every probe row
+            # matching every build row) — stale stats must never drive
+            # an allocation past what the data could produce.
+            capacity = min(
+                capacity, bucket_capacity(max(left.length, 1) * right.length)
+            )
+        else:
+            capacity = bucket_capacity(max(left.length + right.length, 1))
+    counter = _retry_counter(engine)
     while True:
         fn = _device_join_cache(
             nb, np_, tuple(str(p.dtype) for p in bk), capacity, op.how
@@ -400,6 +945,9 @@ def _join_device(left: HostBatch, right: HostBatch, op: JoinOp,
         if not bool(overflow):
             break
         capacity *= 2
+        counter.inc()
+        decision.retries += 1
+    remember_capacity(engine, cap_key, capacity)
 
     sel = np.nonzero(out_valid)[0]
     out_rel, src = _join_out_schema(left, right, op)
@@ -438,16 +986,59 @@ def _join_host(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
     return _assemble_join_host(left, right, op, l_idx, r_idx)
 
 
-def _join_host_nm(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
+def _join_host_nm(left: HostBatch, right: HostBatch, op: JoinOp,
+                  right_stats=None, decision=None) -> HostBatch:
     """N:M inner/left equijoin on host — the CPU-backend analog of the
     device kernel (XLA CPU sorts are too slow to route big joins through
     the device path there). The native O(n) build+probe hash join
     (native/hash_join.cc) carries the bulk; the vectorized numpy
-    sort/searchsorted form is the no-toolchain fallback."""
+    sort/searchsorted form is the no-toolchain fallback.
+
+    Zone pre-filter (the host analog of the windowed drivers' window
+    skipping): rows whose key lies outside the other side's [min, max]
+    cannot join — inner drops them from the probe, both hows drop them
+    from the build, so a selective join hashes only the overlap."""
     l_remap, r_remap, _ = _align_join_dicts(left, right, op)
     lk = _packed_key_ids(left, op.left_on, l_remap,
                          right, op.right_on, r_remap)
     lkeys, rkeys = lk
+
+    sel_l = sel_r = None  # compressed-row -> original-row maps
+    if (
+        decision is not None and decision.zone_skip
+        and len(lkeys) and len(rkeys)
+    ):
+        llo, lhi = int(lkeys.min()), int(lkeys.max())
+        rlo, rhi = int(rkeys.min()), int(rkeys.max())
+        if op.how == "inner" and (llo < rlo or lhi > rhi):
+            keep = (lkeys >= rlo) & (lkeys <= rhi)
+            if int(keep.sum()) < int(0.9 * len(lkeys)):
+                sel_l = np.nonzero(keep)[0]
+                lkeys = lkeys[sel_l]
+        if rlo < llo or rhi > lhi:
+            keep = (rkeys >= llo) & (rkeys <= lhi)
+            if int(keep.sum()) < int(0.9 * len(rkeys)):
+                sel_r = np.nonzero(keep)[0]
+                rkeys = rkeys[sel_r]
+
+    def _emit(l_idx, r_idx):
+        if sel_l is not None:
+            l_idx = sel_l[l_idx]
+        if sel_r is not None and len(sel_r):
+            r_idx = np.where(r_idx >= 0, sel_r[np.clip(r_idx, 0, None)], -1)
+        return _assemble_join_host(left, right, op, l_idx, r_idx)
+
+    if op.how == "left" and len(lkeys) and not len(rkeys):
+        # Pre-filter emptied the build side: every probe row is
+        # unmatched (the generic path below assumes a non-empty build).
+        return _emit(
+            np.arange(left.length, dtype=np.int64),
+            np.full(left.length, -1, dtype=np.int64),
+        )
+    if op.how == "inner" and (not len(lkeys) or not len(rkeys)):
+        return _emit(
+            np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
+        )
 
     from ..native import hash_join_call
 
@@ -455,10 +1046,7 @@ def _join_host_nm(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
         native = hash_join_call(rkeys, lkeys, left_outer=(op.how == "left"))
         if native is not None:
             l_idx, r_idx = native
-            return _assemble_join_host(
-                left, right, op,
-                l_idx.astype(np.int64), r_idx.astype(np.int64),
-            )
+            return _emit(l_idx.astype(np.int64), r_idx.astype(np.int64))
     order = np.argsort(rkeys, kind="stable")
     span = 0
     if len(rkeys) and len(lkeys):
@@ -486,7 +1074,7 @@ def _join_host_nm(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
     total = int(counts.sum())
     starts = np.zeros(len(counts) + 1, dtype=np.int64)
     np.cumsum(counts, out=starts[1:])
-    l_idx = np.repeat(np.arange(left.length, dtype=np.int64), counts)
+    l_idx = np.repeat(np.arange(len(lkeys), dtype=np.int64), counts)
     within = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1], counts)
     if len(rkeys):
         r_idx = order[
@@ -496,7 +1084,7 @@ def _join_host_nm(left: HostBatch, right: HostBatch, op: JoinOp) -> HostBatch:
         r_idx = np.full(total, -1, dtype=np.int64)
     if op.how == "left" and len(rkeys):
         r_idx = np.where(np.repeat(unmatched, counts), -1, r_idx)
-    return _assemble_join_host(left, right, op, l_idx, r_idx)
+    return _emit(l_idx, r_idx)
 
 
 def _packed_key_ids(left, left_on, l_remap, right, right_on, r_remap):

@@ -63,6 +63,10 @@ PXLINT_HOT_REGIONS = (
     "exec/engine.py:Engine._staged_windows*",
     "exec/engine.py:Engine._windows",
     "exec/engine.py:Engine._stage",
+    # Windowed device-join drivers: their per-window loops ride the same
+    # prefetch pipeline; an unjustified host sync there serializes the
+    # probe stream exactly like one in the fold loops.
+    "exec/joins.py:_join_device_windowed*",
 )
 
 

@@ -57,6 +57,24 @@ class AggExpr:
     args: tuple  # tuple[Expr]; evaluated pre-aggregation
 
 
+def trace_map_renames(map_op: "MapOp", mapping: dict) -> dict | None:
+    """One reverse step of column-provenance tracing through a MapOp:
+    remap each tracked (output name -> current name) entry through the
+    map's exprs, or None when any tracked column is computed rather
+    than a pure ``ColumnRef`` — upstream statistics (ingest sketches)
+    then no longer describe its values. Shared by the executor's join
+    stream walk and the planner's plan walk so the two can never
+    disagree about when sketches apply."""
+    exprs = dict(map_op.exprs)
+    new = {}
+    for out, src in mapping.items():
+        e = exprs.get(src)
+        if not isinstance(e, ColumnRef):
+            return None
+        new[out] = e.name
+    return new
+
+
 # -- operators ---------------------------------------------------------------
 class Op:
     pass

@@ -525,6 +525,7 @@ def stream_query(
         registry=engine.registry,
         now_ns=now_ns,
         max_output_rows=max_output_rows or (1 << 62),
+        table_stats=engine._compile_table_stats(),
     )
     compiled = compile_pxl(query, state)
     return StreamingQuery(engine, compiled.plan, emit, cancel=cancel,

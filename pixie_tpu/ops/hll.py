@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 DEFAULT_P = 10  # 2^10 = 1024 registers, ~3.25% relative error
 
@@ -64,3 +65,61 @@ def hll_estimate(registers):
     linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
     est = jnp.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)
     return jnp.round(est).astype(jnp.int64)
+
+
+# -- host (numpy) mirror -----------------------------------------------------
+# The table-store ingest sketches (``table_store/sketches.py``) maintain
+# ONE register row per key column on the append path, where a jax
+# dispatch per pushed batch would dominate the sketch's cost. These
+# mirrors compute bit-identical registers/estimates to the device
+# kernels above (same splitmix64, same rho, same estimator constants),
+# so a host-maintained sketch can be merged with (or checked against)
+# device-produced registers freely.
+
+
+def splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Numpy splitmix64 — bit-identical to ``_splitmix64`` above."""
+    with np.errstate(over="ignore"):
+        x = x.astype(np.uint64, copy=False) + np.uint64(0x9E3779B97F4A7C15)
+        z = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def hll_init_np(p: int = DEFAULT_P) -> np.ndarray:
+    return np.zeros(1 << p, dtype=np.int32)
+
+
+def hll_update_np(registers: np.ndarray, values: np.ndarray,
+                  p: int = DEFAULT_P) -> np.ndarray:
+    """Fold ``values`` (any integer dtype) into one register row in place."""
+    m = len(registers)
+    h = splitmix64_np(values.astype(np.int64, copy=False).view(np.uint64))
+    idx = (h & np.uint64(m - 1)).astype(np.int64)
+    w = h >> np.uint64(p)
+    # rho = leading-zero rank of the remaining 64-p bits, exact (no
+    # float log2 — see module docstring).
+    nz = w > 0
+    hibit = np.zeros(len(w), dtype=np.int32)
+    ww = w.copy()
+    for s in (32, 16, 8, 4, 2, 1):
+        m2 = ww >> np.uint64(s)
+        take = m2 > 0
+        hibit += take.astype(np.int32) * s
+        ww = np.where(take, m2, ww)
+    rho = np.where(nz, 64 - p - hibit, 64 - p + 1).astype(np.int32)
+    np.maximum.at(registers, idx, rho)
+    return registers
+
+
+def hll_estimate_np(registers: np.ndarray) -> int:
+    """Scalar estimate from one register row — same math as
+    ``hll_estimate`` (alpha, small-range linear counting)."""
+    m = len(registers)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    inv_sum = float(np.sum(np.exp2(-registers.astype(np.float64))))
+    raw = alpha * m * m / inv_sum
+    zeros = float(np.sum(registers == 0))
+    if raw <= 2.5 * m and zeros > 0:
+        return int(round(m * np.log(m / max(zeros, 1.0))))
+    return int(round(raw))

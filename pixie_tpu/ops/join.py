@@ -27,8 +27,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .groupby import dense_group_ids, dense_group_ids_hash
+from .hashtable import _mix64, _mix64_j
 from .scan import blocked_cumsum
 
 
@@ -96,9 +98,6 @@ def probe_sorted_join(
     """
     if how not in ("inner", "left"):
         raise ValueError(f"probe_sorted_join supports inner/left, not {how!r}")
-    b = sorted_build_keys.shape[0]
-    n = probe_valid.shape[0]
-    c = capacity
     nb = jnp.asarray(n_build, dtype=jnp.int32)
     lo = jnp.minimum(
         jnp.searchsorted(sorted_build_keys, probe_keys, side="left"), nb
@@ -106,6 +105,17 @@ def probe_sorted_join(
     hi = jnp.minimum(
         jnp.searchsorted(sorted_build_keys, probe_keys, side="right"), nb
     ).astype(jnp.int32)
+    return _expand_ranges(
+        lo, hi, probe_valid, capacity, how, sorted_build_keys.shape[0]
+    )
+
+
+def _expand_ranges(lo, hi, probe_valid, capacity: int, how: str, b: int):
+    """Expand per-probe match ranges [lo, hi) into the fixed-capacity
+    (probe_idx, probe_take, build_idx, build_take, out_valid, overflow)
+    output — the shared back half of every probe-side kernel."""
+    n = probe_valid.shape[0]
+    c = capacity
     m = jnp.where(probe_valid, hi - lo, 0).astype(jnp.int32)
 
     e = jnp.maximum(m, 1) if how == "left" else m
@@ -132,6 +142,94 @@ def probe_sorted_join(
     return (
         probe_idx, pair_valid, build_idx, pair_valid & is_match,
         pair_valid, total_pairs > c,
+    )
+
+
+# -- radix-partitioned probe -------------------------------------------------
+def radix_partition_build(keys: np.ndarray, radix_bits: int):
+    """Host-side build partitioning for ``radix_probe_join``.
+
+    Hashes the packed int64 build keys with the splitmix64 mixer
+    (``ops/hashtable._mix64``) and sorts them by (top ``radix_bits`` of
+    the hash, key). Within a partition keys are ascending, so a probe
+    row binary-searches ONE partition instead of the whole build side —
+    log2(B/P) memory touches per probe instead of log2(B), against a
+    partition-sized working set.
+
+    Returns (order, part_starts, search_steps):
+      order        int64[B] — build-row permutation (sorted position ->
+                   original row), the analog of the sorted driver's
+                   ``np.argsort``.
+      part_starts  int32[P+1] — partition offsets into the sorted keys
+                   (real rows only; padding stays outside every range).
+      search_steps static trip count for the kernel's bounded binary
+                   search: enough for the LARGEST partition, bucketed up
+                   so one compiled program serves similar builds.
+    """
+    p = 1 << radix_bits
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    h = _mix64(keys.view(np.uint64))
+    part = (h >> np.uint64(64 - radix_bits)).astype(np.int64)
+    order = np.lexsort((keys, part)).astype(np.int64)
+    counts = np.bincount(part, minlength=p)
+    part_starts = np.zeros(p + 1, dtype=np.int64)
+    np.cumsum(counts, out=part_starts[1:])
+    # +1 step of slack over ceil(log2(max+1)): the branchless search
+    # no-ops once converged, so slack costs one gather, never wrongness.
+    steps = max(4, int(np.ceil(np.log2(int(counts.max()) + 2))) + 1)
+    return order, part_starts.astype(np.int32), steps
+
+
+def _bounded_searchsorted(a, keys, lo0, hi0, steps: int, side: str):
+    """Per-row binary search of ``keys`` into ``a`` restricted to
+    [lo0, hi0), with a STATIC trip count (extra steps no-op once
+    lo == hi — static shapes, no data-dependent control flow)."""
+    lo, hi = lo0, hi0
+    top = a.shape[0] - 1
+    for _ in range(steps):
+        mid = (lo + hi) >> 1
+        v = a[jnp.clip(mid, 0, top)]
+        go = (v < keys) if side == "left" else (v <= keys)
+        upd = lo < hi
+        lo = jnp.where(upd & go, mid + 1, lo)
+        hi = jnp.where(upd & ~go, mid, hi)
+    return lo
+
+
+def radix_probe_join(
+    sorted_build_keys,
+    part_starts,
+    probe_keys,
+    probe_valid,
+    capacity: int,
+    how: str = "inner",
+    radix_bits: int = 8,
+    search_steps: int = 24,
+):
+    """Probe one window against a radix-partitioned device build side.
+
+    The driver partitions the build side ONCE per query with
+    ``radix_partition_build`` and stages ``sorted_build_keys`` (int64[B],
+    padding = int64 max past the real rows) + ``part_starts`` (int32[P+1])
+    on device; each probe window hashes its keys with the same splitmix64
+    mixer, reads its partition's [start, end) range, and binary-searches
+    only that partition. Same output contract and ``how`` support
+    (inner/left) as ``probe_sorted_join``.
+    """
+    if how not in ("inner", "left"):
+        raise ValueError(f"radix_probe_join supports inner/left, not {how!r}")
+    h = _mix64_j(probe_keys.astype(jnp.uint64))
+    p = (h >> jnp.uint64(64 - radix_bits)).astype(jnp.int32)
+    lo0 = part_starts[p]
+    hi0 = part_starts[p + 1]
+    lo = _bounded_searchsorted(
+        sorted_build_keys, probe_keys, lo0, hi0, search_steps, "left"
+    )
+    hi = _bounded_searchsorted(
+        sorted_build_keys, probe_keys, lo0, hi0, search_steps, "right"
+    )
+    return _expand_ranges(
+        lo, hi, probe_valid, capacity, how, sorted_build_keys.shape[0]
     )
 
 

@@ -31,6 +31,13 @@ class CompilerState:
     now_ns: int = 0
     max_output_rows: int = 10_000
     max_groups: int = 4096
+    # Ingest-sketch statistics per table (``table_store/sketches.py``):
+    # {table: {"rows": int, "ndv": {col: estimated distinct values}}}.
+    # Optimizer rules consult them (e.g. eager aggregation sizes its
+    # partial agg's group capacity from the join key's NDV instead of a
+    # blind default that climbs the overflow-doubling ladder at run
+    # time). Estimates only — never correctness-bearing.
+    table_stats: dict = field(default_factory=dict)
 
     def __post_init__(self):
         if not self.now_ns:
@@ -107,7 +114,8 @@ def compile_pxl(query: str, state: CompilerState) -> CompiledScript:
             "px.export(df, ...) (or the script only defines functions — "
             "call one and display its result)"
         )
-    run_rules(builder.plan, state.max_output_rows)
+    run_rules(builder.plan, state.max_output_rows,
+              table_stats=state.table_stats)
     # Always-on static verification (see pixie_tpu/analysis): schema
     # propagation + column/dtype binding + topology invariants over the
     # rewritten plan, so a bad plan fails HERE with node provenance

@@ -41,7 +41,8 @@ _PLUCK_FUNCS = frozenset({"pluck", "pluck_float64", "pluck_int64"})
 ALL = None  # "requires every column" marker
 
 
-def run_rules(plan: Plan, max_output_rows: int = 10_000) -> Plan:
+def run_rules(plan: Plan, max_output_rows: int = 10_000,
+              table_stats: dict | None = None) -> Plan:
     prune_unreachable(plan)
     fold_constants(plan)
     prune_noop_filters(plan)
@@ -52,7 +53,7 @@ def run_rules(plan: Plan, max_output_rows: int = 10_000) -> Plan:
     fuse_consecutive_maps(plan)
     drop_noop_maps(plan)
     merge_nodes(plan)
-    push_agg_through_join(plan)
+    push_agg_through_join(plan, table_stats)
     prune_unused_columns(plan)
     add_limit_to_result_sinks(plan, max_output_rows)
     return plan
@@ -405,7 +406,44 @@ def push_filters_below_maps(plan: Plan) -> None:
 _PAJ_DECOMPOSABLE = frozenset({"count", "sum", "min", "max"})
 
 
-def push_agg_through_join(plan: Plan) -> None:
+def _source_key_ndv(plan: Plan, nid: int, cols, table_stats):
+    """Estimated NDV product of ``cols`` at node ``nid`` from ingest
+    sketches (walking renames/filters down to a MemorySourceOp), or
+    None when the subtree computes the keys or stats are missing."""
+    if not table_stats:
+        return None
+    mapping = {c: c for c in cols}
+    while True:
+        node = plan.nodes.get(nid)
+        if node is None:
+            return None
+        op = node.op
+        if isinstance(op, MemorySourceOp):
+            st = table_stats.get(op.table)
+            if not st:
+                return None
+            prod = 1
+            for c in mapping.values():
+                v = (st.get("ndv") or {}).get(c)
+                if v is None:
+                    return None
+                prod *= max(int(v), 1)
+            rows = st.get("rows")
+            return min(prod, int(rows)) if rows else prod
+        if isinstance(op, (FilterOp, LimitOp)) and node.inputs:
+            nid = node.inputs[0]
+        elif isinstance(op, MapOp) and node.inputs:
+            from ..exec.plan import trace_map_renames
+
+            mapping = trace_map_renames(op, mapping)
+            if mapping is None:
+                return None
+            nid = node.inputs[0]
+        else:
+            return None
+
+
+def push_agg_through_join(plan: Plan, table_stats: dict | None = None) -> None:
     """Eager aggregation (Yan & Larson): rewrite GroupBy(Join(L, R)) so
     the build side pre-aggregates below the join.
 
@@ -529,11 +567,31 @@ def push_agg_through_join(plan: Plan) -> None:
                 partial_items.append(
                     (f"__paj_{kind}_{src}", rrel.col_type(src))
                 )
+        # Partial-agg group capacity: the join key's sketched NDV (x1.25
+        # slack for HLL error, rounded to a power of two) instead of a
+        # blind 64K default — a mis-sized capacity climbs the overflow-
+        # doubling ladder at run time, one jit recompile per rung.
+        # Clamped to the rebucket ceiling: sketch NDV is table-LIFETIME
+        # (expiry never decrements), and under-sizing self-corrects at
+        # run time while a stale over-size pre-allocates real memory.
+        from ..config import get_flag
+
+        groups = max(agg.max_groups, 1 << 16)
+        ndv = _source_key_ndv(
+            plan, right_id, list(join.right_on), table_stats
+        )
+        if ndv:
+            want = int(ndv * 1.25) + 1
+            groups = max(
+                agg.max_groups,
+                min(1 << (want - 1).bit_length(),
+                    int(get_flag("max_groups_limit"))),
+            )
         partial_id = plan.add(
             AggOp(
                 group_cols=tuple(join.right_on),
                 aggs=tuple(partial_aggs),
-                max_groups=max(agg.max_groups, 1 << 16),
+                max_groups=groups,
             ),
             inputs=[right_id],
             relation=Relation(partial_items),

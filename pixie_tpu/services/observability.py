@@ -211,6 +211,13 @@ class _Bound:
     def labels(self, **kw):
         return type(self)(self._m, self._lock, tuple(kw.items()))
 
+    def value(self) -> float:
+        """Current scalar value for this label set (0.0 if never set) —
+        counters/gauges only; histograms keep structured state."""
+        with self._lock:
+            v = self._m.values.get(self._labels, 0.0)
+        return v if isinstance(v, float) else 0.0
+
 
 class Counter(_Bound):
     def inc(self, v: float = 1.0) -> None:

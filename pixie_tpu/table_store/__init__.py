@@ -1,13 +1,16 @@
 """Hot/cold columnar table store (reference: ``src/table_store``)."""
 
+from .sketches import ColumnSketch, TableSketches
 from .table import Cursor, StartSpec, StopSpec, Table, TableStats
 from .table_store import TableStore
 
 __all__ = [
+    "ColumnSketch",
     "Cursor",
     "StartSpec",
     "StopSpec",
     "Table",
+    "TableSketches",
     "TableStats",
     "TableStore",
 ]

@@ -1,0 +1,129 @@
+"""Per-tablet ingest sketches: row count, zone maps, HLL NDV.
+
+Maintained incrementally on the table-store push path (``Table.append``)
+so plan-time decisions never scan data:
+
+- **row count** — exact rows ever appended (expiry never decrements:
+  all uses are conservative upper bounds).
+- **zone maps** — per key column, the global (min, max) plus a bounded
+  ring of per-append-batch (first_row_id, n, min, max) entries. The
+  global bounds feed join routing today (capacity-estimate overlap,
+  host-path range pre-filters; the windowed join driver computes its
+  per-window bounds exactly from the packed probe keys, which is both
+  cheaper and tighter than row-id zone lookups post-filtering). The
+  per-batch ring (``window_zone``) is the seam for predicate-driven
+  scan-window skipping — the ROADMAP "skip staging windows whose zone
+  maps can't match the predicate" item — where the scan DOES address
+  windows by row id.
+- **HLL NDV** — one ``ops/hll.py`` register row per key column (the
+  numpy mirror: bit-identical registers to the device kernel, no jax
+  dispatch on the append path). NDV × rows picks the join build side;
+  rows / NDV is the join-cardinality estimate that sizes output
+  capacity up front instead of climbing the overflow-doubling ladder.
+
+Sketched columns are the single-plane integer/time columns (the same
+set ``Table.col_stats`` bounds) plus dictionary-encoded string code
+columns. Multi-plane columns (UINT128) and floats are not sketched —
+joins on those route through the exact densify path where no cheap
+zone arithmetic applies.
+
+Reference grounding: PAPERS.md "Online Sketch-based Query Optimization"
+(2102.02440) — sketches maintained online, consulted at plan time; the
+reference engine has no analog (Carnot's planner is stats-blind).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..ops.hll import DEFAULT_P, hll_estimate_np, hll_init_np, hll_update_np
+
+#: Per-append zone-map entries kept per column; beyond this the oldest
+#: entries merge pairwise (coverage stays total, granularity halves) so
+#: long-lived streaming tables can't grow the ring unboundedly.
+MAX_ZONE_ENTRIES = 1024
+
+
+@dataclass
+class ZoneEntry:
+    row0: int  # first row id of the appended batch
+    n: int  # rows in the batch
+    lo: int
+    hi: int
+
+
+@dataclass
+class ColumnSketch:
+    """Ingest sketch for one key column."""
+
+    rows: int = 0
+    lo: int | None = None  # global zone map (min over all appends)
+    hi: int | None = None
+    registers: np.ndarray = field(default_factory=hll_init_np)
+    zones: list = field(default_factory=list)  # list[ZoneEntry]
+
+    def update(self, values: np.ndarray, row0: int) -> None:
+        """Fold one appended batch (single int plane / string codes)."""
+        n = len(values)
+        if n == 0:
+            return
+        lo, hi = int(values.min()), int(values.max())
+        self.rows += n
+        self.lo = lo if self.lo is None else min(self.lo, lo)
+        self.hi = hi if self.hi is None else max(self.hi, hi)
+        hll_update_np(self.registers, values, DEFAULT_P)
+        self.zones.append(ZoneEntry(row0, n, lo, hi))
+        if len(self.zones) > MAX_ZONE_ENTRIES:
+            merged = []
+            it = iter(self.zones)
+            for a in it:
+                b = next(it, None)
+                if b is None:
+                    merged.append(a)
+                elif b.row0 == a.row0 + a.n:
+                    merged.append(ZoneEntry(
+                        a.row0, a.n + b.n, min(a.lo, b.lo), max(a.hi, b.hi)
+                    ))
+                else:  # non-contiguous (expiry gap): keep both
+                    merged.extend((a, b))
+            self.zones = merged
+
+    @property
+    def ndv(self) -> int:
+        """Estimated distinct values (HLL, ~3% error), capped by rows."""
+        return max(1, min(hll_estimate_np(self.registers), self.rows))
+
+    def window_zone(self, row_lo: int, row_hi: int):
+        """Conservative (min, max) over rows [row_lo, row_hi), or None
+        when no zone entry overlaps (e.g. the range pre-dates sketching
+        or lies in an expiry gap — callers must treat None as
+        unbounded)."""
+        lo = hi = None
+        for z in self.zones:
+            if z.row0 + z.n <= row_lo or z.row0 >= row_hi:
+                continue
+            lo = z.lo if lo is None else min(lo, z.lo)
+            hi = z.hi if hi is None else max(hi, z.hi)
+        if lo is None:
+            return None
+        return lo, hi
+
+
+class TableSketches:
+    """All of one tablet's column sketches + the exact row count."""
+
+    def __init__(self):
+        self.rows = 0
+        self.cols: dict[str, ColumnSketch] = {}
+
+    def update(self, name: str, values: np.ndarray, row0: int) -> None:
+        self.cols.setdefault(name, ColumnSketch()).update(values, row0)
+
+    def col(self, name: str) -> ColumnSketch | None:
+        return self.cols.get(name)
+
+    def ndv(self, name: str) -> int | None:
+        s = self.cols.get(name)
+        return s.ndv if s is not None and s.rows else None

@@ -430,6 +430,11 @@ class Table:
         # way it does for dictionary codes. The reference has no analog
         # (its agg hash map is domain-oblivious, agg_node.h).
         self.col_stats: dict[str, tuple[int, int]] = {}
+        # Ingest sketches (sketches.py): per-key-column HLL NDV + zone
+        # maps + row count, consulted by join routing and the planner's
+        # eager-aggregation sizing (PAPERS.md 2102.02440). Gated by the
+        # ingest_sketches flag; None until the first sketched append.
+        self.sketches = None
         if len(self.relation):
             self._init_backend()
 
@@ -522,8 +527,26 @@ class Table:
                     else (min(cur[0], lo), max(cur[1], hi))
                 )
         times = cols[TIME_COLUMN][0] if (TIME_COLUMN, 0) == self._plane_layout[0] else None
-        self._backend.append(planes, times)
+        rid = self._backend.append(planes, times)
         from ..config import get_flag
+
+        if get_flag("ingest_sketches") and rid >= 0:
+            # Per-column NDV/zone-map sketches for join routing: the
+            # single-plane INT64 columns col_stats already bounds, plus
+            # dictionary string code planes (their ids ARE the join key
+            # space). time_ is skipped — the time index supersedes it.
+            if self.sketches is None:
+                from .sketches import TableSketches
+
+                self.sketches = TableSketches()
+            self.sketches.rows += hb.length
+            for (c, i), p in zip(self._plane_layout, planes):
+                if i != 0 or c == TIME_COLUMN or len(p) == 0:
+                    continue
+                if self.relation.col_type(c) in (
+                    DataType.INT64, DataType.STRING
+                ) and len(host_dtypes(self.relation.col_type(c))) == 1:
+                    self.sketches.update(c, p, rid)
 
         if get_flag("device_residency"):
             # Ship any newly completed windows to device now (the

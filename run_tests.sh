@@ -23,11 +23,25 @@
 #   ./run_tests.sh --analyze           static analysis gate: pxlint over
 #                                      pixie_tpu/ (all rules, baseline
 #                                      applied) + the plan verifier over
-#                                      all six bench shapes' compiled
-#                                      plans. Non-zero exit on any
+#                                      every bench shape's compiled
+#                                      plan. Non-zero exit on any
 #                                      non-baselined finding. Also runs
 #                                      inside --tier1.
+#   ./run_tests.sh --bench-join        quick join gate: a small
+#                                      selectivity/skew sweep (uniform
+#                                      vs zipf keys, low/high match
+#                                      rate) through every join
+#                                      strategy, reporting the strategy
+#                                      chosen + capacity retries and
+#                                      failing on any mismatch vs the
+#                                      numpy reference join (see
+#                                      tools/bench_join.py).
 case "$1" in
+  --bench-join)
+    shift
+    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python tools/bench_join.py "$@"
+    ;;
   --analyze)
     shift
     rc=0

@@ -1,0 +1,213 @@
+"""Ingest-sketch tests: table_store/sketches.py, the numpy HLL mirror,
+and the sketch consumers (join routing stats, capacity estimation,
+planner partial-agg sizing)."""
+
+import numpy as np
+
+from pixie_tpu.config import override_flag
+from pixie_tpu.ops.hll import hll_estimate_np, hll_init_np, hll_update_np
+from pixie_tpu.table_store.sketches import MAX_ZONE_ENTRIES, ColumnSketch
+
+
+class TestNumpyHLLMirror:
+    def test_registers_bit_identical_to_device_kernel(self):
+        import jax.numpy as jnp
+
+        from pixie_tpu.ops.hll import hll_init, hll_update
+
+        rng = np.random.default_rng(7)
+        vals = rng.integers(-(1 << 40), 1 << 40, 5000)
+        host = hll_update_np(hll_init_np(), vals)
+        dev = hll_update(
+            hll_init(1),
+            jnp.zeros(len(vals), dtype=jnp.int32),
+            jnp.ones(len(vals), dtype=bool),
+            jnp.asarray(vals),
+        )
+        np.testing.assert_array_equal(host, np.asarray(dev)[0])
+
+    def test_estimate_accuracy(self):
+        rng = np.random.default_rng(11)
+        for true_n in (50, 5_000, 200_000):
+            vals = rng.integers(0, true_n, 4 * true_n)
+            est = hll_estimate_np(hll_update_np(hll_init_np(), vals))
+            assert abs(est - true_n) / true_n < 0.12, (true_n, est)
+
+    def test_incremental_equals_one_shot(self):
+        rng = np.random.default_rng(13)
+        vals = rng.integers(0, 10_000, 30_000)
+        one = hll_update_np(hll_init_np(), vals)
+        inc = hll_init_np()
+        for chunk in np.array_split(vals, 7):
+            hll_update_np(inc, chunk)
+        np.testing.assert_array_equal(one, inc)
+
+
+class TestColumnSketch:
+    def test_zone_maps_and_ndv(self):
+        s = ColumnSketch()
+        s.update(np.arange(100, 200, dtype=np.int64), row0=0)
+        s.update(np.arange(500, 600, dtype=np.int64), row0=100)
+        assert (s.lo, s.hi) == (100, 599)
+        assert s.rows == 200
+        assert abs(s.ndv - 200) <= 20
+        assert s.window_zone(0, 100) == (100, 199)
+        assert s.window_zone(100, 200) == (500, 599)
+        assert s.window_zone(50, 150) == (100, 599)  # spans both
+        assert s.window_zone(200, 300) is None  # unsketched range
+
+    def test_zone_ring_bounded(self):
+        s = ColumnSketch()
+        for i in range(2 * MAX_ZONE_ENTRIES + 10):
+            s.update(np.array([i], dtype=np.int64), row0=i)
+        assert len(s.zones) <= MAX_ZONE_ENTRIES + 1
+        # Coverage stays total after merges.
+        assert s.window_zone(0, 2 * MAX_ZONE_ENTRIES) is not None
+
+
+class TestTableIngest:
+    def test_append_maintains_sketches(self):
+        from pixie_tpu.exec.engine import Engine
+
+        eng = Engine(window_rows=1 << 12)
+        rng = np.random.default_rng(3)
+        n = 20_000
+        eng.append_data("t", {
+            "time_": np.arange(n, dtype=np.int64),
+            "k": rng.integers(0, 700, n),
+            "s": [f"x{i % 40}" for i in range(n)],
+        })
+        sk = eng.tables["t"].sketches
+        assert sk.rows == n
+        assert abs(sk.ndv("k") - 700) < 70
+        assert abs(sk.ndv("s") - 40) <= 4  # dictionary code plane
+        assert sk.col("time_") is None  # time_ is not sketched
+        stats = eng._compile_table_stats()
+        assert stats["t"]["rows"] == n
+        assert "k" in stats["t"]["ndv"]
+
+    def test_flag_disables_sketches(self):
+        from pixie_tpu.table_store import Table
+
+        with override_flag("ingest_sketches", False):
+            t = Table("t")
+            t.append({"k": np.arange(10, dtype=np.int64)}, time_cols=())
+        assert t.sketches is None
+
+
+class TestRoutingConsumers:
+    def test_stream_join_stats_from_sketches(self):
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.exec.joins import stream_join_stats
+        from pixie_tpu.exec.plan import MemorySourceOp, Plan, ResultSinkOp
+
+        eng = Engine(window_rows=1 << 12)
+        rng = np.random.default_rng(5)
+        n = 10_000
+        eng.append_data("t", {
+            "time_": np.arange(n, dtype=np.int64),
+            "k": rng.integers(50, 450, n),
+        })
+        from pixie_tpu.exec.stream import _Stream
+
+        t = eng.tables["t"]
+        st = _Stream(t.relation, dict(t.dicts), [], [t],
+                     MemorySourceOp(table="t"))
+        stats = stream_join_stats(st, ("k",))
+        assert stats is not None and stats.origin == "sketch"
+        assert stats.rows == n
+        assert (stats.lo, stats.hi) == (50, 449)
+        assert abs(stats.ndv - 400) < 40
+
+    def test_stream_join_stats_traces_renames_in_reverse(self):
+        """Chains are in application order; tracing an output key back
+        to its source column must walk them newest-map-first (k <- a <-
+        b here, NOT k <- a applied forwards)."""
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.exec.joins import stream_join_stats
+        from pixie_tpu.exec.plan import ColumnRef, MapOp, MemorySourceOp
+        from pixie_tpu.exec.stream import _Stream
+
+        eng = Engine(window_rows=1 << 12)
+        n = 5_000
+        eng.append_data("t", {
+            "time_": np.arange(n, dtype=np.int64),
+            "a": np.arange(n, dtype=np.int64) % 10,  # ndv 10
+            "b": np.arange(n, dtype=np.int64) % 1000,  # ndv 1000
+        })
+        t = eng.tables["t"]
+        chain = [
+            MapOp(exprs=(("a", ColumnRef("b")),)),  # a now CARRIES b
+            MapOp(exprs=(("k", ColumnRef("a")),)),  # k <- a (<- b)
+        ]
+        st = _Stream(t.relation, dict(t.dicts), chain, [t],
+                     MemorySourceOp(table="t"))
+        stats = stream_join_stats(st, ("k",))
+        assert stats is not None
+        # k's values are column b's: NDV ~1000, zone [0, 999].
+        assert abs(stats.ndv - 1000) < 100
+        assert (stats.lo, stats.hi) == (0, 999)
+
+    def test_capacity_estimate_math(self):
+        from pixie_tpu.exec.joins import (
+            JoinSideStats,
+            estimate_join_capacity,
+        )
+
+        build = JoinSideStats(rows=10_000, lo=0, hi=999, ndv=1_000)
+        probe = JoinSideStats(rows=4_096, lo=0, hi=999)
+        cap = estimate_join_capacity(4_096, build, probe, "inner")
+        # fanout 10 x 4096 x 2.0 safety -> 82k -> bucketed pow2.
+        assert cap == 131_072
+        # Non-overlapping zones floor out at the minimum bucket.
+        probe_far = JoinSideStats(rows=4_096, lo=5_000, hi=9_999)
+        assert estimate_join_capacity(
+            4_096, build, probe_far, "inner"
+        ) <= 2_048
+        # Left joins emit every probe row even when nothing matches.
+        assert estimate_join_capacity(
+            4_096, build, probe_far, "left"
+        ) >= 4_096
+
+    def test_planner_partial_agg_sized_from_ndv(self):
+        from pixie_tpu.exec.plan import AggOp
+        from pixie_tpu.planner import CompilerState, compile_pxl
+        from pixie_tpu.types.dtypes import DataType
+        from pixie_tpu.types.relation import Relation
+        from pixie_tpu.udf.registry import default_registry
+
+        rel = Relation([
+            ("time_", DataType.TIME64NS), ("k", DataType.INT64),
+            ("b", DataType.INT64), ("v", DataType.INT64),
+        ])
+        q = """
+import px
+l = px.DataFrame(table='t')
+r = px.DataFrame(table='t')
+g = l.merge(r, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+out = g.groupby('b').agg(n=('v_r', px.count))
+px.display(out)
+"""
+        ndv = 3_000
+        state = CompilerState(
+            schemas={"t": rel}, registry=default_registry(),
+            table_stats={"t": {"rows": 50_000, "ndv": {"k": ndv}}},
+        )
+        plan = compile_pxl(q, state).plan
+        partial = [
+            n.op for n in plan.nodes.values()
+            if isinstance(n.op, AggOp) and n.op.group_cols == ("k",)
+        ]
+        assert partial, "eager-agg rewrite did not fire"
+        # 3000 * 1.25 slack -> next pow2 = 4096 (not the blind 64K).
+        assert partial[0].max_groups == 4_096
+
+        # Without stats the historical 64K default stands.
+        state2 = CompilerState(schemas={"t": rel},
+                               registry=default_registry())
+        plan2 = compile_pxl(q, state2).plan
+        partial2 = [
+            n.op for n in plan2.nodes.values()
+            if isinstance(n.op, AggOp) and n.op.group_cols == ("k",)
+        ]
+        assert partial2[0].max_groups == 1 << 16

@@ -1,0 +1,152 @@
+"""Quick join gate (``run_tests.sh --bench-join``): a small
+selectivity/skew sweep through every N:M join strategy.
+
+For each key distribution (uniform, zipf-skewed build, selective
+clustered probe, duplicate-heavy high match) the sweep runs the same
+inner-join query through each strategy (auto + every forced path),
+checks the result against a numpy reference join, and prints one line
+per run: strategy chosen, build-side swap, capacity retries, zone-
+skipped windows, wall seconds. Any result mismatch or unexpected
+capacity retry fails the gate.
+
+This is a correctness/routing gate, not a perf benchmark — the real
+numbers come from bench.py's device_join* shapes.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_L = 24_000
+N_R = 12_000
+WINDOW = 2_048  # forces multi-window driver paths
+
+STRATEGIES = ("auto", "host", "single", "sorted", "radix")
+
+
+def _dists():
+    rng = np.random.default_rng(42)
+    n_keys = 3_000
+    uniform = (
+        rng.integers(0, n_keys, N_L),
+        rng.integers(0, n_keys, N_R),
+    )
+    zipf = (
+        rng.integers(0, n_keys, N_L),
+        (np.minimum(rng.zipf(1.5, N_R), n_keys) - 1) * 2654435761 % n_keys,
+    )
+    lk = (np.arange(N_L, dtype=np.int64) * n_keys) // N_L
+    selective = (lk, rng.integers(n_keys - n_keys // 8, n_keys, N_R))
+    # Few keys, huge N:M fan-out — sized down so the ~25x expansion
+    # stays a quick gate, not a benchmark.
+    dup_heavy = (
+        rng.integers(0, 40, 2_000),
+        rng.integers(0, 40, 1_000),
+    )
+    return {
+        "uniform": uniform,
+        "zipf": zipf,
+        "selective": selective,
+        "dup_heavy": dup_heavy,
+    }
+
+
+def _run_one(dist_name, lk, rk, strategy) -> tuple[bool, str]:
+    import pixie_tpu.exec.joins as joins_mod
+    from pixie_tpu.config import override_flag
+    from pixie_tpu.exec.engine import Engine
+
+    lv = np.arange(len(lk), dtype=np.int64)
+    rv = np.arange(len(rk), dtype=np.int64) + 1_000_000
+    eng = Engine(window_rows=1 << 14)
+    eng.append_data("l", {"time_": np.arange(len(lk), dtype=np.int64),
+                          "k": lk.astype(np.int64), "lv": lv})
+    eng.append_data("r", {"time_": np.arange(len(rk), dtype=np.int64),
+                          "k": rk.astype(np.int64), "rv": rv})
+    q = """
+import px
+l = px.DataFrame(table='l')
+r = px.DataFrame(table='r')
+g = l.merge(r, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
+px.display(g, 'j')
+"""
+    old = joins_mod.DEVICE_JOIN_MIN_ROWS
+    joins_mod.DEVICE_JOIN_MIN_ROWS = 0  # past the host-dict small gate
+    try:
+        with override_flag("join_strategy", strategy), \
+                override_flag("join_probe_window_rows", WINDOW):
+            t0 = time.perf_counter()
+            out = eng.execute_query(q, max_output_rows=1 << 62)["j"]
+            dt = time.perf_counter() - t0
+    finally:
+        joins_mod.DEVICE_JOIN_MIN_ROWS = old
+    got = out.to_pydict()
+    got_pairs = collections.Counter(
+        zip(got["lv"].tolist(), got["rv"].tolist())
+    )
+
+    r_by_key: dict = collections.defaultdict(list)
+    for j, k in enumerate(rk.tolist()):
+        r_by_key[k].append(j)
+    ref_pairs = collections.Counter(
+        (int(lv[i]), int(rv[j]))
+        for i, k in enumerate(lk.tolist())
+        for j in r_by_key.get(k, ())
+    )
+    d = eng.last_join_decision
+    retries_cum = eng.tracer.registry.counter(
+        "pixie_join_capacity_retries_total"
+    ).value()
+    line = (
+        f"[bench-join] {dist_name:9s} {strategy:6s} -> "
+        f"{d.strategy if d else '?':9s} swap={bool(d and d.swap)!s:5s} "
+        f"retries={d.retries if d else 0} "
+        f"retries_cum={int(retries_cum)} "
+        f"skipped={d.skipped_windows if d else 0:3d} "
+        f"rows={sum(got_pairs.values())} {dt:6.3f}s"
+    )
+    ok = got_pairs == ref_pairs
+    if not ok:
+        line += "  RESULT MISMATCH vs numpy reference"
+    return ok, line
+
+
+def main() -> int:
+    failures = 0
+    total_retries = 0
+    from pixie_tpu.services.observability import default_registry
+
+    for dist_name, (lk, rk) in _dists().items():
+        for strategy in STRATEGIES:
+            ok, line = _run_one(dist_name, lk, rk, strategy)
+            print(line, file=sys.stderr)
+            if not ok:
+                failures += 1
+    total_retries = int(default_registry.counter(
+        "pixie_join_capacity_retries_total"
+    ).value())
+    print(
+        f"[bench-join] {len(_dists()) * len(STRATEGIES)} runs, "
+        f"{failures} failures, {total_retries} capacity retries",
+        file=sys.stderr,
+    )
+    if total_retries:
+        print(
+            "[bench-join] FAIL: sketch-guided capacity should eliminate "
+            "overflow retries on the sweep distributions",
+            file=sys.stderr,
+        )
+        return 1
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
