@@ -80,6 +80,14 @@ class Client:
     def list_scripts(self) -> list[str]:
         return self._request("broker.scripts", {})["scripts"]
 
+    def debug_queries(self, limit: int = 50) -> dict:
+        """Recent distributed-query traces from the broker — status,
+        duration, and per-agent resource usage (bytes staged, device ms,
+        wire bytes). The `px debug queries` surface."""
+        res = self._request("broker.debug_queries", {"limit": limit})
+        return {"in_flight": res.get("in_flight", []),
+                "queries": res.get("queries", [])}
+
     def schemas(self) -> dict:
         return self._request("broker.schemas", {})["schemas"]
 

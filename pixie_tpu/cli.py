@@ -12,6 +12,7 @@ Usage:
   python -m pixie_tpu.cli script list | script show px/http_stats
   python -m pixie_tpu.cli explain px/http_stats
   python -m pixie_tpu.cli tables|agents --broker HOST:PORT
+  python -m pixie_tpu.cli debug queries --broker HOST:PORT [-v]
   python -m pixie_tpu.cli docs
 """
 
@@ -250,6 +251,56 @@ def cmd_agents(args) -> int:
     return 0
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TB"
+
+
+def cmd_debug(args) -> int:
+    """`px debug queries`: recent query traces from the broker with
+    per-query resource usage and per-agent attribution (the self-
+    observability surface — docs/OBSERVABILITY.md)."""
+    with _client(args.broker) as client:
+        res = client.debug_queries(limit=args.limit)
+    rows = res["queries"]
+    if args.output == "json":
+        print(json.dumps(res, default=str))
+        return 0
+    if not rows and not res["in_flight"]:
+        print("no recent queries")
+        return 0
+    hdr = (f"{'qid':12s} {'status':8s} {'ms':>9s} {'rows':>9s} "
+           f"{'staged':>9s} {'device':>9s} {'wire':>9s} agents")
+    print(hdr)
+    for row in res["in_flight"] + rows:
+        u = row.get("usage", {})
+        agents = sorted(row.get("agent_usage", {}))
+        print(
+            f"{row.get('qid') or row['id'][:12]:12s} "
+            f"{row['status']:8s} "
+            f"{row['duration_ms']:>9.1f} "
+            f"{row.get('rows_out', u.get('rows_out', 0)):>9d} "
+            f"{_fmt_bytes(u.get('bytes_staged', 0)):>9s} "
+            f"{u.get('device_ms', 0.0):>8.1f}ms "
+            f"{_fmt_bytes(u.get('wire_bytes', 0)):>9s} "
+            f"{','.join(agents)}"
+        )
+        if args.verbose:
+            for aid, au in sorted(row.get("agent_usage", {}).items()):
+                print(
+                    f"  {aid:14s} staged={_fmt_bytes(au.get('bytes_staged', 0))} "
+                    f"device={au.get('device_ms', 0.0):.1f}ms "
+                    f"wire={_fmt_bytes(au.get('wire_bytes', 0))} "
+                    f"rows={au.get('rows_out', 0)} "
+                    f"windows={au.get('windows', 0)}"
+                )
+    return 0
+
+
 def cmd_docs(args) -> int:
     from .metadata.funcs import register_metadata_funcs
     from .metadata.state import MetadataState
@@ -323,6 +374,19 @@ def main(argv=None) -> int:
     ag = sub.add_parser("agents", help="list live agents")
     ag.add_argument("--broker", required=True)
     ag.set_defaults(fn=cmd_agents)
+
+    db = sub.add_parser(
+        "debug", help="self-observability surfaces (debug queries)"
+    )
+    db.add_argument("what", choices=("queries",),
+                    help="queries: recent query traces + resource usage")
+    db.add_argument("--broker", required=True)
+    db.add_argument("--limit", type=int, default=20)
+    db.add_argument("-v", "--verbose", action="store_true",
+                    help="per-agent usage breakdown under each query")
+    db.add_argument("-o", "--output", choices=("table", "json"),
+                    default="table")
+    db.set_defaults(fn=cmd_debug)
 
     dc = sub.add_parser("docs", help="dump the function reference (markdown)")
     dc.set_defaults(fn=cmd_docs)

@@ -263,3 +263,17 @@ define_flag(
     "Queries slower than this (wall-clock ms) dump their full trace to "
     "the 'pixie_tpu.slow_query' logger; 0 disables the slow-query log.",
 )
+
+# -- self-observability (services/telemetry.py) ------------------------------
+define_flag(
+    "self_telemetry", True,
+    "Agents fold their engine's finished query traces + resource "
+    "records into the __queries__/__spans__/__agents__ tables "
+    "(PxL-queryable through the normal engine path) and publish "
+    "distributed-trace span summaries for the broker's /debug/tracez.",
+)
+define_flag(
+    "telemetry_table_mb", 8,
+    "Per-table byte budget (MB) for the self-telemetry tables; each "
+    "table's ring expires its own oldest rows at the budget.",
+)

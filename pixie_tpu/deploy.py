@@ -57,8 +57,10 @@ def run_broker() -> int:
             "quarantined": tracker.quarantined(),
         },
         # Broker-side distributed-query traces (dispatch/retry/failover
-        # spans) back /debug/queryz on this role.
+        # spans) back /debug/queryz on this role; the cluster-stitched
+        # view (broker + agent spans per trace id) backs /debug/tracez.
         tracer=broker.tracer,
+        trace_view=broker.trace_view,
     )
     obs_port = obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "6101")))
     print(

@@ -42,6 +42,10 @@ class StageStat:
     seconds: float = 0.0
     rows: int = 0
     count: int = 0
+    # Bytes moved by this stage (today: host->device transfer bytes on
+    # "stage" intervals; zero for device-cache-resident windows). Feeds
+    # QueryResourceUsage.bytes_staged (trace.py).
+    nbytes: int = 0
 
 
 @dataclass
@@ -63,15 +67,17 @@ class FragmentStats:
         default_factory=threading.Lock, repr=False, compare=False
     )
 
-    def add(self, stage: str, seconds: float, rows: int = 0) -> None:
+    def add(self, stage: str, seconds: float, rows: int = 0,
+            nbytes: int = 0) -> None:
         with self._lock:
             s = self.stages.setdefault(stage, StageStat())
             s.seconds += seconds
             s.rows += int(rows)
             s.count += 1
+            s.nbytes += int(nbytes)
 
-    def timed(self, stage: str, rows: int = 0):
-        return _Timer(self, stage, rows)
+    def timed(self, stage: str, rows: int = 0, nbytes: int = 0):
+        return _Timer(self, stage, rows, nbytes)
 
     def to_dict(self) -> dict:
         # Snapshot under the lock: /debug/queryz renders IN-FLIGHT
@@ -79,7 +85,7 @@ class FragmentStats:
         # inserting stage keys while a scrape iterates.
         with self._lock:
             stages = {
-                k: (v.seconds, v.rows, v.count)
+                k: (v.seconds, v.rows, v.count, v.nbytes)
                 for k, v in self.stages.items()
             }
         return {
@@ -88,22 +94,28 @@ class FragmentStats:
             "rows_in": self.rows_in,
             "rows_out": self.rows_out,
             "stages": {
-                k: {"seconds": round(s, 6), "rows": r, "count": c}
-                for k, (s, r, c) in stages.items()
+                k: {"seconds": round(s, 6), "rows": r, "count": c,
+                    "bytes": b}
+                for k, (s, r, c, b) in stages.items()
             },
         }
 
 
 class _Timer:
-    def __init__(self, stats: FragmentStats, stage: str, rows: int):
+    def __init__(self, stats: FragmentStats, stage: str, rows: int,
+                 nbytes: int = 0):
         self.stats, self.stage, self.rows = stats, stage, rows
+        self.nbytes = nbytes
 
     def __enter__(self):
         self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self.stats.add(self.stage, time.perf_counter() - self.t0, self.rows)
+        self.stats.add(
+            self.stage, time.perf_counter() - self.t0, self.rows,
+            self.nbytes,
+        )
 
 
 @dataclass

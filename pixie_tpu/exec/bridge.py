@@ -137,6 +137,22 @@ def _compact_payload(p):
     })
 
 
+def payload_nbytes(p) -> int:
+    """Approximate wire size of one bridge payload: the plane bytes the
+    transport actually moves (metadata/dicts excluded). Host numpy
+    arithmetic only — feeds ``QueryResourceUsage.wire_bytes``."""
+    if isinstance(p, RowsPayload):
+        return p.batch.nbytes
+    if isinstance(p, AggStatePayload):
+        import jax
+
+        return int(sum(
+            np.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(p.state)
+        ))
+    return 0
+
+
 def bridge_payload(engine, res):
     """Produce a BridgeSink payload: partial-agg state for agg chains,
     materialized rows otherwise (GRPCSinkNode's two modes)."""
@@ -145,12 +161,20 @@ def bridge_payload(engine, res):
     ):
         import jax
 
+        # The agent-mode agg fold records onto the query's trace spine
+        # like any other fragment (rows/windows/stage/compute feed the
+        # per-agent QueryResourceUsage attribution).
+        qstats = getattr(engine, "_query_stats", None)
         while True:
             frag = compile_fragment(
                 res.chain, res.relation, res.dicts, engine.registry,
                 col_stats=_stream_col_stats(res),
             )
-            state = engine._fold_agg_state(res, frag)
+            stats = (
+                qstats.new_fragment(res.chain) if qstats is not None
+                else None
+            )
+            state = engine._fold_agg_state(res, frag, stats)
             if not bool(np.asarray(state["overflow"])):
                 break
             res = _double_agg_groups(res)  # rebucket before shipping

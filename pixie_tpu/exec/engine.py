@@ -213,6 +213,12 @@ class Engine:
         # identity, so a shared cache would cross-seed engines running
         # the same script over different data.
         self._join_capacity_cache: dict = {}
+        # Self-telemetry (services/telemetry.py TelemetryCollector):
+        # when attached, finished traces fold into __queries__/__spans__
+        # tables and observed per-script cardinalities feed back into
+        # _compile_table_stats. None = off (the default for bare
+        # engines; agents/deploy roles wire it).
+        self.telemetry = None
 
     @property
     def tables(self) -> dict:
@@ -310,6 +316,16 @@ class Engine:
                     c: s.ndv for c, s in sk.cols.items() if s.rows
                 },
             }
+        # Telemetry feedback (arXiv:2102.02440): OBSERVED per-script
+        # output cardinalities from past runs, keyed by script hash
+        # under a dunder key no table name can collide with. compile_pxl
+        # resolves the entry for the script being compiled so optimizer
+        # rules (push_agg_through_join sizing) can floor their capacity
+        # estimates at reality instead of trusting a drifted sketch.
+        if self.telemetry is not None:
+            obs = self.telemetry.observed()
+            if obs:
+                out["__observed__"] = obs
         return out
 
     def set_metadata_state(self, state) -> None:
@@ -374,6 +390,9 @@ class Engine:
     ) -> dict:
         self._cancel = cancel
         self.last_pipeline = None  # fresh per-query pipeline snapshot
+        # Fresh per-query join outcome: a non-join query must not
+        # inherit (and re-account) the previous query's decision.
+        self.last_join_decision = None
         # The trace's stats spine IS the per-fragment stats object —
         # analyze just runs it with sync=True (see analyze.py).
         self._query_stats = trace.stats
@@ -387,6 +406,12 @@ class Engine:
             trace.pipeline = (
                 dict(self.last_pipeline) if self.last_pipeline else None
             )
+            jd = self.last_join_decision
+            if jd is not None:
+                trace.usage.retries += int(getattr(jd, "retries", 0))
+                trace.usage.skipped_windows += int(
+                    getattr(jd, "skipped_windows", 0)
+                )
 
     @staticmethod
     def _plan_fingerprint(plan: Plan) -> int:
@@ -530,9 +555,15 @@ class Engine:
                 payload = batch_to_otlp(mat_input(node.inputs[0]), op.spec)
                 self.export_otel(payload, op.spec.endpoint)
             elif isinstance(op, BridgeSinkOp):
-                outputs[("bridge", op.bridge_id)] = bridge_payload(
-                    self, results[node.inputs[0]]
-                )
+                from .bridge import payload_nbytes
+
+                payload = bridge_payload(self, results[node.inputs[0]])
+                outputs[("bridge", op.bridge_id)] = payload
+                # Wire accounting (QueryResourceUsage): bridge egress is
+                # what this fragment ships to the merge tier.
+                qstats = self._query_stats
+                if qstats is not None and getattr(qstats, "trace", None):
+                    qstats.trace.add_wire_bytes(payload_nbytes(payload))
             elif isinstance(op, BridgeSourceOp):
                 if not bridge_inputs or op.bridge_id not in bridge_inputs:
                     raise QueryError(f"no input for bridge {op.bridge_id}")
@@ -1004,7 +1035,7 @@ class Engine:
             return
         for hb in self._windows(stream):
             self._check_cancel()
-            with _timed(stats, "stage", rows=hb.length):
+            with _timed(stats, "stage", rows=hb.length, nbytes=hb.nbytes):
                 cols, valid = self._stage(hb, self._window_capacity(hb.length))
                 _block_if(stats, cols)
             if stats is not None:

@@ -67,6 +67,17 @@ PXLINT_HOT_REGIONS = (
     # prefetch pipeline; an unjustified host sync there serializes the
     # probe stream exactly like one in the fold loops.
     "exec/joins.py:_join_device_windowed*",
+    # Telemetry fold (services/telemetry.py): runs in Tracer.end_query
+    # on the query thread right after the exec guard releases — a host
+    # sync there would serialize the NEXT query behind telemetry
+    # bookkeeping, so the fold must stay pure host-list arithmetic.
+    "services/telemetry.py:TelemetryCollector*",
+    "services/telemetry.py:ClusterTraceView*",
+    # Resource accounting on the trace spine: _finalize_usage and the
+    # per-window stage/add paths run per query/window with the same
+    # no-sync contract.
+    "exec/trace.py:QueryTrace._finalize_usage",
+    "exec/trace.py:TracedFragment.add",
 )
 
 

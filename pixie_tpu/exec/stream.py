@@ -139,14 +139,14 @@ def _window_shapes(cols) -> tuple:
     )
 
 
-def _timed(stats, stage: str, rows: int = 0):
+def _timed(stats, stage: str, rows: int = 0, nbytes: int = 0):
     """Stage timer context (no-op without stats) — keeps the analyze and
     plain execution paths one code path."""
     if stats is None:
         import contextlib
 
         return contextlib.nullcontext()
-    return stats.timed(stage, rows)
+    return stats.timed(stage, rows, nbytes)
 
 
 def _block_if(stats, x) -> None:

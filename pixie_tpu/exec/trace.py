@@ -57,9 +57,10 @@ import os
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 
 from ..config import get_flag
+from . import tracectx
 from .analyze import FragmentStats, QueryStats, StageStat
 
 logger = logging.getLogger("pixie_tpu.slow_query")
@@ -76,9 +77,71 @@ STAGE_BUCKETS = (
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 )
 
+#: Byte-volume buckets (staged / wire bytes per query): one window is
+#: KBs..MBs, a 16M-row scan is GBs.
+BYTES_BUCKETS = (
+    1 << 10, 1 << 14, 1 << 18, 1 << 22, 1 << 26, 1 << 30, 1 << 34,
+)
+
+#: Millisecond buckets (per-query device dispatch time).
+MS_BUCKETS = (0.1, 1.0, 10.0, 100.0, 1_000.0, 10_000.0, 100_000.0)
+
 
 def _new_id(nbytes: int) -> str:
     return os.urandom(nbytes).hex()
+
+
+@dataclass
+class QueryResourceUsage:
+    """What one query actually COST, accumulated at existing host
+    boundaries (never a device sync): the observed counterpart of the
+    sketch-guided planner's predictions (arXiv:2102.02440 feedback loop)
+    and the load signal multi-tenant admission control schedules on.
+
+    - ``bytes_staged``  host->device transfer bytes during execution
+      (0 for device-cache-resident windows — those were staged at
+      append time; the gap between rows_in and bytes_staged IS the
+      cache-hit signal)
+    - ``device_ms``     host-side dispatch time of device programs
+      (compute + finalize stage seconds; dispatch, not fenced runtime)
+    - ``compile_ms``    the compile span (parse + PxL + plan + verify)
+    - ``stall_ms``      query-thread time blocked on the prefetch pipe
+    - ``wire_bytes``    bridge payload bytes this query SHIPPED
+      (BridgeSinkOp egress — data-agent attribution; the merge's
+      ingress is the sum over its producers)
+    - ``retries``       dispatch retries (broker) + join-capacity
+      overflow retries (engine)
+    - ``skipped_windows`` probe/scan windows never staged (zone maps)
+    """
+
+    rows_in: int = 0
+    rows_out: int = 0
+    windows: int = 0
+    bytes_staged: int = 0
+    device_ms: float = 0.0
+    compile_ms: float = 0.0
+    stall_ms: float = 0.0
+    wire_bytes: int = 0
+    retries: int = 0
+    skipped_windows: int = 0
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        for k in ("device_ms", "compile_ms", "stall_ms"):
+            d[k] = round(d[k], 3)
+        return d
+
+    def merge(self, other: "QueryResourceUsage | dict") -> None:
+        """Fold another usage record in (broker-side per-agent
+        aggregation; accepts the dict form that crossed the bus)."""
+        d = other if isinstance(other, dict) else asdict(other)
+        for k in (
+            "rows_in", "rows_out", "windows", "bytes_staged", "wire_bytes",
+            "retries", "skipped_windows",
+        ):
+            setattr(self, k, getattr(self, k) + int(d.get(k, 0)))
+        for k in ("device_ms", "compile_ms", "stall_ms"):
+            setattr(self, k, getattr(self, k) + float(d.get(k, 0.0)))
 
 
 @dataclass
@@ -141,13 +204,15 @@ class TracedFragment(FragmentStats):
         self.span.attributes["ops"] = ",".join(ops) or "(join)"
         self.last_activity_ns = self.span.start_unix_nano
 
-    def add(self, stage: str, seconds: float, rows: int = 0) -> None:
+    def add(self, stage: str, seconds: float, rows: int = 0,
+            nbytes: int = 0) -> None:
         now_ns = time.time_ns()
         with self._lock:
             s = self.stages.setdefault(stage, StageStat())
             s.seconds += seconds
             s.rows += int(rows)
             s.count += 1
+            s.nbytes += int(nbytes)
             count = s.count
             self.last_activity_ns = now_ns
         tracer = self.trace.tracer
@@ -204,14 +269,26 @@ class QueryTrace:
     """One query's lifecycle: ids, status, span tree, stats spine."""
 
     def __init__(self, tracer: "Tracer | None", script: str = "",
-                 analyze: bool = False, kind: str = "query"):
+                 analyze: bool = False, kind: str = "query",
+                 parent_ctx: dict | None = None):
         self.tracer = tracer
-        self.trace_id = _new_id(16)
+        # A valid parent context (a broker dispatch span, carried in the
+        # bus envelope — see tracectx.py) makes this trace PART of the
+        # distributed trace: same trace id, root parented under the
+        # dispatch span. Otherwise this query is its own trace root.
+        self.parent_ctx = (
+            dict(parent_ctx) if tracectx.valid(parent_ctx) else None
+        )
+        self.trace_id = (
+            self.parent_ctx["trace_id"] if self.parent_ctx else _new_id(16)
+        )
         self.script = script or ""
         self.script_hash = hashlib.sha256(
             self.script.encode()
         ).hexdigest()[:12]
-        self.kind = kind  # "query" | "stream"
+        self.kind = kind  # "query" | "stream" | "fragment" | "merge" | ...
+        self.qid = ""  # distributed query id (agents/broker stamp it)
+        self.agent_id = ""  # executing agent (agents stamp it)
         self.status = "running"
         self.error = ""
         self.start_unix_nano = time.time_ns()
@@ -220,13 +297,29 @@ class QueryTrace:
         self.duration_s = 0.0
         self.window_sample = int(get_flag("trace_window_sample"))
         self.pipeline: dict | None = None  # engine.last_pipeline snapshot
+        self.usage = QueryResourceUsage()
+        self.agent_usage: dict = {}  # broker: {agent_id: usage dict}
+        self.exported = False  # OTLP push succeeded (ring-drop counting)
         self.dropped_spans = 0
         self._lock = threading.Lock()
         self.root = Span(
-            "query", self.trace_id, start_unix_nano=self.start_unix_nano
+            "query", self.trace_id, start_unix_nano=self.start_unix_nano,
+            parent_id=self.parent_ctx["span_id"] if self.parent_ctx else "",
         )
         self.spans: list[Span] = [self.root]
         self.stats = TraceStats(self, sync=analyze)
+
+    def ctx(self, span: "Span | None" = None) -> dict:
+        """The propagation envelope for children of ``span`` (default:
+        the root) — what the broker stamps onto dispatch messages."""
+        return tracectx.make(
+            self.trace_id, (span or self.root).span_id
+        )
+
+    def add_wire_bytes(self, n: int) -> None:
+        """Account bridge egress bytes (BridgeSinkOp payloads)."""
+        with self._lock:
+            self.usage.wire_bytes += int(n)
 
     # -- span plumbing -------------------------------------------------------
     def _new_span(self, name: str, parent: Span | None) -> Span:
@@ -268,13 +361,21 @@ class QueryTrace:
         self.duration_s = time.perf_counter() - self._t0
         self.stats.total_seconds = self.duration_s
         self.root.end_unix_nano = self.end_unix_nano
+        self._finalize_usage()
         self.root.attributes.update({
             "status": status,
             "script_hash": self.script_hash,
             "kind": self.kind,
             "rows_in": self.rows_in,
             "rows_out": self.rows_out,
+            "bytes_staged": self.usage.bytes_staged,
+            "device_ms": round(self.usage.device_ms, 3),
+            "wire_bytes": self.usage.wire_bytes,
         })
+        if self.qid:
+            self.root.attributes["qid"] = self.qid
+        if self.agent_id:
+            self.root.attributes["agent_id"] = self.agent_id
         if error:
             self.root.attributes["error"] = error
         if self.pipeline:
@@ -284,6 +385,33 @@ class QueryTrace:
         for f in self.stats.fragments:
             if isinstance(f, TracedFragment):
                 f.finish(self.end_unix_nano)
+
+    def _finalize_usage(self) -> None:
+        """Derive the resource record from the stats spine + spans.
+        Purely host-side arithmetic over already-collected counters."""
+        u = self.usage
+        # Additive: a broker trace pre-merged its agents' usage (its own
+        # stats spine is empty); an engine trace starts from zeros.
+        u.rows_in += self.rows_in
+        u.rows_out += self.rows_out
+        u.windows += self.windows
+        for f in self.stats.fragments:
+            with f._lock:
+                stages = {k: (v.seconds, v.nbytes)
+                          for k, v in f.stages.items()}
+            u.bytes_staged += stages.get("stage", (0.0, 0))[1]
+            u.device_ms += (
+                stages.get("compute", (0.0, 0))[0]
+                + stages.get("finalize", (0.0, 0))[0]
+            ) * 1e3
+            u.stall_ms += stages.get("stall", (0.0, 0))[0] * 1e3
+        compile_span = next(
+            (s for s in self.spans if s.name == "compile"), None
+        )
+        if compile_span is not None and compile_span.end_unix_nano:
+            u.compile_ms += (
+                compile_span.end_unix_nano - compile_span.start_unix_nano
+            ) / 1e6
 
     def to_dict(self) -> dict:
         """The /debug/queryz row (and slow-query log body)."""
@@ -302,8 +430,17 @@ class QueryTrace:
             "rows_out": self.rows_out,
             "windows": self.windows,
             "spans": len(self.spans),
+            "usage": self.usage.to_dict(),
             "fragments": [f.to_dict() for f in self.stats.fragments],
         }
+        if self.qid:
+            d["qid"] = self.qid
+        if self.agent_id:
+            d["agent_id"] = self.agent_id
+        if self.agent_usage:
+            d["agent_usage"] = dict(self.agent_usage)
+        if self.parent_ctx:
+            d["parent"] = dict(self.parent_ctx)
         if self.error:
             d["error"] = self.error
         if self.pipeline:
@@ -320,13 +457,16 @@ class QueryTrace:
         ``OTLPHttpExporter`` POSTs to ``/v1/traces``."""
         from .otel import _attr_kvs
 
+        res = [
+            ("service.name", "pixie-tpu-engine"),
+            ("query.script_hash", self.script_hash),
+        ]
+        if self.agent_id:
+            res.append(("service.instance.id", self.agent_id))
         return {
             "resourceSpans": [{
                 "resource": {
-                    "attributes": _attr_kvs([
-                        ("service.name", "pixie-tpu-engine"),
-                        ("query.script_hash", self.script_hash),
-                    ])
+                    "attributes": _attr_kvs(res)
                 },
                 "scopeSpans": [{
                     "scope": {"name": "pixie_tpu.exec.trace"},
@@ -352,6 +492,22 @@ class Tracer:
         self._stage_hist: dict = {}  # stage -> bound Histogram
         self._exporter = None
         self._exporter_url = None
+        # Finished-trace listeners (the TelemetryCollector hook): called
+        # AFTER metrics/export, exceptions contained — telemetry folding
+        # must never fail or slow the query that produced the trace.
+        self._listeners: list = []
+        self._closed = False
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(trace)`` to run on every finished trace."""
+        self._listeners.append(fn)
+
+    def shutdown(self) -> None:
+        """Stop exporting/notifying: traces finished after shutdown
+        still finalize into the ring (queryz keeps working) but no OTLP
+        push or listener runs — the teardown contract for processes
+        whose collector endpoint is already gone."""
+        self._closed = True
 
     # -- metrics -------------------------------------------------------------
     @property
@@ -393,6 +549,29 @@ class Tracer:
                     "pixie_trace_export_errors_total",
                     "Failed OTLP trace pushes (trace_export_url)",
                 ),
+                "dropped": reg.counter(
+                    "pixie_trace_dropped_total",
+                    "Finished traces evicted from the ring buffer "
+                    "without having been OTLP-exported",
+                ),
+                "bytes_staged": reg.histogram(
+                    "pixie_query_bytes_staged",
+                    "Per-query host->device staging bytes (0 = fully "
+                    "device-cache-resident)",
+                    buckets=BYTES_BUCKETS,
+                ),
+                "device_ms": reg.histogram(
+                    "pixie_query_device_ms",
+                    "Per-query device program dispatch milliseconds "
+                    "(compute + finalize stages; host-side, unfenced)",
+                    buckets=MS_BUCKETS,
+                ),
+                "wire_bytes": reg.histogram(
+                    "pixie_query_wire_bytes",
+                    "Per-query bridge payload egress bytes (agent "
+                    "fragments shipping partial states/rows)",
+                    buckets=BYTES_BUCKETS,
+                ),
             }
         return self._metrics
 
@@ -406,10 +585,24 @@ class Tracer:
 
     # -- lifecycle -----------------------------------------------------------
     def begin_query(self, script: str = "", analyze: bool = False,
-                    kind: str = "query") -> QueryTrace:
-        tr = QueryTrace(self, script=script, analyze=analyze, kind=kind)
+                    kind: str = "query",
+                    parent_ctx: dict | None = None) -> QueryTrace:
+        """Start a trace. ``parent_ctx`` defaults to the AMBIENT
+        distributed context (tracectx.current(), bound by the bus
+        dispatcher that delivered the triggering message) — so a
+        fragment executed inside an agent handler automatically joins
+        the broker's trace without explicit plumbing."""
+        if parent_ctx is None:
+            parent_ctx = tracectx.current()
+        tr = QueryTrace(
+            self, script=script, analyze=analyze, kind=kind,
+            parent_ctx=parent_ctx,
+        )
         with self._lock:
-            self._inflight[tr.trace_id] = tr
+            # Keyed by root span id, not trace id: N fragments of one
+            # distributed query SHARE a trace id but are distinct
+            # in-flight entries.
+            self._inflight[tr.root.span_id] = tr
         return tr
 
     def end_query(self, trace: QueryTrace, status: str = "ok",
@@ -419,18 +612,45 @@ class Tracer:
         (a second end is a no-op) so both StreamingQuery.run's finally
         and an explicit close() can call it."""
         with self._lock:
-            if self._inflight.pop(trace.trace_id, None) is None:
+            if self._inflight.pop(trace.root.span_id, None) is None:
                 return  # already ended (or foreign trace)
         trace._finalize(status, error)
-        with self._lock:
-            self._ring.append(trace)
         m = self._m()
+        with self._lock:
+            # Ring-drop accounting (satellite): an evicted trace that
+            # never made it out over OTLP is telemetry LOST — count it
+            # so operators can size trace_ring_size / wire an exporter.
+            if (
+                self._ring.maxlen is not None
+                and len(self._ring) == self._ring.maxlen
+                and self._ring
+                and not self._ring[0].exported
+            ):
+                m["dropped"].inc()
+            self._ring.append(trace)
         m["queries"].labels(status=status).inc()
         m["duration"].labels(status=status).observe(trace.duration_s)
+        u = trace.usage
+        m["bytes_staged"].observe(u.bytes_staged)
+        m["device_ms"].observe(u.device_ms)
+        m["wire_bytes"].observe(u.wire_bytes)
         if trace.pipeline:
             m["stall"].observe(trace.pipeline.get("stall_secs", 0.0))
         self._slow_query_check(trace, m)
         self._export(trace, m)
+        self._notify(trace)
+
+    def _notify(self, trace: QueryTrace) -> None:
+        if self._closed:
+            return
+        for fn in list(self._listeners):
+            try:
+                fn(trace)
+            except Exception:
+                # A broken telemetry consumer must never fail queries.
+                logging.getLogger("pixie_tpu.trace").warning(
+                    "trace listener %r failed", fn, exc_info=True
+                )
 
     def _slow_query_check(self, trace: QueryTrace, m: dict) -> None:
         thresh_ms = float(get_flag("slow_query_threshold_ms"))
@@ -445,7 +665,7 @@ class Tracer:
 
     def _export(self, trace: QueryTrace, m: dict) -> None:
         url = str(get_flag("trace_export_url"))
-        if not url:
+        if not url or self._closed:
             return
         if self._exporter is None or self._exporter_url != url:
             from .otel import OTLPHttpExporter
@@ -454,9 +674,12 @@ class Tracer:
             self._exporter_url = url
         try:
             self._exporter(trace.to_otlp())
+            trace.exported = True
         except Exception:
             # Telemetry must never fail the query; the counter is the
-            # operator's signal that the collector is down.
+            # operator's signal that the collector is down. A shutdown
+            # racing a slow in-flight push lands here too (socket torn
+            # down mid-POST) — counted, not raised.
             m["export_errors"].inc()
 
     # -- accessors (the /debug/queryz surface) -------------------------------
@@ -474,9 +697,9 @@ class Tracer:
 
     def get(self, trace_id: str) -> QueryTrace | None:
         with self._lock:
-            tr = self._inflight.get(trace_id)
-            if tr is not None:
-                return tr
+            for t in self._inflight.values():
+                if t.trace_id == trace_id:
+                    return t
             for t in self._ring:
                 if t.trace_id == trace_id:
                     return t

@@ -240,6 +240,74 @@ STIRLING_ERROR_RELATION = Relation(
     ]
 )
 
+# -- self-observability tables (services/telemetry.py) -----------------------
+# The engine's OWN telemetry as queryable tables: the TelemetryCollector
+# folds finished query traces + resource records into these, so bundled
+# PxL scripts (px/slow_queries, px/query_cost, px/agent_health) run over
+# the system's history through the normal engine path. Reference analog:
+# Stirling's stirling_error self-monitoring table, generalized to the
+# whole query lifecycle.
+
+# One row per finished query/fragment trace; time_ = trace end.
+QUERIES_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("trace_id", DataType.STRING),
+        ("qid", DataType.STRING),  # distributed query id ("" = local)
+        ("agent_id", DataType.STRING),
+        ("kind", DataType.STRING),  # query|stream|fragment|merge|distributed
+        ("script_hash", DataType.STRING),
+        ("script", DataType.STRING),  # first 200 chars
+        ("status", DataType.STRING),
+        ("duration_ms", DataType.FLOAT64),
+        ("rows_in", DataType.INT64),
+        ("rows_out", DataType.INT64),
+        ("windows", DataType.INT64),
+        ("bytes_staged", DataType.INT64),
+        ("device_ms", DataType.FLOAT64),
+        ("compile_ms", DataType.FLOAT64),
+        ("stall_ms", DataType.FLOAT64),
+        ("wire_bytes", DataType.INT64),
+        ("retries", DataType.INT64),
+        ("skipped_windows", DataType.INT64),
+    ]
+)
+
+# One row per trace span (bounded per trace); time_ = span start.
+SPANS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("trace_id", DataType.STRING),
+        ("span_id", DataType.STRING),
+        ("parent_id", DataType.STRING),
+        ("name", DataType.STRING),
+        ("agent_id", DataType.STRING),
+        ("duration_ms", DataType.FLOAT64),
+    ]
+)
+
+# One row per finished trace: the folding agent's running totals (the
+# latest row per agent_id is its current health snapshot).
+AGENTS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("agent_id", DataType.STRING),
+        ("kind", DataType.STRING),  # pem|kelvin|engine|broker
+        ("queries_total", DataType.INT64),
+        ("errors_total", DataType.INT64),
+        ("bytes_staged_total", DataType.INT64),
+        ("device_ms_total", DataType.FLOAT64),
+        ("wire_bytes_total", DataType.INT64),
+    ]
+)
+
+#: {table: Relation} for the self-telemetry tables.
+TELEMETRY_SCHEMAS: dict[str, "Relation"] = {
+    "__queries__": QUERIES_RELATION,
+    "__spans__": SPANS_RELATION,
+    "__agents__": AGENTS_RELATION,
+}
+
 # dns_table.h kDNSTable (subset).
 DNS_EVENTS_RELATION = Relation(
     [
@@ -274,6 +342,10 @@ CANONICAL_SCHEMAS: dict[str, Relation] = {
     "bcc_pid_cpu_usage": PID_RUNTIME_RELATION,
     "proc_exit_events": PROC_EXIT_EVENTS_RELATION,
     "stirling_error": STIRLING_ERROR_RELATION,
+    # Self-telemetry tables ship with every agent (the collector also
+    # lazily creates them, but advertising the schema up front lets the
+    # bundled self-monitoring scripts compile before the first query).
+    **TELEMETRY_SCHEMAS,
 }
 
 

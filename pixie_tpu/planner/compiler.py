@@ -95,6 +95,22 @@ def compile_mutations(query: str, state: CompilerState) -> list:
 
 
 def compile_pxl(query: str, state: CompilerState) -> CompiledScript:
+    # Telemetry feedback resolution (services/telemetry.py): the engine
+    # exposes OBSERVED per-script cardinalities from past runs under
+    # table_stats["__observed__"] keyed by script hash; resolve THIS
+    # script's entry so optimizer rules can consult it without knowing
+    # the script (arXiv:2102.02440 — observed stats over estimates).
+    observed = state.table_stats.get("__observed__")
+    if observed:
+        import hashlib
+
+        ent = observed.get(
+            hashlib.sha256(query.encode()).hexdigest()[:12]
+        )
+        if ent:
+            state.table_stats = {
+                **state.table_stats, "__observed_self__": dict(ent),
+            }
     tree = parse_pxl(query)
     builder = PlanBuilder(
         plan=Plan(),

@@ -587,6 +587,21 @@ def push_agg_through_join(plan: Plan, table_stats: dict | None = None) -> None:
                 min(1 << (want - 1).bit_length(),
                     int(get_flag("max_groups_limit"))),
             )
+        # Telemetry feedback floor: a past run of THIS script observed
+        # its largest aggregate's true output cardinality (the partial
+        # agg is itself a fragment, so the max covers it). A drifted
+        # sketch NDV can under-size the capacity and pay the overflow-
+        # doubling ladder at run time — floor at reality instead;
+        # over-size is the cheaper error (see join_capacity_safety).
+        observed = (table_stats or {}).get("__observed_self__") or {}
+        ogroups = int(observed.get("agg_groups", 0) or 0)
+        if ogroups:
+            owant = int(ogroups * 1.25) + 1
+            groups = max(
+                groups,
+                min(1 << (owant - 1).bit_length(),
+                    int(get_flag("max_groups_limit"))),
+            )
         partial_id = plan.add(
             AggOp(
                 group_cols=tuple(join.right_on),

@@ -18,6 +18,11 @@ from .query_broker import (
     QueryResultForwarder,
     QueryTimeout,
 )
+from .telemetry import (
+    ClusterTraceView,
+    TelemetryCollector,
+    enable_self_telemetry,
+)
 from .tracker import AgentTracker
 
 __all__ = [
@@ -25,6 +30,7 @@ __all__ = [
     "AgentLost",
     "AgentTracker",
     "BusTimeout",
+    "ClusterTraceView",
     "FaultInjector",
     "KelvinAgent",
     "MessageBus",
@@ -32,4 +38,6 @@ __all__ = [
     "QueryBroker",
     "QueryResultForwarder",
     "QueryTimeout",
+    "TelemetryCollector",
+    "enable_self_telemetry",
 ]

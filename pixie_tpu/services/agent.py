@@ -16,7 +16,9 @@ import threading
 import time
 import traceback
 
+from ..exec import tracectx
 from ..exec.engine import Engine, QueryError
+from ..exec.trace import plan_script
 from .msgbus import MessageBus
 from .tracker import TOPIC_HEARTBEAT, TOPIC_REGISTER
 
@@ -122,6 +124,20 @@ class Agent:
                 f"agent.{a}.{kind}",
                 lambda m, k=kind: self._ack_receipt(m, k),
             ))
+        # Self-telemetry (services/telemetry.py): finished fragment/
+        # merge traces fold into this agent's __queries__/__spans__/
+        # __agents__ tables (PxL-queryable, per-agent attribution) and
+        # distributed span summaries flow to the broker's tracez view.
+        from ..config import get_flag
+
+        if get_flag("self_telemetry"):
+            from .telemetry import enable_self_telemetry
+
+            self.telemetry = enable_self_telemetry(
+                self.engine, agent_id=self.agent_id,
+                kind="pem" if self.processes_data else "kelvin",
+                bus=self.bus,
+            )
         self._register()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
@@ -278,6 +294,19 @@ class Agent:
         with self._lock:
             return self._dedup_dispatch_locked(qid, kind)
 
+    def _begin_fragment_trace(self, msg, qid: str, plan, kind: str):
+        """Start this fragment's trace as part of the dispatching
+        broker's distributed trace: the context envelope in the dispatch
+        message (or the ambient one the bus dispatcher bound) parents
+        the fragment's root span under the broker's dispatch span."""
+        ctx = tracectx.extract(msg) or tracectx.current()
+        tr = self.engine.tracer.begin_query(
+            script=plan_script(plan), kind=kind, parent_ctx=ctx
+        )
+        tr.qid = qid
+        tr.agent_id = self.agent_id
+        return tr
+
     def _on_execute(self, msg):
         """Run a data fragment; ship bridge payloads to the merge agent."""
         qid, plan = msg["qid"], msg["plan"]
@@ -293,9 +322,10 @@ class Agent:
             if qid in self._cancelled:
                 return
             self._running[qid] = ev
+        trace = self._begin_fragment_trace(msg, qid, plan, "fragment")
         try:
             t0 = time.perf_counter()
-            outputs = self.engine.execute_plan(plan, cancel=ev)
+            outputs = self.engine.execute_plan(plan, cancel=ev, trace=trace)
             elapsed = time.perf_counter() - t0
         except Exception as e:
             with self._lock:
@@ -332,15 +362,26 @@ class Agent:
                 )
         self.bus.publish(
             f"query.{qid}.agent_done",
-            {"agent": self.agent_id, "exec_time_s": elapsed},
+            {
+                "agent": self.agent_id,
+                "exec_time_s": elapsed,
+                # Per-agent resource attribution (QueryResourceUsage):
+                # execute_plan ended the trace, so usage is final here.
+                "usage": trace.usage.to_dict(),
+            },
         )
 
     @staticmethod
     def _new_pending_merge() -> dict:
         # "keep" narrows the participating data-agent set when the
         # broker fails over a lost agent (None = everyone expected).
+        # "trace_ctx" is the broker's dispatch-span context from the
+        # merge install — the merge may RUN from whichever handler
+        # completes the bridge set (a different dispatcher thread whose
+        # ambient context is some data agent's fragment), so the
+        # install-time context is stored, not inherited.
         return {"plan": None, "expect": None, "got": {}, "got_keys": set(),
-                "keep": None}
+                "keep": None, "trace_ctx": None}
 
     def _on_merge(self, msg):
         """Install a merge fragment; runs once all bridge payloads land."""
@@ -365,6 +406,7 @@ class Agent:
                     parked if pm["keep"] is None else (pm["keep"] & parked)
                 )
             pm["plan"] = msg["plan"]
+            pm["trace_ctx"] = tracectx.extract(msg) or tracectx.current()
             pm["expect"] = {
                 (bid, aid)
                 for bid in msg["bridge_ids"]
@@ -455,10 +497,18 @@ class Agent:
                         if keep is None or a in keep]
             if payloads:
                 bridge_inputs[bid] = payloads
+        trace = self.engine.tracer.begin_query(
+            script=plan_script(pm["plan"]), kind="merge",
+            parent_ctx=pm["trace_ctx"],
+        )
+        trace.qid = qid
+        trace.agent_id = self.agent_id
         try:
+            t0 = time.perf_counter()
             outputs = self.engine.execute_plan(
-                pm["plan"], bridge_inputs=bridge_inputs
+                pm["plan"], bridge_inputs=bridge_inputs, trace=trace
             )
+            elapsed = time.perf_counter() - t0
         except Exception as e:
             self.bus.publish(
                 f"query.{qid}.results",
@@ -470,6 +520,15 @@ class Agent:
                 f"query.{qid}.results",
                 {"table": name, "batch": batch, "agent": self.agent_id},
             )
+        # Merge-tier attribution rides a role-tagged agent_done (the
+        # forwarder files it under merge_stats, keeping agent_stats ==
+        # data agents for existing consumers). BEFORE eos, so the wait
+        # loop never needs its post-eos grace budget for it.
+        self.bus.publish(
+            f"query.{qid}.agent_done",
+            {"agent": self.agent_id, "exec_time_s": elapsed,
+             "role": "merge", "usage": trace.usage.to_dict()},
+        )
         self.bus.publish(f"query.{qid}.results", {"eos": True})
 
 
@@ -517,9 +576,15 @@ class Agent:
                     },
                 )
 
+        # The streaming cursor runs on its own thread: re-bind the
+        # dispatch's trace context there so the stream's lifecycle trace
+        # joins the distributed trace (contextvars are thread-local).
+        ctx = tracectx.extract(msg) or tracectx.current()
+
         def run():
             try:
-                sq = StreamingQuery(self.engine, plan, emit, cancel=ev)
+                with tracectx.bound(ctx):
+                    sq = StreamingQuery(self.engine, plan, emit, cancel=ev)
                 sq.run(poll_interval_s=interval)
             except Exception as e:
                 if qid not in self._cancelled:

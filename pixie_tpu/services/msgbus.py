@@ -15,6 +15,8 @@ import queue
 import threading
 from typing import Callable
 
+from ..exec import tracectx
+
 
 class Subscription:
     def __init__(self, bus: "MessageBus", topic: str, fn: Callable):
@@ -23,7 +25,11 @@ class Subscription:
         self.fn = fn
         self._q: queue.Queue = queue.Queue()
         self._alive = True
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        # Named for observability (and the ack-thread regression test):
+        # one dispatcher thread per subscription, identifiable by topic.
+        self._thread = threading.Thread(
+            target=self._run, name=f"bus-sub-{topic}", daemon=True
+        )
         self._thread.start()
 
     def _run(self):
@@ -32,7 +38,12 @@ class Subscription:
             if msg is _CLOSE:
                 return
             try:
-                self.fn(msg)
+                # Distributed-trace propagation: bind the message's
+                # context envelope (if any) around the handler so work
+                # it triggers — including Engine query traces — parents
+                # under the publisher's span (tracectx.py).
+                with tracectx.bound(tracectx.extract(msg)):
+                    self.fn(msg)
             except Exception as e:  # handler errors must not kill delivery
                 self.bus._on_handler_error(self.topic, e)
 
@@ -78,7 +89,14 @@ class MessageBus:
         With a fault injector attached, the injector decides the
         delivery plan (drop/delay/duplicate); the returned count is the
         SUBSCRIBER count regardless — a NATS publisher can't observe
-        in-flight loss either."""
+        in-flight loss either.
+
+        Trace-context envelope: a publish from inside a traced scope
+        (an explicit ``tracectx.bound`` or a handler delivering a
+        context-stamped message) stamps the ambient context onto the
+        message — on a COPY, so retried publishes of a shared dict and
+        the caller's object are never mutated."""
+        msg = tracectx.attach(msg)
         inj = self.fault_injector
         if inj is not None:
             for delay_s in inj.intercept(topic, msg):

@@ -18,6 +18,7 @@ import socket
 import struct
 import threading
 
+from ..exec import tracectx
 from .msgbus import BusTimeout, MessageBus
 from .wire import WireError, decode, encode
 
@@ -321,7 +322,11 @@ class _RemoteSubscription:
             if msg is self._SENTINEL:
                 return
             try:
-                self._fn(msg)
+                # Same envelope binding as msgbus.Subscription: the
+                # distributed trace context survives the TCP hop (the
+                # wire codec carries the _trace_ctx dict unchanged).
+                with tracectx.bound(tracectx.extract(msg)):
+                    self._fn(msg)
             except Exception:  # handler errors never kill the dispatcher
                 pass
 
@@ -393,6 +398,7 @@ class RemoteBus:
         return sub
 
     def publish(self, topic: str, msg: dict) -> int:
+        msg = tracectx.attach(msg)  # envelope parity with MessageBus
         inj = self.fault_injector
         if inj is not None:
             for delay_s in inj.intercept(topic, msg):

@@ -170,28 +170,37 @@ class MetricsRegistry:
                     counts[i] += c
                 total += st["count"]
             bounds = m.buckets
-        if total == 0:
+        if total == 0 or not bounds:
+            # Zero observations (or a bucketless histogram, where every
+            # observation lands in +Inf and no finite interpolation
+            # exists): there IS no quantile — None, never a made-up 0.0.
             return None
-        out = {}
-        for q in qs:
-            rank = q * total
-            cum = 0.0
-            val = bounds[-1] if bounds else 0.0
-            for i, c in enumerate(counts):
-                if c == 0:
-                    cum += c
-                    continue
-                if cum + c >= rank:
-                    if i >= len(bounds):  # +Inf bucket
-                        val = bounds[-1] if bounds else 0.0
-                    else:
-                        lo = bounds[i - 1] if i > 0 else 0.0
-                        hi = bounds[i]
-                        val = lo + (hi - lo) * max(rank - cum, 0.0) / c
-                    break
-                cum += c
-            out[q] = val
-        return out
+        return _interpolate_quantiles(bounds, counts, total, qs)
+
+
+def _interpolate_quantiles(bounds, counts, total, qs) -> dict:
+    """histogram_quantile linear interpolation over cumulative bucket
+    counts (the +Inf bucket clamps to the highest finite bound). One
+    shared implementation for both quantile surfaces — callers
+    guarantee ``total > 0`` and non-empty ``bounds``."""
+    out = {}
+    for q in qs:
+        rank = q * total
+        cum = 0.0
+        val = bounds[-1]
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                if i >= len(bounds):  # +Inf bucket
+                    val = bounds[-1]
+                else:
+                    lo = bounds[i - 1] if i > 0 else 0.0
+                    val = lo + (bounds[i] - lo) * max(rank - cum, 0.0) / c
+                break
+            cum += c
+        out[q] = val
+    return out
 
 
 def f_le(b: float) -> str:
@@ -248,6 +257,31 @@ class Gauge(_Bound):
 
 
 class Histogram(_Bound):
+    def quantiles(self, qs=(0.5, 0.95, 0.99)):
+        """Approximate quantiles for THIS bound label set (all label
+        sets when unbound). Returns {q: value} or None on a
+        zero-observation histogram — callers never special-case an
+        empty distribution, they get None, not a crash or a fake 0."""
+        with self._lock:
+            st = self._m.values.get(self._labels)
+            bounds = self._m.buckets
+            if st is not None:
+                counts = list(st["counts"])
+                total = st["count"]
+            elif not self._labels:
+                # Unbound handle: aggregate across every label set.
+                counts = [0] * (len(bounds) + 1)
+                total = 0
+                for s in self._m.values.values():
+                    for i, c in enumerate(s["counts"]):
+                        counts[i] += c
+                    total += s["count"]
+            else:
+                return None
+        if total == 0 or not bounds:
+            return None
+        return _interpolate_quantiles(bounds, counts, total, qs)
+
     def observe(self, v: float) -> None:
         v = float(v)
         with self._lock:
@@ -285,11 +319,15 @@ class ObservabilityServer:
     OperatorExecutionStats surface, made always-on)."""
 
     def __init__(self, registry: MetricsRegistry | None = None,
-                 statusz_fn=None, health_fn=None, tracer=None):
+                 statusz_fn=None, health_fn=None, tracer=None,
+                 trace_view=None):
         self.registry = registry or default_registry
         self.statusz_fn = statusz_fn  # () -> dict
         self.health_fn = health_fn  # () -> (bool, str)
         self.tracer = tracer  # exec.trace.Tracer | None
+        # services.telemetry.ClusterTraceView | None: wire one to serve
+        # /debug/tracez — the cluster-stitched distributed-trace view.
+        self.trace_view = trace_view
         self._httpd = None
 
     def handle(self, path: str) -> tuple[int, str, str]:
@@ -325,6 +363,20 @@ class ObservabilityServer:
                 indent=1,
                 default=str,
             )
+            return (200, "application/json", body)
+        if path == "/debug/tracez" or path.startswith("/debug/tracez/"):
+            if self.trace_view is None:
+                return (404, "text/plain", "no trace view wired\n")
+            tid = path[len("/debug/tracez/"):] if "/tracez/" in path else ""
+            if tid:
+                tr = self.trace_view.get(tid)
+                if tr is None:
+                    return (404, "text/plain", f"no trace {tid}\n")
+                body = json.dumps(tr, indent=1, default=str)
+            else:
+                body = json.dumps(
+                    self.trace_view.tracez(), indent=1, default=str
+                )
             return (200, "application/json", body)
         return (404, "text/plain", "not found\n")
 

@@ -71,10 +71,24 @@ class QueryResultForwarder:
         from .tracker import TOPIC_EXPIRED
 
         q: queue.Queue = queue.Queue()
+
+        def on_ack(m):
+            # Record the ack HERE, on the subscription's dispatcher
+            # thread, so the retry manager can observe it immediately
+            # (acked_keys) without its own query.{qid}.ack subscription
+            # — ONE ack dispatcher thread per query, not two. The
+            # message still flows to the wait loop for dispatch-state
+            # bookkeeping and the watchdog reset.
+            with self._lock:
+                st = self._active.get(qid)
+                if st is not None:
+                    st["acked"].add((m.get("agent"), m.get("ack")))
+            q.put(m)
+
         subs = [
             self.bus.subscribe(f"query.{qid}.results", q.put),
             self.bus.subscribe(f"query.{qid}.agent_done", q.put),
-            self.bus.subscribe(f"query.{qid}.ack", q.put),
+            self.bus.subscribe(f"query.{qid}.ack", on_ack),
             self.bus.subscribe(f"query.{qid}.agent_lost", q.put),
             self.bus.subscribe(
                 TOPIC_EXPIRED,
@@ -95,9 +109,19 @@ class QueryResultForwarder:
                 "merge_agent": merge_agent,
                 "require_complete": require_complete,
                 "dispatch": dispatch,
+                "acked": set(),  # {(agent, kind)} — retry manager reads
                 "missing": {},  # aid -> reason
                 "trace": trace,
             }
+
+    def acked_keys(self, qid: str):
+        """{(agent, kind)} acked so far for a registered query — what
+        the broker's dispatch-retry loop polls instead of holding its
+        own ``query.{qid}.ack`` subscription (and dispatcher thread).
+        None once the query deregisters."""
+        with self._lock:
+            st = self._active.get(qid)
+            return set(st["acked"]) if st is not None else None
 
     def wait(self, qid: str, timeout_s: float) -> dict:
         """Blocks until eos/error/timeout. Returns {table: HostBatch} plus
@@ -109,6 +133,7 @@ class QueryResultForwarder:
             st = self._active[qid]
         outputs: dict = {}
         stats: dict = {}
+        merge_stats: dict = {}  # merge-tier attribution (role="merge")
         eos = False
         grace_deadline = None
         # Inactivity watchdog: only QUERY-RELEVANT activity pushes the
@@ -118,7 +143,7 @@ class QueryResultForwarder:
         try:
             while True:
                 if eos and self._complete(st, stats):
-                    return self._result(st, outputs, stats)
+                    return self._result(st, outputs, stats, merge_stats)
                 now = time.monotonic()
                 if eos:
                     # After eos, per-agent stats may still be in flight
@@ -130,7 +155,7 @@ class QueryResultForwarder:
                         grace_deadline = now + min(timeout_s, 1.0)
                     wait_s = grace_deadline - now
                     if wait_s <= 0:
-                        return self._result(st, outputs, stats)
+                        return self._result(st, outputs, stats, merge_stats)
                 else:
                     wait_s = deadline - now
                     if wait_s <= 0:
@@ -142,7 +167,7 @@ class QueryResultForwarder:
                     msg = st["queue"].get(timeout=wait_s)
                 except queue.Empty:
                     if eos:
-                        return self._result(st, outputs, stats)
+                        return self._result(st, outputs, stats, merge_stats)
                     # Watchdog fired (query_result_forwarder.go:241):
                     # cancel the query everywhere and fail the stream.
                     self.cancel(qid)
@@ -209,7 +234,17 @@ class QueryResultForwarder:
                         msg.get("reason", "lost"),
                     )
                 elif "exec_time_s" in msg:
-                    stats[msg["agent"]] = {"exec_time_s": msg["exec_time_s"]}
+                    entry = {"exec_time_s": msg["exec_time_s"]}
+                    if isinstance(msg.get("usage"), dict):
+                        entry["usage"] = dict(msg["usage"])
+                    if msg.get("role") == "merge":
+                        # Merge-tier usage is attribution, not a data
+                        # shard: kept out of agent_stats so expected-set
+                        # completion (and existing consumers) see data
+                        # agents only.
+                        merge_stats[msg["agent"]] = entry
+                    else:
+                        stats[msg["agent"]] = entry
                 elif msg.get("eos"):
                     eos = True
                 elif "table" in msg:
@@ -272,10 +307,12 @@ class QueryResultForwarder:
             f"dispatch: {dict(sorted(st['dispatch'].items()))})"
         )
 
-    def _result(self, st: dict, outputs: dict, stats: dict) -> dict:
+    def _result(self, st: dict, outputs: dict, stats: dict,
+                merge_stats: dict | None = None) -> dict:
         res = {
             "tables": outputs,
             "agent_stats": stats,
+            "merge_stats": dict(merge_stats or {}),
             "partial": bool(st["missing"]),
             "missing_agents": sorted(st["missing"]),
         }
@@ -358,6 +395,12 @@ class QueryBroker:
         from ..exec.trace import Tracer
 
         self.tracer = Tracer()
+        # Cluster-stitched distributed traces (/debug/tracez): the
+        # broker's own dispatch spans + the span summaries agents
+        # publish on telemetry.spans, grouped by trace id.
+        from .telemetry import ClusterTraceView
+
+        self.trace_view = ClusterTraceView(bus, tracer=self.tracer)
         # Dynamic-tracing support (the MutationExecutor dependency,
         # mutation_executor.go:84); wire a TracepointRegistry to enable.
         self.tracepoints = None
@@ -529,23 +572,53 @@ class QueryBroker:
         (the forwarder turns it into failover or fail-fast) or, when
         ``on_lost(aid, kind)`` is given (streaming path), calls that
         instead. ``live()`` gates the loop; default: the forwarder
-        registration is still active."""
+        registration is still active.
+
+        Ack observation: a forwarder-REGISTERED query (the
+        execute_script path) already holds a ``query.{qid}.ack``
+        subscription whose callback records every ack — the retry
+        manager observes THAT state (``forwarder.acked_keys``) instead
+        of spawning a second subscription + dispatcher thread per query.
+        Only the streaming path (which never registers) keeps its own
+        dedicated ack subscription."""
         from ..config import get_flag
 
         retries = int(get_flag("dispatch_retries"))
         base_s = float(get_flag("dispatch_backoff_ms")) / 1e3
+        use_forwarder_acks = live is None and self.forwarder.is_active(qid)
         if live is None:
             live = lambda: self.forwarder.is_active(qid)  # noqa: E731
         acked: set = set()
         all_acked = threading.Event()
         keys = set(dispatches)
+        ack_sub = None
+        if use_forwarder_acks:
+            def wait_acked(wait_s: float) -> bool:
+                # Poll the forwarder's ack state on a short cadence
+                # (bounded by the wait budget): the acks were recorded
+                # on the forwarder's ack dispatcher the instant they
+                # arrived, so freshness matches the old subscription.
+                deadline = time.monotonic() + wait_s
+                while True:
+                    got = self.forwarder.acked_keys(qid)
+                    if got is None:
+                        return True  # deregistered: query over, stand down
+                    acked.clear()
+                    acked.update(got)
+                    if keys <= acked:
+                        return True
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return False
+                    time.sleep(min(left, 0.05))
+        else:
+            def on_ack(m):
+                acked.add((m.get("agent"), m.get("ack")))
+                if keys <= acked:
+                    all_acked.set()
 
-        def on_ack(m):
-            acked.add((m.get("agent"), m.get("ack")))
-            if keys <= acked:
-                all_acked.set()
-
-        ack_sub = self.bus.subscribe(f"query.{qid}.ack", on_ack)
+            ack_sub = self.bus.subscribe(f"query.{qid}.ack", on_ack)
+            wait_acked = all_acked.wait
         for topic, msg in dispatches.values():
             self.bus.publish(topic, msg)
 
@@ -556,7 +629,7 @@ class QueryBroker:
                     wait_s = min(
                         base_s * (2 ** attempt), MAX_DISPATCH_BACKOFF_S
                     ) * (1.0 + 0.25 * rng.random())
-                    if all_acked.wait(wait_s):
+                    if wait_acked(wait_s):
                         return
                     if not live():
                         return  # query already finished/failed
@@ -590,7 +663,8 @@ class QueryBroker:
                                    f"{retries} retries"},
                     )
             finally:
-                ack_sub.unsubscribe()
+                if ack_sub is not None:
+                    ack_sub.unsubscribe()
 
         threading.Thread(
             target=run, name=f"dispatch-{qid}", daemon=True
@@ -611,6 +685,7 @@ class QueryBroker:
             sub.unsubscribe()
         for sub in getattr(self, "_serve_subs", []):
             sub.unsubscribe()
+        self.trace_view.close()
 
     def execute_script(
         self,
@@ -724,6 +799,7 @@ class QueryBroker:
             raise QueryError(str(e)) from e
 
         qid = uuid.uuid4().hex[:12]
+        trace.qid = qid
         data_agents = list(dplan.data_agent_ids)
         if not dplan.kelvin_agent_ids:
             raise QueryError("no live agent available to run the query")
@@ -768,10 +844,35 @@ class QueryBroker:
                 "data_agents": ",".join(data_agents),
                 "merge_agent": merge_agent,
             })
+            # Trace stitching: every dispatch carries the dispatch
+            # span's context envelope, so each agent's fragment/merge
+            # trace parents under THIS span — one distributed trace,
+            # broker -> N agents -> merge (exec/tracectx.py). Stamped
+            # into the stored message dicts so background RETRIES of a
+            # dispatch carry the same context.
+            from ..exec import tracectx
+
+            ctx = trace.ctx(sp)
+            for key, (topic, msg) in list(dispatches.items()):
+                dispatches[key] = (topic, tracectx.attach(msg, ctx))
             self._dispatch_with_retry(qid, dispatches, trace=trace)
         result = self.forwarder.wait(qid, timeout_s)
         result["qid"] = qid
         result["distributed_plan"] = dplan
+        # Fold per-agent resource records into the broker's trace: the
+        # distributed query's cost with per-agent attribution (served by
+        # broker.debug_queries / `px debug queries` / /debug/queryz).
+        # Built locally and assigned ONCE: the trace is already visible
+        # to concurrent debug surfaces (to_dict iterates agent_usage),
+        # so in-place insertion would race their snapshot.
+        agent_usage = {}
+        for aid, entry in {**result.get("agent_stats", {}),
+                           **result.get("merge_stats", {})}.items():
+            u = entry.get("usage")
+            if isinstance(u, dict):
+                agent_usage[aid] = dict(u)
+                trace.usage.merge(u)
+        trace.agent_usage = agent_usage
         if mutation_states is not None:
             result["mutations"] = mutation_states
         return result
@@ -939,6 +1040,9 @@ class QueryBroker:
           broker.schemas  {} -> {ok, schemas: {table: Relation}}
           broker.agents   {} -> {ok, agents: [agent info dict]}
           broker.scripts  {} -> {ok, scripts: [name]}
+          broker.debug_queries {limit?} -> {ok, in_flight, queries}
+                          recent distributed-query traces with resource
+                          usage + per-agent attribution (px debug queries)
         """
 
         def _reply(msg, payload):
@@ -1040,6 +1144,20 @@ class QueryBroker:
 
             _reply(msg, {"ok": True, "scripts": list_scripts()})
 
+        def _on_debug_queries(msg):
+            # `px debug queries`: the broker's recent distributed-query
+            # traces — status, duration, resource usage with per-agent
+            # attribution (QueryTrace.to_dict carries usage/agent_usage).
+            try:
+                n = max(1, min(int(msg.get("limit", 50)), 500))
+            except (TypeError, ValueError):
+                n = 50
+            _reply(msg, {
+                "ok": True,
+                "in_flight": self.tracer.in_flight(),
+                "queries": self.tracer.recent()[:n],
+            })
+
         self._serve_subs = [
             self.bus.subscribe("broker.execute", _guarded(_on_execute)),
             self.bus.subscribe(
@@ -1051,4 +1169,7 @@ class QueryBroker:
             self.bus.subscribe("broker.schemas", _guarded(_on_schemas)),
             self.bus.subscribe("broker.agents", _guarded(_on_agents)),
             self.bus.subscribe("broker.scripts", _guarded(_on_scripts)),
+            self.bus.subscribe(
+                "broker.debug_queries", _guarded(_on_debug_queries)
+            ),
         ]

@@ -1,0 +1,318 @@
+"""Telemetry as tables: the engine's own history, queryable with PxL.
+
+The platform that observes your cluster is observable the same way: a
+``TelemetryCollector`` registers as a finished-trace listener on an
+engine's ``Tracer`` (``exec/trace.py``) and folds every trace + its
+``QueryResourceUsage`` into real ``table_store`` tables —
+
+- ``__queries__``  one row per finished query/fragment/merge trace
+- ``__spans__``    one row per span (bounded per trace)
+- ``__agents__``   the folding agent's running totals per finished trace
+
+— with bounded retention (each table's byte-budget ring expires its own
+oldest rows, the same mechanism that bounds ingest tables). Bundled PxL
+scripts (``px/slow_queries``, ``px/query_cost``, ``px/agent_health``)
+run over these through the NORMAL engine path: on a cluster the
+distributed planner fans the scan across every agent's local telemetry,
+so per-agent attribution falls out of the ``agent_id`` column.
+
+The collector also closes the planner's feedback loop (PAPERS.md
+"Online Sketch-based Query Optimization", arXiv:2102.02440): observed
+aggregate output cardinalities per script hash are retained and exposed
+through ``Engine._compile_table_stats`` under ``__observed__``, where
+``push_agg_through_join`` floors its partial-agg capacity at reality.
+
+``ClusterTraceView`` is the stitching half (PAPERS.md "Near Data
+Processing in Taurus", 2506.20010 — ship span summaries, not rows):
+agents publish the spans of traces that carry a distributed parent
+context on ``telemetry.spans``, the broker's view groups them with its
+own dispatch spans by trace id, and ``/debug/tracez`` renders one
+coherent waterfall per distributed query.
+
+Both classes run OFF the engine's hot path: folding happens in
+``Tracer.end_query`` after the exec guard is released, uses host lists
+only (no device work, no syncs — registered in ``PXLINT_HOT_REGIONS``),
+and all shared state is lock-guarded (bus dispatcher threads).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+from ..config import get_flag
+from ..ingest.schemas import TELEMETRY_SCHEMAS
+
+#: Bus topic distributed-trace span summaries ride on (agent -> broker).
+TOPIC_SPANS = "telemetry.spans"
+
+#: Span rows folded/published per trace (the trace itself caps spans at
+#: 512; telemetry keeps the head — root/compile/fragments come first).
+MAX_SPAN_ROWS = 128
+
+#: Observed-cardinality entries retained (per script hash; LRU-evicted).
+MAX_OBSERVED = 256
+
+
+def _span_rows(trace, agent_id: str, end_ns: int) -> dict:
+    spans = trace.spans[:MAX_SPAN_ROWS]
+    return {
+        "time_": [s.start_unix_nano or end_ns for s in spans],
+        "trace_id": [trace.trace_id] * len(spans),
+        "span_id": [s.span_id for s in spans],
+        "parent_id": [s.parent_id for s in spans],
+        "name": [s.name for s in spans],
+        "agent_id": [agent_id] * len(spans),
+        "duration_ms": [
+            ((s.end_unix_nano - s.start_unix_nano) / 1e6
+             if s.end_unix_nano and s.start_unix_nano else 0.0)
+            for s in spans
+        ],
+    }
+
+
+def _span_summaries(trace) -> list:
+    """Compact wire form of a trace's spans (ClusterTraceView rows)."""
+    out = []
+    for s in trace.spans[:MAX_SPAN_ROWS]:
+        d = {
+            "span_id": s.span_id,
+            "parent_id": s.parent_id,
+            "name": s.name,
+            "start_unix_nano": int(s.start_unix_nano),
+            "end_unix_nano": int(s.end_unix_nano),
+        }
+        status = s.attributes.get("status")
+        if status:
+            d["status"] = str(status)
+        out.append(d)
+    return out
+
+
+class TelemetryCollector:
+    """Folds one engine's finished traces into its own table store."""
+
+    def __init__(self, engine, agent_id: str = "engine",
+                 kind: str = "engine", bus=None):
+        self.engine = engine
+        self.agent_id = agent_id
+        self.kind = kind
+        self.bus = bus
+        self._lock = threading.Lock()
+        self._totals = {
+            "queries": 0, "errors": 0, "bytes_staged": 0,
+            "device_ms": 0.0, "wire_bytes": 0,
+        }
+        self._observed: "OrderedDict[str, dict]" = OrderedDict()
+        self._installed = False
+        self.fold_errors = 0  # visible health of the fold path itself
+
+    # -- lifecycle -----------------------------------------------------------
+    def install(self) -> "TelemetryCollector":
+        """Create the telemetry tables (bounded rings) and start folding.
+        Idempotent; returns self."""
+        if self._installed:
+            return self
+        budget = max(int(get_flag("telemetry_table_mb")), 1) << 20
+        for name, rel in TELEMETRY_SCHEMAS.items():
+            if self.engine.table_store.relation(name) is None:
+                self.engine.create_table(name, rel, max_bytes=budget)
+        self.engine.tracer.add_listener(self.on_trace)
+        self.engine.telemetry = self
+        self._installed = True
+        return self
+
+    # -- the fold (Tracer listener) ------------------------------------------
+    def on_trace(self, trace) -> None:
+        # Tracer._notify already contains exceptions, but count them
+        # here too so a schema drift is visible, not silent.
+        try:
+            self._fold(trace)
+        except Exception:
+            with self._lock:
+                self.fold_errors += 1
+            raise
+
+    def _fold(self, trace) -> None:
+        end_ns = trace.end_unix_nano or time.time_ns()
+        u = trace.usage
+        agent = trace.agent_id or self.agent_id
+        self.engine.append_data("__queries__", {
+            "time_": [end_ns],
+            "trace_id": [trace.trace_id],
+            "qid": [trace.qid or ""],
+            "agent_id": [agent],
+            "kind": [trace.kind],
+            "script_hash": [trace.script_hash],
+            "script": [trace.script[:200]],
+            "status": [trace.status],
+            "duration_ms": [trace.duration_s * 1e3],
+            "rows_in": [int(u.rows_in)],
+            "rows_out": [int(u.rows_out)],
+            "windows": [int(u.windows)],
+            "bytes_staged": [int(u.bytes_staged)],
+            "device_ms": [float(u.device_ms)],
+            "compile_ms": [float(u.compile_ms)],
+            "stall_ms": [float(u.stall_ms)],
+            "wire_bytes": [int(u.wire_bytes)],
+            "retries": [int(u.retries)],
+            "skipped_windows": [int(u.skipped_windows)],
+        })
+        self.engine.append_data("__spans__", _span_rows(trace, agent, end_ns))
+        with self._lock:
+            t = self._totals
+            t["queries"] += 1
+            if trace.status == "error":
+                t["errors"] += 1
+            t["bytes_staged"] += int(u.bytes_staged)
+            t["device_ms"] += float(u.device_ms)
+            t["wire_bytes"] += int(u.wire_bytes)
+            snapshot = dict(t)
+            self._record_observed(trace)
+        self.engine.append_data("__agents__", {
+            "time_": [end_ns],
+            "agent_id": [self.agent_id],
+            "kind": [self.kind],
+            "queries_total": [snapshot["queries"]],
+            "errors_total": [snapshot["errors"]],
+            "bytes_staged_total": [snapshot["bytes_staged"]],
+            "device_ms_total": [snapshot["device_ms"]],
+            "wire_bytes_total": [snapshot["wire_bytes"]],
+        })
+        # Distributed participants ship their span summary to the
+        # broker's ClusterTraceView (sketch-sized telemetry, not rows).
+        if self.bus is not None and trace.parent_ctx:
+            self.bus.publish(TOPIC_SPANS, {
+                "trace_id": trace.trace_id,
+                "agent": agent,
+                "spans": _span_summaries(trace),
+            })
+
+    # -- planner feedback ----------------------------------------------------
+    def _record_observed(self, trace) -> None:
+        """Caller holds self._lock. Retain observed output cardinalities
+        per script hash: the max aggregate-fragment rows_out is the true
+        group count the sketch-driven sizing only estimated."""
+        if trace.status != "ok":
+            return
+        agg_groups = 0
+        for f in trace.stats.fragments:
+            if any(op in ("AggOp", "rebucket") for op in f.ops):
+                agg_groups = max(agg_groups, int(f.rows_out))
+        ent = self._observed.pop(trace.script_hash, None) or {
+            "agg_groups": 0, "rows_out": 0, "runs": 0,
+        }
+        ent["agg_groups"] = max(ent["agg_groups"], agg_groups)
+        ent["rows_out"] = max(ent["rows_out"], int(trace.rows_out))
+        ent["runs"] += 1
+        self._observed[trace.script_hash] = ent  # re-insert = most recent
+        while len(self._observed) > MAX_OBSERVED:
+            self._observed.popitem(last=False)
+
+    def observed(self) -> dict:
+        """{script_hash: {agg_groups, rows_out, runs}} snapshot — what
+        ``Engine._compile_table_stats`` exposes under ``__observed__``."""
+        with self._lock:
+            return {h: dict(e) for h, e in self._observed.items()}
+
+    def totals(self) -> dict:
+        with self._lock:
+            return dict(self._totals)
+
+
+class ClusterTraceView:
+    """Cluster-wide stitched traces for ``/debug/tracez`` (broker role).
+
+    Collects span summaries from two feeds — the local tracer's finished
+    traces (the broker's compile/dispatch/failover spans) and agents'
+    ``telemetry.spans`` publications — grouped by trace id in a bounded
+    LRU. A distributed query therefore renders as ONE trace: the
+    broker's dispatch span parenting every agent's fragment spans.
+    """
+
+    def __init__(self, bus=None, tracer=None, max_traces: int = 64,
+                 max_spans: int = 1024):
+        self.max_traces = max_traces
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        self._sub = (
+            bus.subscribe(TOPIC_SPANS, self._on_spans)
+            if bus is not None else None
+        )
+        if tracer is not None:
+            tracer.add_listener(self.add_trace)
+
+    def close(self) -> None:
+        if self._sub is not None:
+            self._sub.unsubscribe()
+            self._sub = None
+
+    # -- feeds ---------------------------------------------------------------
+    def add_trace(self, trace) -> None:
+        """Local-tracer listener (broker's own traces)."""
+        self._ingest(
+            trace.trace_id, trace.agent_id or "broker",
+            _span_summaries(trace),
+        )
+
+    def _on_spans(self, msg) -> None:
+        tid, spans = msg.get("trace_id"), msg.get("spans")
+        if isinstance(tid, str) and isinstance(spans, list):
+            self._ingest(tid, str(msg.get("agent", "?")), spans)
+
+    def _ingest(self, trace_id: str, agent: str, spans: list) -> None:
+        with self._lock:
+            ent = self._traces.pop(trace_id, None) or {
+                "spans": [], "agents": set(), "updated_unix_nano": 0,
+            }
+            room = self.max_spans - len(ent["spans"])
+            if room > 0:
+                ent["spans"].extend(spans[:room])
+            ent["agents"].add(agent)
+            ent["updated_unix_nano"] = time.time_ns()
+            self._traces[trace_id] = ent  # re-insert = most recent
+            while len(self._traces) > self.max_traces:
+                self._traces.popitem(last=False)
+
+    # -- the /debug/tracez surface -------------------------------------------
+    def tracez(self) -> dict:
+        with self._lock:
+            rows = [
+                {
+                    "trace_id": tid,
+                    "agents": sorted(ent["agents"]),
+                    "spans": len(ent["spans"]),
+                    "root": next(
+                        (s for s in ent["spans"] if not s["parent_id"]),
+                        None,
+                    ),
+                    "updated_unix_nano": ent["updated_unix_nano"],
+                }
+                for tid, ent in reversed(self._traces.items())
+            ]
+        return {"traces": rows}
+
+    def get(self, trace_id: str) -> dict | None:
+        """Full stitched span list for one trace (newest-first feed
+        order preserved per participant)."""
+        with self._lock:
+            ent = self._traces.get(trace_id)
+            if ent is None:
+                return None
+            return {
+                "trace_id": trace_id,
+                "agents": sorted(ent["agents"]),
+                "spans": [dict(s) for s in ent["spans"]],
+            }
+
+
+def enable_self_telemetry(engine, agent_id: str = "engine",
+                          kind: str = "engine",
+                          bus=None) -> TelemetryCollector:
+    """Wire a TelemetryCollector onto an engine (idempotent: an engine
+    that already has one keeps it)."""
+    if getattr(engine, "telemetry", None) is not None:
+        return engine.telemetry
+    return TelemetryCollector(engine, agent_id, kind, bus=bus).install()

@@ -107,6 +107,14 @@ class HostBatch:
         rel = relation if relation is not None else Relation(rel_items)
         return cls(relation=rel, cols=cols, length=length or 0, dicts=out_dicts)
 
+    @property
+    def nbytes(self) -> int:
+        """Total plane bytes (the resource-accounting unit for staging
+        and bridge-wire costs; dictionary strings not included)."""
+        return int(sum(
+            p.nbytes for planes in self.cols.values() for p in planes
+        ))
+
     def to_pydict(self, decode_strings: bool = True) -> dict[str, np.ndarray]:
         out: dict[str, np.ndarray] = {}
         for name, dt in self.relation.items():

@@ -27,6 +27,17 @@
 #                                      plan. Non-zero exit on any
 #                                      non-baselined finding. Also runs
 #                                      inside --tier1.
+#   ./run_tests.sh --obs               self-observability gate: the
+#                                      self-telemetry + trace-stitching
+#                                      suites (tests/test_telemetry.py,
+#                                      tests/test_trace_stitching.py)
+#                                      plus plan-verifier compilation of
+#                                      the bundled self-monitoring PxL
+#                                      scripts against the telemetry
+#                                      table schemas (see
+#                                      pixie_tpu/analysis/obs_check.py).
+#                                      The script-compile half also runs
+#                                      inside --tier1.
 #   ./run_tests.sh --bench-join        quick join gate: a small
 #                                      selectivity/skew sweep (uniform
 #                                      vs zipf keys, low/high match
@@ -37,6 +48,16 @@
 #                                      numpy reference join (see
 #                                      tools/bench_join.py).
 case "$1" in
+  --obs)
+    shift
+    rc=0
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pixie_tpu.analysis.obs_check || rc=$?
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest -q tests/test_telemetry.py \
+      tests/test_trace_stitching.py "$@" || rc=$?
+    exit $rc
+    ;;
   --bench-join)
     shift
     exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -82,6 +103,10 @@ case "$1" in
     # Static-analysis gate first (fast; see --analyze): a non-baselined
     # lint finding or a bench-shape verification failure fails tier 1.
     "$0" --analyze; rc_analyze=$?
+    # Self-observability script gate (the pytest half of --obs already
+    # runs inside the main sweep below).
+    env JAX_PLATFORMS=cpu python -m pixie_tpu.analysis.obs_check \
+      || rc_analyze=1
     # ROADMAP.md "Tier-1 verify", verbatim:
     set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); [ $rc -eq 0 ] && rc=$rc_analyze; exit $rc
     ;;

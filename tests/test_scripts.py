@@ -362,6 +362,52 @@ def _seed_all_tables(eng, n=3000, seed=11):
         "status": rng.choice([0, 0, 0, 2], n).astype(np.int64),
         "error": [("", "RuntimeError('boom')")[i % 2] for i in range(n)],
     })
+    # Self-telemetry tables (services/telemetry.py fold shape): synthetic
+    # history so px/slow_queries, px/query_cost and px/agent_health have
+    # rows (the fold itself is exercised in tests/test_telemetry.py).
+    m = 40
+    tm = np.arange(m, dtype=np.int64) * 10**6
+    eng.append_data("__queries__", {
+        "time_": tm,
+        "trace_id": [f"{i:032x}" for i in range(m)],
+        "qid": [("", f"q{i % 5}")[i % 2] for i in range(m)],
+        "agent_id": [f"pem-{i % 3}" for i in range(m)],
+        "kind": [("query", "fragment", "merge")[i % 3] for i in range(m)],
+        "script_hash": [f"hash-{i % 4}" for i in range(m)],
+        "script": ["import px"] * m,
+        "status": [("ok", "ok", "ok", "error")[i % 4] for i in range(m)],
+        "duration_ms": rng.uniform(1, 500, m),
+        "rows_in": rng.integers(0, 10**6, m),
+        "rows_out": rng.integers(0, 10**4, m),
+        "windows": rng.integers(0, 64, m),
+        "bytes_staged": rng.integers(0, 10**8, m),
+        "device_ms": rng.uniform(0, 100, m),
+        "compile_ms": rng.uniform(0, 50, m),
+        "stall_ms": rng.uniform(0, 20, m),
+        "wire_bytes": rng.integers(0, 10**6, m),
+        "retries": rng.integers(0, 3, m),
+        "skipped_windows": rng.integers(0, 8, m),
+    })
+    eng.append_data("__spans__", {
+        "time_": tm,
+        "trace_id": [f"{i % 8:032x}" for i in range(m)],
+        "span_id": [f"{i:016x}" for i in range(m)],
+        "parent_id": [("", f"{i - 1:016x}")[i % 2] for i in range(m)],
+        "name": [("query", "compile", "fragment", "window.compute")[i % 4]
+                 for i in range(m)],
+        "agent_id": [f"pem-{i % 3}" for i in range(m)],
+        "duration_ms": rng.uniform(0, 100, m),
+    })
+    eng.append_data("__agents__", {
+        "time_": tm,
+        "agent_id": [f"pem-{i % 3}" for i in range(m)],
+        "kind": ["pem"] * m,
+        "queries_total": np.arange(m, dtype=np.int64) + 1,
+        "errors_total": rng.integers(0, 3, m),
+        "bytes_staged_total": rng.integers(0, 10**9, m),
+        "device_ms_total": rng.uniform(0, 1000, m),
+        "wire_bytes_total": rng.integers(0, 10**7, m),
+    })
 
 
 @pytest.fixture(scope="module")

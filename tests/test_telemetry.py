@@ -1,0 +1,326 @@
+"""Self-telemetry tests (ISSUE 10): the TelemetryCollector fold,
+telemetry-as-tables through the normal engine path, bundled
+self-monitoring scripts, planner feedback, and the metrics satellites
+(zero-observation quantiles, pixie_trace_dropped_total).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pixie_tpu import config
+from pixie_tpu.exec import Engine
+from pixie_tpu.exec.trace import Tracer
+from pixie_tpu.ingest.schemas import TELEMETRY_SCHEMAS
+from pixie_tpu.scripts import load_script
+from pixie_tpu.services.observability import MetricsRegistry
+from pixie_tpu.services.telemetry import (
+    TelemetryCollector,
+    enable_self_telemetry,
+)
+
+W = 1 << 10
+
+AGG_Q = (
+    "import px\n"
+    "df = px.DataFrame(table='t')\n"
+    "df = df.groupby('k').agg(n=('v', px.count), s=('v', px.sum))\n"
+    "px.display(df)\n"
+)
+
+
+def _mk_engine(n=3 * W + 7, telemetry=True):
+    eng = Engine(window_rows=W)
+    rng = np.random.default_rng(3)
+    eng.append_data("t", {
+        "time_": np.arange(n, dtype=np.int64),
+        "k": rng.integers(0, 11, n),
+        "v": rng.integers(0, 1000, n),
+    })
+    if telemetry:
+        enable_self_telemetry(eng, agent_id="eng0")
+    return eng
+
+
+def _pydict(eng, table, max_rows=10_000):
+    out = eng.execute_query(
+        f"import px\npx.display(px.DataFrame(table='{table}'))\n",
+        max_output_rows=max_rows,
+    )
+    return out["output"].to_pydict()
+
+
+class TestCollectorFold:
+    def test_queries_table_row_per_query(self):
+        eng = _mk_engine()
+        eng.execute_query(AGG_Q)
+        d = _pydict(eng, "__queries__")
+        assert len(d["trace_id"]) == 1
+        assert d["kind"][0] == "query" and d["status"][0] == "ok"
+        assert d["agent_id"][0] == "eng0"
+        assert d["rows_in"][0] == 3 * W + 7
+        assert d["windows"][0] >= 3
+        assert d["duration_ms"][0] > 0
+        assert d["device_ms"][0] >= 0 and d["compile_ms"][0] > 0
+        tr = eng.tracer.last()  # the __queries__ scan itself
+        assert tr.trace_id == d["trace_id"][0] or tr.status == "ok"
+
+    def test_spans_table_parents_consistent(self):
+        eng = _mk_engine()
+        eng.execute_query(AGG_Q)
+        d = _pydict(eng, "__spans__")
+        names = set(d["name"])
+        assert {"query", "compile", "fragment"} <= names
+        ids = set(d["span_id"])
+        roots = [p for p in d["parent_id"] if p == ""]
+        assert roots  # the query root
+        assert all(p in ids for p in d["parent_id"] if p)
+        assert all(t == d["trace_id"][0] for t in d["trace_id"])
+
+    def test_agents_table_totals_monotonic(self):
+        eng = _mk_engine()
+        for _ in range(3):
+            eng.execute_query(AGG_Q)
+        d = _pydict(eng, "__agents__")
+        totals = list(d["queries_total"])
+        assert totals == sorted(totals) and totals[-1] >= 3
+        assert set(d["agent_id"]) == {"eng0"}
+
+    def test_error_queries_folded_and_counted(self):
+        eng = _mk_engine()
+        with pytest.raises(Exception):
+            eng.execute_query(
+                "import px\npx.display(px.DataFrame(table='nope'))\n"
+            )
+        d = _pydict(eng, "__queries__")
+        assert "error" in set(d["status"])
+        a = _pydict(eng, "__agents__")
+        assert max(a["errors_total"]) >= 1
+
+    def test_staging_bytes_recorded_without_device_cache(self):
+        eng = _mk_engine(telemetry=False)
+        enable_self_telemetry(eng, agent_id="eng0")
+        with config.override_flag("device_residency", False):
+            eng.execute_query(AGG_Q)
+        d = _pydict(eng, "__queries__")
+        assert d["bytes_staged"][0] > 0  # real host->device transfer
+
+    def test_retention_bounded_by_budget(self):
+        with config.override_flag("telemetry_table_mb", 2):
+            eng = _mk_engine()
+        for name in TELEMETRY_SCHEMAS:
+            t = eng.tables[name]
+            assert t.max_bytes == 2 << 20, name
+
+    def test_install_idempotent_and_listener_single(self):
+        eng = _mk_engine()
+        c1 = eng.telemetry
+        c2 = enable_self_telemetry(eng, agent_id="other")
+        assert c2 is c1
+        eng.execute_query(AGG_Q)
+        d = _pydict(eng, "__queries__")
+        assert len(d["trace_id"]) == 1  # one fold, not two
+
+    def test_fold_never_fails_query(self):
+        eng = _mk_engine()
+        # Sabotage: drop a telemetry table's relation so the fold raises.
+        eng.telemetry.engine = None
+        eng.execute_query(AGG_Q)  # must not raise
+        assert eng.tracer.last().status == "ok"
+
+
+class TestBundledScripts:
+    def test_slow_queries_runs_over_own_history(self):
+        eng = _mk_engine()
+        for _ in range(2):
+            eng.execute_query(AGG_Q)
+        out = eng.execute_query(load_script("px/slow_queries").pxl)
+        d = out["output"].to_pydict()
+        assert len(d["script_hash"]) >= 1
+        assert (d["n"] >= 1).all() and (d["max_ms"] >= d["mean_ms"] - 1e-6).all()
+
+    def test_query_cost_attributes_by_agent(self):
+        eng = _mk_engine()
+        eng.execute_query(AGG_Q)
+        out = eng.execute_query(load_script("px/query_cost").pxl)
+        d = out["output"].to_pydict()
+        assert set(d["agent_id"]) == {"eng0"}
+        assert {"bytes_staged", "device_ms", "wire_bytes", "retries"} <= set(d)
+
+    def test_agent_health_latest_totals(self):
+        eng = _mk_engine()
+        for _ in range(2):
+            eng.execute_query(AGG_Q)
+        out = eng.execute_query(load_script("px/agent_health").pxl)
+        d = out["output"].to_pydict()
+        assert list(d["agent_id"]) == ["eng0"]
+        assert d["queries_total"][0] >= 2
+
+
+class TestPlannerFeedback:
+    def test_observed_cardinalities_recorded(self):
+        eng = _mk_engine()
+        eng.execute_query(AGG_Q)
+        obs = eng.telemetry.observed()
+        tr = eng.tracer.get(
+            _pydict(eng, "__queries__")["trace_id"][0]
+        )
+        ent = obs[tr.script_hash]
+        assert ent["agg_groups"] == 11 and ent["runs"] == 1
+
+    def test_exposed_through_compile_table_stats(self):
+        eng = _mk_engine()
+        eng.execute_query(AGG_Q)
+        stats = eng._compile_table_stats()
+        assert "__observed__" in stats
+        assert any(e["agg_groups"] == 11 for e in stats["__observed__"].values())
+
+    def test_compile_resolves_observed_self(self):
+        import hashlib
+
+        from pixie_tpu.planner import CompilerState, compile_pxl
+        from pixie_tpu.types.dtypes import DataType
+        from pixie_tpu.types.relation import Relation
+        from pixie_tpu.udf.registry import default_registry
+
+        q = AGG_Q
+        h = hashlib.sha256(q.encode()).hexdigest()[:12]
+        state = CompilerState(
+            schemas={"t": Relation([
+                ("time_", DataType.TIME64NS), ("k", DataType.INT64),
+                ("v", DataType.INT64),
+            ])},
+            registry=default_registry(),
+            table_stats={"__observed__": {h: {"agg_groups": 123}}},
+        )
+        compile_pxl(q, state)
+        assert state.table_stats["__observed_self__"]["agg_groups"] == 123
+
+    def test_push_agg_through_join_floors_at_observed(self):
+        """A drifted (too-small) sketch NDV under-sizes the partial agg;
+        the observed floor from a past run corrects it."""
+        import hashlib
+
+        from pixie_tpu.exec.plan import AggOp
+        from pixie_tpu.planner import CompilerState, compile_pxl
+        from pixie_tpu.types.dtypes import DataType
+        from pixie_tpu.types.relation import Relation
+        from pixie_tpu.udf.registry import default_registry
+
+        T, I = DataType.TIME64NS, DataType.INT64
+        schemas = {
+            "conn_l": Relation([("time_", T), ("k", I), ("b", I)]),
+            "conn_r": Relation([("time_", T), ("k", I), ("v", I)]),
+        }
+        q = (
+            "import px\n"
+            "l = px.DataFrame(table='conn_l')\n"
+            "r = px.DataFrame(table='conn_r')\n"
+            "g = l.merge(r, how='inner', left_on=['k'], right_on=['k'],"
+            " suffixes=['', '_r'])\n"
+            "out = g.groupby('b').agg(n=('v', px.count))\n"
+            "px.display(out)\n"
+        )
+        h = hashlib.sha256(q.encode()).hexdigest()[:12]
+
+        def partial_groups(table_stats):
+            state = CompilerState(
+                schemas=dict(schemas), registry=default_registry(),
+                table_stats=table_stats,
+            )
+            plan = compile_pxl(q, state).plan
+            paj = [
+                n.op for n in plan.nodes.values()
+                if isinstance(n.op, AggOp)
+                and any(a.out_name.startswith("__paj_") for a in n.op.aggs)
+            ]
+            assert paj, "eager-agg rewrite did not fire"
+            return paj[0].max_groups
+
+        ndv_only = partial_groups(
+            {"conn_r": {"rows": 1000, "ndv": {"k": 100}}}
+        )
+        with_observed = partial_groups({
+            "conn_r": {"rows": 1000, "ndv": {"k": 100}},
+            "__observed__": {h: {"agg_groups": 100_000}},
+        })
+        assert with_observed >= 100_000
+        assert with_observed > ndv_only
+
+
+class TestQuantilesZeroObservation:
+    """Satellite: quantiles must return None on a zero-observation
+    histogram instead of misbehaving (AttributeError / fake values)."""
+
+    def test_registry_quantiles_unobserved_is_none(self):
+        reg = MetricsRegistry()
+        reg.histogram("pixie_zero_seconds", "never observed")
+        assert reg.quantiles("pixie_zero_seconds") is None
+
+    def test_registry_quantiles_no_finite_buckets_is_none(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("pixie_bucketless_seconds", "x", buckets=())
+        h.observe(1.0)
+        assert reg.quantiles("pixie_bucketless_seconds") is None
+
+    def test_bound_histogram_quantiles_method(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("pixie_q_seconds", "x", buckets=(1.0, 2.0))
+        assert h.quantiles() is None  # zero observations: None, no crash
+        assert h.labels(status="ok").quantiles() is None
+        for v in (0.5, 0.5, 1.5, 1.5):
+            h.labels(status="ok").observe(v)
+        q = h.labels(status="ok").quantiles((0.5,))
+        assert q is not None and 0 < q[0.5] <= 2.0
+        # Unbound handle aggregates across label sets.
+        assert h.quantiles((0.5,)) is not None
+
+    def test_label_filtered_no_match_is_none(self):
+        reg = MetricsRegistry()
+        reg.histogram("pixie_lbl_seconds", "x").labels(s="a").observe(0.1)
+        assert reg.quantiles("pixie_lbl_seconds", (0.5,), s="nope") is None
+
+
+class TestTraceDroppedCounter:
+    def test_unexported_ring_eviction_counts(self):
+        reg = MetricsRegistry()
+        tracer = Tracer(registry=reg, ring_size=2)
+        for _ in range(4):
+            tracer.end_query(tracer.begin_query(script="q"))
+        # 4 finished, ring holds 2 -> 2 evicted unexported.
+        assert "pixie_trace_dropped_total 2" in reg.render()
+
+    def test_exported_traces_do_not_count(self):
+        reg = MetricsRegistry()
+        tracer = Tracer(registry=reg, ring_size=1)
+        t1 = tracer.begin_query(script="q")
+        tracer.end_query(t1)
+        t1.exported = True  # as a successful OTLP push would mark it
+        tracer.end_query(tracer.begin_query(script="q2"))
+        assert not [
+            ln for ln in reg.render().splitlines()
+            if ln.startswith("pixie_trace_dropped_total ")
+        ]  # registered, but never incremented
+
+
+class TestTracerShutdown:
+    def test_no_listener_or_export_after_shutdown(self):
+        reg = MetricsRegistry()
+        tracer = Tracer(registry=reg)
+        seen = []
+        tracer.add_listener(seen.append)
+        tracer.end_query(tracer.begin_query(script="a"))
+        assert len(seen) == 1
+        tracer.shutdown()
+        with config.override_flag(
+            "trace_export_url", "http://127.0.0.1:9"
+        ):
+            tracer.end_query(tracer.begin_query(script="b"))
+        assert len(seen) == 1  # no new notification
+        assert not [
+            ln for ln in reg.render().splitlines()
+            if ln.startswith("pixie_trace_export_errors_total ")
+        ]  # no export was attempted, so none could fail
+        # The trace still finalized into the ring (queryz keeps working).
+        assert tracer.last().script == "b"
