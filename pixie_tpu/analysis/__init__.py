@@ -11,11 +11,23 @@ hazards *before* a fragment blows up on-device mid-query:
   (node id + operator) instead of a device-side shape error.
 - ``lint``: a reusable AST-rule engine (driven by ``tools/pxlint.py``)
   with JAX- and concurrency-aware rules over the source tree.
+- ``bounds`` (pxbound): an abstract interpreter propagating per-node
+  resource bounds (row intervals, bytes, group counts, join output,
+  bridge wire bytes) seeded from ingest sketches; its
+  ``PlanResourceReport`` pre-sizes engine buffers and drives the
+  broker's predicted-cost admission control, audited by the
+  ``bound_check`` soundness gate against PR-7 telemetry.
 
-See docs/ANALYSIS.md for the rule catalog, suppression syntax, and the
-baseline workflow.
+See docs/ANALYSIS.md for the rule catalog, suppression syntax, the
+baseline workflow, and the bounds domain.
 """
 
+from .bounds import (
+    PlanResourceReport,
+    check_plan_bounds,
+    distributed_bounds,
+    plan_bounds,
+)
 from .diagnostics import Diagnostic, PlanCheckError, Severity
 from .verifier import (
     check_plan,
@@ -27,8 +39,12 @@ from .verifier import (
 __all__ = [
     "Diagnostic",
     "PlanCheckError",
+    "PlanResourceReport",
     "Severity",
     "check_plan",
+    "check_plan_bounds",
+    "distributed_bounds",
+    "plan_bounds",
     "verify_dispatch_sets",
     "verify_distributed_plan",
     "verify_plan",

@@ -371,6 +371,26 @@ def _self_attr(node) -> str | None:
     return None
 
 
+def _class_lock_attrs(cls: ast.ClassDef) -> set:
+    """``self.X`` attributes assigned a Lock/RLock/Condition/Semaphore
+    anywhere in the class body (shared by thread-shared-state and
+    blocking-call-under-lock)."""
+    out: set = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            vf = node.value.func
+            ctor = (
+                vf.attr if isinstance(vf, ast.Attribute)
+                else vf.id if isinstance(vf, ast.Name) else None
+            )
+            if ctor in _LOCK_CTORS:
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a:
+                        out.add(a)
+    return out
+
+
 class ThreadSharedStateRule:
     name = "thread-shared-state"
     description = (
@@ -395,8 +415,7 @@ class ThreadSharedStateRule:
         # Pass 1: lock attrs from EVERY method, so a lock assigned in a
         # textually-later method (e.g. __init__ not first in the class
         # body) still counts when earlier methods' writes are scanned.
-        for fn in info.methods.values():
-            self._collect_lock_attrs(info, fn)
+        info.lock_attrs = _class_lock_attrs(cls)
         for name, fn in info.methods.items():
             self._scan_method(info, name, fn)
 
@@ -473,23 +492,6 @@ class ThreadSharedStateRule:
                     ),
                     symbol=f"{info.qualname}.{w.method}",
                 )
-
-    def _collect_lock_attrs(self, info: _ClassInfo, fn) -> None:
-        """Record self.X = threading.Lock()/RLock()/... assignments."""
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Assign) and isinstance(
-                node.value, ast.Call
-            ):
-                vf = node.value.func
-                ctor = (
-                    vf.attr if isinstance(vf, ast.Attribute)
-                    else vf.id if isinstance(vf, ast.Name) else None
-                )
-                if ctor in _LOCK_CTORS:
-                    for t in node.targets:
-                        a = _self_attr(t)
-                        if a:
-                            info.lock_attrs.add(a)
 
     def _scan_method(self, info: _ClassInfo, name: str, fn):
         writes: list[_AttrWrite] = []
@@ -606,6 +608,107 @@ class ThreadSharedStateRule:
                               method=method))
 
 
+# -- rule: blocking-call-under-lock -------------------------------------------
+
+class BlockingCallUnderLockRule:
+    """Flag blocking calls made while a ``with self.<lock>:`` scope is
+    held. ``bus.request`` / ``RemoteBus.request`` block up to their
+    timeout waiting for a remote reply, and ``block_until_ready()`` /
+    ``.item()`` fence the device — holding an instance lock across
+    either serializes every other thread (bus dispatcher threads, the
+    query thread) behind a network/device round trip, and a reply
+    handler that needs the same lock deadlocks outright. Move the
+    blocking call outside the critical section (snapshot state under
+    the lock, call after)."""
+
+    name = "blocking-call-under-lock"
+    description = (
+        "bus.request/block_until_ready/.item() while holding a "
+        "`with self.<lock>` — a blocking round trip inside a critical "
+        "section (deadlock-prone; serializes other threads)"
+    )
+
+    def prepare(self, ctxs, repo_root=None):
+        pass
+
+    def check(self, ctx: FileCtx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(self, ctx: FileCtx, cls: ast.ClassDef):
+        locks = _class_lock_attrs(cls)
+        if not locks:
+            return
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{ctx.qualname(cls)}.{item.name}"
+                yield from self._scan(ctx, item, qn, locks, locked=False)
+
+    def _scan(self, ctx, node, qn, locks, locked):
+        if isinstance(node, ast.With):
+            held = locked or any(
+                _self_attr(item.context_expr) in locks
+                or (
+                    isinstance(item.context_expr, ast.Call)
+                    and _self_attr(item.context_expr.func) in locks
+                )
+                for item in node.items
+            )
+            for child in node.body:
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue  # defined, not called, under the lock
+                yield from self._scan(ctx, child, qn, locks, held)
+            return
+        if locked and isinstance(node, ast.Call):
+            msg = self._blocking_msg(node)
+            if msg:
+                yield Finding(
+                    rule=self.name,
+                    path=ctx.relpath,
+                    line=node.lineno,
+                    message=f"{msg} while holding a lock",
+                    symbol=qn,
+                )
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A nested def does not RUN here; its body executes on
+                # whatever thread later calls it (scanned unlocked via
+                # the class walk only if it's a method — nested-def
+                # bodies under a lock are not held-lock call sites).
+                continue
+            yield from self._scan(ctx, child, qn, locks, locked)
+
+    @staticmethod
+    def _blocking_msg(node: ast.Call) -> str | None:
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        if f.attr == "request":
+            # bus.request / self.bus.request / self._bus.request /
+            # RemoteBus.request — the message-bus request/reply round
+            # trip. Receiver must look like a bus so `requests`-style
+            # libraries don't false-positive.
+            recv = f.value
+            name = (
+                recv.id if isinstance(recv, ast.Name)
+                else recv.attr if isinstance(recv, ast.Attribute)
+                else None
+            )
+            if name is not None and (
+                name == "RemoteBus" or name.lstrip("_").endswith("bus")
+            ):
+                return f"{name}.request() (blocks up to its timeout)"
+            return None
+        if f.attr == "block_until_ready":
+            return "block_until_ready() (device fence)"
+        if f.attr == "item" and not node.args:
+            return ".item() (device-to-host readback)"
+        return None
+
+
 # -- rule: metrics-naming -----------------------------------------------------
 
 class MetricsNamingRule:
@@ -665,6 +768,7 @@ ALL_RULES = (
     HostSyncHotPathRule,
     JitRecompileHazardRule,
     ThreadSharedStateRule,
+    BlockingCallUnderLockRule,
     MetricsNamingRule,
 )
 

@@ -35,6 +35,10 @@ class ScriptResults(dict):
       tables cover only the survivors (graceful degradation)
     - ``missing_agents``: the lost agents' ids
     - ``qid`` / ``agent_stats``: execution identity + per-agent timings
+    - ``predicted_cost``: pxbound's plan-time resource envelope
+      (``bytes_staged_hi``/``rows_in_hi``/...; None entries =
+      sketch-less, unbounded) — the broker's admission-control signal;
+      compare with the observed usage in ``agent_stats``
     """
 
     def __init__(self, *args, **kw):
@@ -43,6 +47,7 @@ class ScriptResults(dict):
         self.missing_agents: list = []
         self.qid = None
         self.agent_stats: dict = {}
+        self.predicted_cost: dict | None = None
 
 
 class TableRecordHandler:
@@ -125,6 +130,7 @@ class Client:
         out.missing_agents = list(res.get("missing_agents", []))
         out.qid = res.get("qid")
         out.agent_stats = dict(res.get("agent_stats", {}))
+        out.predicted_cost = res.get("predicted_cost")
         for name, hb in sorted(res["tables"].items()):
             d = hb.to_pydict()
             out[name] = d

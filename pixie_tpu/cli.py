@@ -274,17 +274,23 @@ def cmd_debug(args) -> int:
         print("no recent queries")
         return 0
     hdr = (f"{'qid':12s} {'status':8s} {'ms':>9s} {'rows':>9s} "
-           f"{'staged':>9s} {'device':>9s} {'wire':>9s} agents")
+           f"{'staged':>9s} {'pred':>9s} {'device':>9s} {'wire':>9s} "
+           "agents")
     print(hdr)
     for row in res["in_flight"] + rows:
         u = row.get("usage", {})
         agents = sorted(row.get("agent_usage", {}))
+        # pxbound predicted staged bytes next to the observed column —
+        # the admission-control signal, auditable per query (a observed
+        # > predicted row is a soundness bug; see docs/ANALYSIS.md).
+        pb = (row.get("predicted") or {}).get("bytes_staged_hi")
         print(
             f"{row.get('qid') or row['id'][:12]:12s} "
             f"{row['status']:8s} "
             f"{row['duration_ms']:>9.1f} "
             f"{row.get('rows_out', u.get('rows_out', 0)):>9d} "
             f"{_fmt_bytes(u.get('bytes_staged', 0)):>9s} "
+            f"{'-' if pb is None else _fmt_bytes(pb):>9s} "
             f"{u.get('device_ms', 0.0):>8.1f}ms "
             f"{_fmt_bytes(u.get('wire_bytes', 0)):>9s} "
             f"{','.join(agents)}"

@@ -24,10 +24,14 @@ class Flag:
     default: object
     parse: Callable
     doc: str
+    # Computed once at definition: flag reads sit on per-compile hot
+    # paths (verify/bounds memo keys), where rebuilding the env-var
+    # string per get_flag call measurably added up.
+    env_var: str = ""
 
-    @property
-    def env_var(self) -> str:
-        return "PIXIE_TPU_" + self.name.upper()
+    def __post_init__(self):
+        if not self.env_var:
+            self.env_var = "PIXIE_TPU_" + self.name.upper()
 
 
 _REGISTRY: dict[str, Flag] = {}
@@ -64,6 +68,27 @@ def get_flag(name: str):
     if env is not None:
         return f.parse(env)
     return f.default
+
+
+_MISSING = object()
+
+
+def get_flags(*names) -> tuple:
+    """Batch ``get_flag``: one lock acquisition for N flags. For hot
+    paths that snapshot several flags per call (the analysis passes'
+    memo keys read five per compile)."""
+    flags = [_REGISTRY[n] for n in names]
+    environ = os.environ
+    with _LOCK:
+        ov = [_OVERRIDES.get(n, _MISSING) for n in names]
+    out = []
+    for f, o in zip(flags, ov):
+        if o is not _MISSING:
+            out.append(o)
+            continue
+        env = environ.get(f.env_var)
+        out.append(f.parse(env) if env is not None else f.default)
+    return tuple(out)
 
 
 def set_flag(name: str, value) -> None:
@@ -262,6 +287,53 @@ define_flag(
     "slow_query_threshold_ms", 0.0,
     "Queries slower than this (wall-clock ms) dump their full trace to "
     "the 'pixie_tpu.slow_query' logger; 0 disables the slow-query log.",
+)
+
+# -- resource bounds + admission control (analysis/bounds.py) ----------------
+define_flag(
+    "bounds_safety", 2.0,
+    "Multiplier on pxbound's predicted resource totals (staged bytes, "
+    "rows). Covers run-time effects the plan-time walk cannot see "
+    "exactly: overflow-rebucket re-folds, concurrent ingest between "
+    "compile and execution, join driver re-staging. The soundness gate "
+    "(analysis/bound_check.py) asserts observed <= predicted UNDER "
+    "this factor.",
+)
+define_flag(
+    "bounds_presize", True,
+    "Grow AggOp.max_groups at compile time to the sketch-NDV group "
+    "bound (pxbound) so first-run aggregates start at the predicted "
+    "capacity instead of climbing the overflow-doubling ladder (one "
+    "whole-table re-fold per rung). Growth only — results identical.",
+)
+define_flag(
+    "bounds_query_budget_mb", 0.0,
+    "Per-query budget on pxbound's predicted staged bytes; a plan "
+    "predicted over budget fails AT COMPILE with a structured "
+    "resource-bound Diagnostic instead of OOMing mid-query. 0 "
+    "disables. Sketch-less (unbounded) predictions are never rejected.",
+)
+define_flag(
+    "bounds_device_budget_mb", 0.0,
+    "Per-node budget on pxbound's predicted device allocation (staged "
+    "window planes, aggregate group state, join build+output buffers); "
+    "enforced at compile like bounds_query_budget_mb. 0 disables.",
+)
+define_flag(
+    "admission_bytes_budget_mb", 0.0,
+    "Broker admission control: budget on the SUM of in-flight queries' "
+    "predicted staged bytes (pxbound predicted_cost). A single query "
+    "predicted over the whole budget is rejected with its diagnostic; "
+    "a query that merely doesn't fit NOW queues up to "
+    "admission_queue_s. 0 disables (every query admitted). Queries "
+    "with unknown (sketch-less) predictions are admitted and accounted "
+    "at zero.",
+)
+define_flag(
+    "admission_queue_s", 5.0,
+    "How long an admission-controlled query may wait for in-flight "
+    "predicted bytes to drain before it is rejected (queue timeout). "
+    "0 rejects immediately when the budget is full.",
 )
 
 # -- self-observability (services/telemetry.py) ------------------------------

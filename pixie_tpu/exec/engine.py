@@ -315,6 +315,13 @@ class Engine:
                 "ndv": {
                     c: s.ndv for c, s in sk.cols.items() if s.rows
                 },
+                # Global zone maps per sketched column — pxbound's join
+                # overlap term (analysis/bounds.py) reads them.
+                "zones": {
+                    c: (s.lo, s.hi)
+                    for c, s in sk.cols.items()
+                    if s.rows and s.lo is not None
+                },
             }
         # Telemetry feedback (arXiv:2102.02440): OBSERVED per-script
         # output cardinalities from past runs, keyed by script hash
@@ -393,6 +400,11 @@ class Engine:
         # Fresh per-query join outcome: a non-join query must not
         # inherit (and re-account) the previous query's decision.
         self.last_join_decision = None
+        # pxbound's plan-time resource envelope (analysis/bounds.py),
+        # attached by compile_pxl: join-buffer pre-sizing reads it, and
+        # the soundness gate compares it against the trace's observed
+        # QueryResourceUsage.
+        self.last_resource_report = getattr(plan, "resource_report", None)
         # The trace's stats spine IS the per-fragment stats object —
         # analyze just runs it with sync=True (see analyze.py).
         self._query_stats = trace.stats
@@ -522,10 +534,20 @@ class Engine:
                     )
                     left = mat_input(node.inputs[0])
                     right = mat_input(node.inputs[1])
+                    # Join-buffer pre-sizing (pxbound): the plan-time
+                    # capacity estimate covers inputs run-time sketches
+                    # cannot see (post-aggregate build sides) — used as
+                    # the fallback rung before the historical default.
+                    report = self.last_resource_report
+                    planned = (
+                        report.join_capacity.get(nid)
+                        if report is not None else None
+                    )
                     results[nid] = _join_dispatch(
                         left, right, op, self,
                         left_stats=lstats, right_stats=rstats,
                         cap_key=(self._plan_fingerprint(plan), nid),
+                        planned_capacity=planned,
                     )
             elif isinstance(op, UnionOp):
                 mats = [mat_input(i) for i in node.inputs]

@@ -177,8 +177,26 @@ def _scan_side_stats(keys: np.ndarray) -> JoinSideStats:
 # fingerprint hashes operators, not data — two engines running the same
 # script over different tables must not seed each other's rungs.
 # Engine-less driver calls pass cap_key=None and learn nothing.
+#
+# Eviction is LRU (python dicts are insertion-ordered; a hit re-inserts
+# its key at the back) with a hard size cap: pxbound's plan-time
+# pre-sizing makes retention past the cap pure memory loss — under many
+# distinct plan hashes (dashboard fleets, ephemeral test engines) an
+# unbounded dict is a slow leak. Evictions are counted
+# (pixie_join_capacity_evictions_total): a hot cache churning entries
+# means the cap is too small for the plan population, worth seeing.
 _CAPACITY_LOCK = threading.Lock()
 _CAPACITY_CACHE_MAX = 4096
+
+
+def _eviction_counter():
+    from ..services.observability import default_counter
+
+    return default_counter(
+        "pixie_join_capacity_evictions_total",
+        "Learned join-capacity entries evicted by the per-engine LRU "
+        "size cap",
+    )
 
 
 def learned_capacity(engine, cap_key) -> int | None:
@@ -186,17 +204,27 @@ def learned_capacity(engine, cap_key) -> int | None:
     if cap_key is None or cache is None:
         return None
     with _CAPACITY_LOCK:
-        return cache.get(cap_key)
+        cap = cache.get(cap_key)
+        if cap is not None:
+            # Refresh recency: re-insert at the back of the order.
+            del cache[cap_key]
+            cache[cap_key] = cap
+        return cap
 
 
 def remember_capacity(engine, cap_key, capacity: int) -> None:
     cache = getattr(engine, "_join_capacity_cache", None)
     if cap_key is None or cache is None:
         return
+    evicted = 0
     with _CAPACITY_LOCK:
-        if len(cache) >= _CAPACITY_CACHE_MAX:
-            cache.clear()  # rare; bounded, not LRU-precise
+        cache.pop(cap_key, None)
+        while len(cache) >= _CAPACITY_CACHE_MAX:
+            cache.pop(next(iter(cache)))  # LRU: oldest-inserted first
+            evicted += 1
         cache[cap_key] = capacity
+    if evicted:
+        _eviction_counter().inc(evicted)
 
 
 def _retry_counter(engine):
@@ -341,7 +369,7 @@ def choose_join_strategy(left: HostBatch, right: HostBatch, op: JoinOp,
 
 def _join_dispatch(left: HostBatch, right: HostBatch, op: JoinOp,
                    engine=None, left_stats=None, right_stats=None,
-                   cap_key=None) -> HostBatch:
+                   cap_key=None, planned_capacity=None) -> HostBatch:
     """Route a join: host N:1 dict, native host hash, or a device
     kernel strategy chosen by ``choose_join_strategy``.
 
@@ -381,7 +409,8 @@ def _join_dispatch(left: HostBatch, right: HostBatch, op: JoinOp,
     if decision.strategy == "host_hash":
         return _join_host_nm(left, right, op, right_stats, decision)
     return _join_device(left, right, op, engine, decision,
-                        left_stats, right_stats, cap_key)
+                        left_stats, right_stats, cap_key,
+                        planned_capacity=planned_capacity)
 
 
 class _BuildNotUnique(Exception):
@@ -834,7 +863,8 @@ def _join_device_windowed(left: HostBatch, right: HostBatch, op: JoinOp,
 
 def _join_device(left: HostBatch, right: HostBatch, op: JoinOp,
                  engine=None, decision=None, left_stats=None,
-                 right_stats=None, cap_key=None) -> HostBatch:
+                 right_stats=None, cap_key=None,
+                 planned_capacity=None) -> HostBatch:
     """N:M device join: pad to bucketed capacities, run the sort-based
     kernel at the sketch-estimated (or learned) capacity, re-run doubled
     on overflow (counted), gather columns host-side. Large windowable
@@ -931,6 +961,15 @@ def _join_device(left: HostBatch, right: HostBatch, op: JoinOp,
             # an allocation past what the data could produce.
             capacity = min(
                 capacity, bucket_capacity(max(left.length, 1) * right.length)
+            )
+        elif planned_capacity:
+            # pxbound's plan-time estimate (analysis/bounds.py): sized
+            # from bounds run-time sketches cannot see — a post-
+            # aggregate build side's group-count bound. Clamped to the
+            # theoretical max like the run-time estimate.
+            capacity = min(
+                bucket_capacity(max(int(planned_capacity), 1)),
+                bucket_capacity(max(left.length, 1) * right.length),
             )
         else:
             capacity = bucket_capacity(max(left.length + right.length, 1))

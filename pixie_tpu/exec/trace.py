@@ -299,6 +299,10 @@ class QueryTrace:
         self.pipeline: dict | None = None  # engine.last_pipeline snapshot
         self.usage = QueryResourceUsage()
         self.agent_usage: dict = {}  # broker: {agent_id: usage dict}
+        # pxbound predicted_cost (analysis/bounds.py): what the query
+        # was PREDICTED to stage/ship at plan time. The broker stamps
+        # it; `px debug queries` renders predicted vs observed.
+        self.predicted: dict | None = None
         self.exported = False  # OTLP push succeeded (ring-drop counting)
         self.dropped_spans = 0
         self._lock = threading.Lock()
@@ -439,6 +443,8 @@ class QueryTrace:
             d["agent_id"] = self.agent_id
         if self.agent_usage:
             d["agent_usage"] = dict(self.agent_usage)
+        if self.predicted:
+            d["predicted"] = dict(self.predicted)
         if self.parent_ctx:
             d["parent"] = dict(self.parent_ctx)
         if self.error:

@@ -145,6 +145,21 @@ def compile_pxl(query: str, state: CompilerState) -> CompiledScript:
         builder.plan, query, builder.schemas, state.registry,
         plan_params=(state.max_output_rows, state.max_groups),
     )
+    # Resource-bound pass (pixie_tpu/analysis/bounds.py, pxbound):
+    # abstract interpretation of per-node row/byte/group bounds seeded
+    # from the ingest sketches in state.table_stats. Enforces the
+    # (default-off) compile-time budgets, pre-sizes aggregate group
+    # capacity to the NDV bound, and attaches the PlanResourceReport to
+    # the plan — the engine pre-sizes join buffers from it and the
+    # broker schedules admission on its predicted_cost. Raises
+    # PlanCheckError (a PxLError) when a budget flag is on and the
+    # prediction exceeds it; sketch-less plans are never rejected.
+    from ..analysis.bounds import apply_plan_bounds
+
+    apply_plan_bounds(
+        builder.plan, builder.schemas, state.registry, state.table_stats,
+        script=query,
+    )
     return CompiledScript(
         plan=builder.plan, outputs=list(builder.sinks), funcs=visitor.funcs,
         mutations=mutations, n_exports=builder.n_exports,

@@ -26,7 +26,8 @@ class DistributedPlanner:
         self.coordinator = Coordinator()
 
     def plan(
-        self, logical_plan: Plan, state: DistributedState, mesh=None
+        self, logical_plan: Plan, state: DistributedState, mesh=None,
+        schemas=None, table_stats=None,
     ) -> DistributedPlan:
         split = self.splitter.split(logical_plan)
         dplan = self.coordinator.assign(split, state)
@@ -40,6 +41,19 @@ class DistributedPlanner:
         from ...analysis.verifier import check_distributed_plan
 
         check_distributed_plan(dplan)
+        # Resource-bound pass over the split (pxbound): per-agent data
+        # fragment bounds + merge bounds with bridge rows seeded from
+        # the data side x agent count + total wire bound. Attached as
+        # dplan.resource_report; the broker folds it into the
+        # predicted_cost its admission control schedules on. Optional:
+        # callers without schemas (tests building raw splits) skip it.
+        if schemas is not None:
+            from ...analysis.bounds import distributed_bounds
+
+            distributed_bounds(
+                dplan, schemas, self.splitter.registry, table_stats,
+                n_agents=max(len(dplan.data_agent_ids), 1),
+            )
         return dplan
 
     def stitch(self, dplan: DistributedPlan, state: DistributedState, mesh=None) -> None:

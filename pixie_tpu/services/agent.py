@@ -163,6 +163,7 @@ class Agent:
                 "processes_data": self.processes_data,
                 "accepts_remote_sources": self.accepts_remote_sources,
                 "schemas": self._schemas(),
+                "table_stats": self._table_stats(),
             },
         )
 
@@ -174,7 +175,11 @@ class Agent:
         while not self._stop.wait(self.heartbeat_interval_s):
             self.bus.publish(
                 TOPIC_HEARTBEAT,
-                {"agent_id": self.agent_id, "schemas": self._schemas()},
+                {
+                    "agent_id": self.agent_id,
+                    "schemas": self._schemas(),
+                    "table_stats": self._table_stats(),
+                },
             )
 
     def _schemas(self) -> dict:
@@ -183,6 +188,17 @@ class Agent:
             for name, t in self.engine.tables.items()
             if t is not None and len(t.relation)
         }
+
+    def _table_stats(self) -> dict:
+        """Ingest-sketch summaries for the tracker ({table: {rows, ndv,
+        zones}}): the broker-side seed for pxbound predicted costs and
+        the planner's NDV sizing. Microseconds per column — the
+        sketches were maintained at append time; the per-engine
+        __observed__ feedback stays local (script hashes are engine-
+        scoped history, not cluster state)."""
+        stats = self.engine._compile_table_stats()
+        stats.pop("__observed__", None)
+        return stats
 
     # -- data push (Stirling's RegisterDataPushCallback target) --------------
     def append_data(self, table: str, data, time_cols=("time_",)):

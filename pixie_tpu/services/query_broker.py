@@ -39,6 +39,88 @@ class AgentLost(QueryError):
     the participant was the un-substitutable merge agent."""
 
 
+class AdmissionError(QueryError):
+    """Admission control refused the query: its pxbound-predicted cost
+    exceeds the per-engine budget (reject), or in-flight queries held
+    the budget past the queue timeout. Carries the structured
+    :class:`~pixie_tpu.analysis.diagnostics.Diagnostic` so clients see
+    a compile-time-style refusal, not a run-time failure."""
+
+    def __init__(self, diagnostic):
+        self.diagnostic = diagnostic
+        super().__init__(diagnostic.render())
+
+
+class _Admission:
+    """Predicted-cost admission control (``admission_bytes_budget_mb``).
+
+    Tracks the SUM of in-flight queries' predicted staged bytes
+    (pxbound ``predicted_cost.bytes_staged_hi``). ``admit`` returns
+    immediately when the budget is off or the prediction unknown
+    (sketch-less plans are admitted, accounted at zero — conservative
+    bounds must never turn into false rejections); rejects a query
+    predicted over the WHOLE budget; and queues a query that merely
+    doesn't fit NOW until in-flight predictions drain
+    (``admission_queue_s``), then rejects. ``release`` is idempotent.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._in_flight: dict[str, int] = {}
+
+    def in_flight(self) -> dict:
+        with self._cond:
+            return dict(self._in_flight)
+
+    @staticmethod
+    def _diag(message: str) -> "object":
+        from ..analysis.diagnostics import Diagnostic
+
+        return Diagnostic(
+            code="admission-reject", message=message, plan="distributed"
+        )
+
+    def admit(self, qid: str, predicted: dict | None) -> None:
+        from ..config import get_flag
+
+        budget = float(get_flag("admission_bytes_budget_mb")) * (1 << 20)
+        if budget <= 0:
+            return
+        pred = (predicted or {}).get("bytes_staged_hi")
+        if pred is None:
+            return  # unknown cost: admit (never falsely reject)
+        pred = int(pred)
+        if pred > budget:
+            raise AdmissionError(self._diag(
+                f"query {qid} predicted {pred} staged bytes "
+                f"(x{(predicted or {}).get('safety')} safety, origin "
+                f"{(predicted or {}).get('origin')}) > the per-engine "
+                f"admission budget {int(budget)} "
+                "(admission_bytes_budget_mb) — rejected at admission, "
+                "not failed at run time"
+            ))
+        queue_s = float(get_flag("admission_queue_s"))
+        deadline = time.monotonic() + max(queue_s, 0.0)
+        with self._cond:
+            while sum(self._in_flight.values()) + pred > budget:
+                wait_s = deadline - time.monotonic()
+                if wait_s <= 0:
+                    held = sorted(self._in_flight)
+                    raise AdmissionError(self._diag(
+                        f"query {qid} predicted {pred} staged bytes "
+                        f"queued past admission_queue_s={queue_s}s "
+                        f"behind in-flight {held} "
+                        f"(budget {int(budget)} bytes)"
+                    ))
+                self._cond.wait(wait_s)
+            self._in_flight[qid] = pred
+
+    def release(self, qid: str) -> None:
+        with self._cond:
+            if self._in_flight.pop(qid, None) is not None:
+                self._cond.notify_all()
+
+
 class QueryResultForwarder:
     """Per-query result stream assembly with watchdog timeouts,
     failure-driven failover, and partial-result accounting.
@@ -389,6 +471,9 @@ class QueryBroker:
         )
         self.forwarder = QueryResultForwarder(bus)
         self.planner = DistributedPlanner(self.registry)
+        # Predicted-cost admission control (pxbound predicted_cost vs
+        # admission_bytes_budget_mb; off by default).
+        self.admission = _Admission()
         # Broker-side query-lifecycle traces (exec/trace.py Tracer):
         # dispatch / retry / failover spans per distributed query,
         # served as /debug/queryz on the broker role.
@@ -744,6 +829,10 @@ class QueryBroker:
             registry=self.registry,
             now_ns=now_ns,
             max_output_rows=max_output_rows,
+            # Cluster-wide ingest-sketch summary (agents ship it with
+            # heartbeats): seeds the planner's NDV sizing AND pxbound's
+            # predicted query cost — the admission-control signal.
+            table_stats=self.tracker.table_stats(),
         )
         mutation_states = None
         # Cheap gate: the mutation pass re-executes the script, so skip it
@@ -782,6 +871,7 @@ class QueryBroker:
                 registry=self.registry,
                 now_ns=now_ns,
                 max_output_rows=max_output_rows,
+                table_stats=self.tracker.table_stats(),
             )
         state = self.tracker.distributed_state()  # fresh per query
         with trace.span("compile"):
@@ -794,7 +884,11 @@ class QueryBroker:
                 "qid": None,
             }
         try:
-            dplan = self.planner.plan(compiled.plan, state)
+            dplan = self.planner.plan(
+                compiled.plan, state,
+                schemas=compiler_state.schemas,
+                table_stats=compiler_state.table_stats,
+            )
         except PlanningError as e:
             raise QueryError(str(e)) from e
 
@@ -804,6 +898,18 @@ class QueryBroker:
         if not dplan.kelvin_agent_ids:
             raise QueryError("no live agent available to run the query")
         merge_agent = dplan.kelvin_agent_ids[0]
+
+        # Predicted cost (pxbound): the logical plan's resource envelope
+        # + the split's bridge wire bound. Stamped on the broker trace
+        # (predicted-vs-observed in `px debug queries`), attached to
+        # every dispatch, and the admission decision's input.
+        from ..analysis.bounds import merged_cost
+
+        predicted = merged_cost(
+            getattr(compiled.plan, "resource_report", None),
+            getattr(dplan, "resource_report", None),
+        )
+        trace.predicted = predicted
 
         # LaunchQuery: merge fragment first (so the router can accept
         # early bridge chunks), then the per-agent data fragments —
@@ -819,6 +925,7 @@ class QueryBroker:
                         b.bridge_id for b in dplan.split.bridges
                     ],
                     "data_agents": data_agents,
+                    "predicted_cost": predicted,
                 },
             ),
         }
@@ -829,36 +936,48 @@ class QueryBroker:
                     "qid": qid,
                     "plan": dplan.split.before_blocking,
                     "merge_agent": merge_agent,
+                    "predicted_cost": predicted,
                 },
             )
-        # Verify BEFORE registering the query: a failing check must not
-        # leak the forwarder's subscriptions/dispatcher threads (they
-        # are only released through wait()'s deregister).
-        self._check_dispatch_sets(dplan, dispatches, merge_agent)
-        self.forwarder.register_query(
-            qid, data_agents, merge_agent=merge_agent,
-            require_complete=require_complete, trace=trace,
-        )
-        with trace.span("dispatch") as sp:
-            sp.attributes.update({
-                "data_agents": ",".join(data_agents),
-                "merge_agent": merge_agent,
-            })
-            # Trace stitching: every dispatch carries the dispatch
-            # span's context envelope, so each agent's fragment/merge
-            # trace parents under THIS span — one distributed trace,
-            # broker -> N agents -> merge (exec/tracectx.py). Stamped
-            # into the stored message dicts so background RETRIES of a
-            # dispatch carry the same context.
-            from ..exec import tracectx
+        # Admission control: reject/queue BEFORE any registration or
+        # dispatch — a refused query must leak nothing. admit() either
+        # records the query's predicted bytes (released in the finally
+        # below) or raises without recording.
+        self.admission.admit(qid, predicted)
+        try:
+            # Verify BEFORE registering the query: a failing check must
+            # not leak the forwarder's subscriptions/dispatcher threads
+            # (they are only released through wait()'s deregister).
+            self._check_dispatch_sets(dplan, dispatches, merge_agent)
+            self.forwarder.register_query(
+                qid, data_agents, merge_agent=merge_agent,
+                require_complete=require_complete, trace=trace,
+            )
+            with trace.span("dispatch") as sp:
+                sp.attributes.update({
+                    "data_agents": ",".join(data_agents),
+                    "merge_agent": merge_agent,
+                })
+                # Trace stitching: every dispatch carries the dispatch
+                # span's context envelope, so each agent's fragment/merge
+                # trace parents under THIS span — one distributed trace,
+                # broker -> N agents -> merge (exec/tracectx.py). Stamped
+                # into the stored message dicts so background RETRIES of a
+                # dispatch carry the same context.
+                from ..exec import tracectx
 
-            ctx = trace.ctx(sp)
-            for key, (topic, msg) in list(dispatches.items()):
-                dispatches[key] = (topic, tracectx.attach(msg, ctx))
-            self._dispatch_with_retry(qid, dispatches, trace=trace)
-        result = self.forwarder.wait(qid, timeout_s)
+                ctx = trace.ctx(sp)
+                for key, (topic, msg) in list(dispatches.items()):
+                    dispatches[key] = (topic, tracectx.attach(msg, ctx))
+                self._dispatch_with_retry(qid, dispatches, trace=trace)
+            result = self.forwarder.wait(qid, timeout_s)
+        finally:
+            # The query's predicted bytes stop counting against the
+            # admission budget the moment it finishes or fails.
+            self.admission.release(qid)
         result["qid"] = qid
         result["distributed_plan"] = dplan
+        result["predicted_cost"] = predicted
         # Fold per-agent resource records into the broker's trace: the
         # distributed query's cost with per-agent attribution (served by
         # broker.debug_queries / `px debug queries` / /debug/queryz).
@@ -906,6 +1025,12 @@ class QueryBroker:
             registry=self.registry,
             now_ns=now_ns,
             max_output_rows=1 << 62,  # live streams are unbounded
+            # Sketch stats for the planner's NDV sizing + pxbound
+            # presize. Live streams bypass ADMISSION (their lifetime
+            # cost is open-ended; per-execution predictions don't
+            # model a polling cursor) but still get right-sized
+            # buffers.
+            table_stats=self.tracker.table_stats(),
         )
         state = self.tracker.distributed_state()
         compiled = compile_pxl(query, compiler_state)
@@ -1089,6 +1214,7 @@ class QueryBroker:
                     "partial": res.get("partial", False),
                     "missing_agents": res.get("missing_agents", []),
                     "mutations": res.get("mutations"),
+                    "predicted_cost": res.get("predicted_cost"),
                 })
             except Exception as e:  # errors cross the wire as data
                 _reply(msg, {"ok": False, "error": f"{type(e).__name__}: {e}"})

@@ -33,9 +33,14 @@ MAX_FLAP_TRACKED = 1024
 
 
 class _Record:
-    def __init__(self, info: AgentInfo, schemas: dict):
+    def __init__(self, info: AgentInfo, schemas: dict,
+                 table_stats: dict | None = None):
         self.info = info
         self.schemas = schemas  # {table name: Relation}
+        # Ingest-sketch summaries ({table: {rows, ndv, zones}}) the
+        # agent ships with registration/heartbeats — the broker-side
+        # seed for pxbound's predicted costs (admission control).
+        self.table_stats = dict(table_stats or {})
         self.last_heartbeat = time.monotonic()
 
 
@@ -98,7 +103,10 @@ class AgentTracker:
                 tables=frozenset(msg.get("schemas", {})),
                 asid=asid,
             )
-            self._agents[agent_id] = _Record(info, dict(msg.get("schemas", {})))
+            self._agents[agent_id] = _Record(
+                info, dict(msg.get("schemas", {})),
+                msg.get("table_stats"),
+            )
         self.bus.publish(f"agent.{agent_id}.registered", {"asid": asid})
 
     def _on_heartbeat(self, msg: dict):
@@ -112,6 +120,8 @@ class AgentTracker:
                 self.bus.publish(f"agent.{agent_id}.reregister", {})
                 return
             rec.last_heartbeat = time.monotonic()
+            if "table_stats" in msg:
+                rec.table_stats = dict(msg["table_stats"] or {})
             if "schemas" in msg:
                 rec.schemas = dict(msg["schemas"])
                 rec.info = AgentInfo(
@@ -273,6 +283,40 @@ class AgentTracker:
         with self._lock:
             for rec in self._agents.values():
                 out.update(rec.schemas)
+        return out
+
+    def table_stats(self) -> dict:
+        """Cluster-wide ingest-sketch summary: per table, rows SUMMED
+        across agents (each agent holds a disjoint shard), per-column
+        NDV summed (an upper bound — per-agent HLL registers don't
+        cross the heartbeat, so exact merge isn't available here) and
+        zone bounds unioned. Feeds the broker's CompilerState so
+        pxbound's predicted costs (and the planner's NDV sizing) work
+        cluster-wide, not just engine-locally."""
+        out: dict = {}
+        with self._lock:
+            records = [rec.table_stats for rec in self._agents.values()]
+        for stats in records:
+            for table, st in (stats or {}).items():
+                if not isinstance(st, dict):
+                    continue
+                cur = out.setdefault(
+                    table, {"rows": 0, "ndv": {}, "zones": {}}
+                )
+                cur["rows"] += int(st.get("rows", 0) or 0)
+                for c, v in (st.get("ndv") or {}).items():
+                    cur["ndv"][c] = cur["ndv"].get(c, 0) + int(v)
+                for c, z in (st.get("zones") or {}).items():
+                    lo, hi = z[0], z[1]
+                    if c in cur["zones"]:
+                        plo, phi = cur["zones"][c]
+                        lo, hi = min(plo, lo), max(phi, hi)
+                    cur["zones"][c] = (lo, hi)
+        for st in out.values():
+            # NDV can never exceed the row count.
+            st["ndv"] = {
+                c: min(v, st["rows"]) for c, v in st["ndv"].items() if v
+            }
         return out
 
     def agent_ids(self) -> list[str]:

@@ -24,9 +24,24 @@
 #                                      pixie_tpu/ (all rules, baseline
 #                                      applied) + the plan verifier over
 #                                      every bench shape's compiled
-#                                      plan. Non-zero exit on any
-#                                      non-baselined finding. Also runs
-#                                      inside --tier1.
+#                                      plan + the pxbound soundness
+#                                      gate (see --bounds). Non-zero
+#                                      exit on any non-baselined
+#                                      finding. Also runs inside
+#                                      --tier1.
+#   ./run_tests.sh --bounds            resource-bound gate: pytest
+#                                      tests/test_bounds.py + the
+#                                      pxbound soundness check
+#                                      (analysis/bound_check.py):
+#                                      replays all 8 bench shapes and
+#                                      the bundled self-monitoring
+#                                      scripts asserting observed
+#                                      QueryResourceUsage <= predicted,
+#                                      verifies over-budget rejection
+#                                      at compile time, and reports the
+#                                      pass's compile overhead (<5%
+#                                      budget). Runs inside --analyze /
+#                                      --tier1.
 #   ./run_tests.sh --obs               self-observability gate: the
 #                                      self-telemetry + trace-stitching
 #                                      suites (tests/test_telemetry.py,
@@ -63,12 +78,23 @@ case "$1" in
     exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python tools/bench_join.py "$@"
     ;;
+  --bounds)
+    shift
+    rc=0
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pixie_tpu.analysis.bound_check || rc=$?
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest -q tests/test_bounds.py "$@" || rc=$?
+    exit $rc
+    ;;
   --analyze)
     shift
     rc=0
     python tools/pxlint.py "$@" || rc=$?
     env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python -m pixie_tpu.analysis.bench_check || rc=$?
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pixie_tpu.analysis.bound_check || rc=$?
     exit $rc
     ;;
   --faults)

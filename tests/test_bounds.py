@@ -1,0 +1,587 @@
+"""pxbound tests: interval propagation, golden diagnostics, sketch-less
+fallback, aggregate pre-sizing, the admission reject/queue path through
+the broker, the LRU capacity cache, and the blocking-call-under-lock
+lint rule. See docs/ANALYSIS.md (bounds section) and
+analysis/bound_check.py for the soundness gate."""
+
+from __future__ import annotations
+
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu.analysis.bounds import (
+    PlanResourceReport,
+    distributed_bounds,
+    merged_cost,
+    plan_bounds,
+)
+from pixie_tpu.analysis.diagnostics import PlanCheckError
+from pixie_tpu.config import override_flag
+from pixie_tpu.exec.plan import AggOp, JoinOp, MemorySourceOp
+from pixie_tpu.planner import CompilerState, compile_pxl
+from pixie_tpu.types.dtypes import DataType
+from pixie_tpu.types.relation import Relation
+from pixie_tpu.udf.registry import default_registry
+
+T, I, S = DataType.TIME64NS, DataType.INT64, DataType.STRING
+
+SCHEMAS = {
+    "t": Relation([("time_", T), ("k", I), ("v", I), ("svc", S)]),
+    "r": Relation([("time_", T), ("k", I), ("w", I)]),
+}
+
+STATS = {
+    "t": {
+        "rows": 10_000,
+        "ndv": {"k": 100, "v": 5_000, "svc": 8},
+        "zones": {"k": (0, 99), "v": (0, 9_999)},
+    },
+    "r": {
+        "rows": 2_000,
+        "ndv": {"k": 100, "w": 1_000},
+        "zones": {"k": (0, 99), "w": (0, 999)},
+    },
+}
+
+
+def _compile(query, table_stats=None, schemas=None, **kw):
+    state = CompilerState(
+        schemas=dict(schemas or SCHEMAS),
+        registry=default_registry(),
+        table_stats=dict(table_stats or {}),
+        **kw,
+    )
+    return compile_pxl(query, state), state
+
+
+def _node_of(plan, op_type):
+    return next(
+        n for n in plan.nodes.values() if isinstance(n.op, op_type)
+    )
+
+
+class TestIntervalPropagation:
+    def test_scan_filter_agg_chain(self):
+        compiled, state = _compile(
+            """
+import px
+df = px.DataFrame(table='t')
+df = df[df.v > 100]
+out = df.groupby('svc').agg(n=('v', px.count))
+px.display(out)
+""",
+            STATS,
+        )
+        report = compiled.plan.resource_report
+        assert isinstance(report, PlanResourceReport)
+        src = _node_of(compiled.plan, MemorySourceOp)
+        b = report.nodes[src.id]
+        # Source bound: exactly the sketch row count; filters only
+        # widen the lo side (rows can shrink, never grow).
+        assert (b.rows.lo, b.rows.hi) == (0, 10_000)
+        agg = _node_of(compiled.plan, AggOp)
+        ab = report.nodes[agg.id]
+        # Group bound: the svc NDV (8), not the row count.
+        assert ab.rows.hi == 8
+        assert report.agg_groups[agg.id] == 8
+        assert report.origin == "sketch"
+        # Totals scale by the safety factor and are finite.
+        assert report.rows_in_hi is not None
+        assert report.bytes_staged_hi is not None
+
+    def test_limit_caps_interval(self):
+        compiled, _ = _compile(
+            """
+import px
+df = px.DataFrame(table='t')
+df = df.head(7)
+px.display(df)
+""",
+            STATS,
+        )
+        report = compiled.plan.resource_report
+        sink_bounds = [
+            report.nodes[n.id]
+            for n in compiled.plan.nodes.values()
+        ]
+        assert any(b.rows.hi == 7 for b in sink_bounds)
+
+    def test_join_bound_uses_ndv_estimate(self):
+        compiled, _ = _compile(
+            """
+import px
+l = px.DataFrame(table='t')
+r = px.DataFrame(table='r')
+g = l.merge(r, how='inner', left_on=['k'], right_on=['k'],
+            suffixes=['', '_r'])
+out = g.groupby(['svc', 'w']).agg(n=('v', px.count))
+px.display(out)
+""",
+            STATS,
+        )
+        report = compiled.plan.resource_report
+        join = _node_of(compiled.plan, JoinOp)
+        jb = report.nodes[join.id]
+        # Fan-out = 2000 rows / 100 NDV = 20; the estimate (x safety,
+        # bucketed) must be far below the l*r worst case and nonzero.
+        assert jb.rows.hi is not None
+        assert jb.rows.hi < 10_000 * 2_000
+        assert report.join_capacity[join.id] >= 10_000
+
+    def test_sketchless_fallback_unbounded_never_crashes(self):
+        compiled, _ = _compile(
+            """
+import px
+df = px.DataFrame(table='t')
+out = df.groupby('svc').agg(n=('v', px.count))
+px.display(out)
+""",
+            table_stats={},  # no sketches at all
+        )
+        report = compiled.plan.resource_report
+        assert report is not None
+        src = _node_of(compiled.plan, MemorySourceOp)
+        assert report.nodes[src.id].rows.hi is None
+        assert report.bytes_staged_hi is None
+        assert report.rows_in_hi is None
+        # And an enforced budget must NOT reject an unknown prediction.
+        with override_flag("bounds_query_budget_mb", 0.001):
+            compiled2, _ = _compile(
+                "import px\npx.display(px.DataFrame(table='t'))",
+                table_stats={},
+            )
+            assert compiled2.plan.resource_report.diagnostics == []
+
+    def test_bridge_bound_seeds_merge_fragment(self):
+        from pixie_tpu.planner.distributed import DistributedPlanner
+        from pixie_tpu.planner.distributed.distributed_state import (
+            DistributedState,
+        )
+
+        compiled, state = _compile(
+            """
+import px
+df = px.DataFrame(table='t')
+out = df.groupby('svc').agg(n=('v', px.count))
+px.display(out)
+""",
+            STATS,
+        )
+        reg = default_registry()
+        dplan = DistributedPlanner(reg).plan(
+            compiled.plan, DistributedState.homogeneous(3, 1),
+            schemas=SCHEMAS, table_stats=STATS,
+        )
+        rep = dplan.resource_report
+        assert set(rep) == {"data", "merge", "wire_bytes_hi"}
+        # Wire bound: 3 agents' bridge payloads, each bounded by the
+        # partial agg's group count — finite and > 0.
+        assert rep["wire_bytes_hi"] is not None and rep["wire_bytes_hi"] > 0
+        # The merge fragment's bridge source is seeded (3 x data bound),
+        # so its totals are finite too.
+        assert rep["merge"].rows_out_hi is not None
+        cost = merged_cost(compiled.plan.resource_report, rep)
+        assert cost["wire_bytes_hi"] == rep["wire_bytes_hi"]
+
+
+class TestGoldenDiagnostics:
+    QUERY = """
+import px
+df = px.DataFrame(table='t')
+out = df.groupby('svc').agg(n=('v', px.count))
+px.display(out)
+"""
+
+    def test_query_budget_rejects_at_compile(self):
+        with override_flag("bounds_query_budget_mb", 0.001):
+            with pytest.raises(PlanCheckError) as ei:
+                _compile(self.QUERY, STATS)
+        diags = ei.value.diagnostics
+        assert [d.code for d in diags] == ["resource-bound"]
+        assert "bounds_query_budget_mb" in diags[0].message
+        assert "predicted staged bytes" in diags[0].message
+
+    def test_device_budget_names_the_node(self):
+        with override_flag("bounds_device_budget_mb", 0.0001):
+            with pytest.raises(PlanCheckError) as ei:
+                _compile(self.QUERY, STATS)
+        diags = [d for d in ei.value.diagnostics
+                 if d.code == "resource-bound"]
+        assert diags, "no resource-bound diagnostic"
+        assert any(d.node is not None and d.op for d in diags), (
+            "device-budget diagnostic must carry node provenance"
+        )
+
+    def test_budgets_off_by_default(self):
+        compiled, _ = _compile(self.QUERY, STATS)
+        assert compiled.plan.resource_report.diagnostics == []
+
+
+class TestPresize:
+    def test_agg_presized_to_ndv_bound(self):
+        stats = {
+            "t": {"rows": 500_000, "ndv": {"v": 100_000, "svc": 8},
+                  "zones": {}},
+        }
+        compiled, _ = _compile(
+            """
+import px
+df = px.DataFrame(table='t')
+out = df.groupby('v').agg(n=('k', px.count))
+px.display(out)
+""",
+            stats,
+        )
+        agg = _node_of(compiled.plan, AggOp)
+        # Default max_groups is 4096; NDV 100k x 1.25 -> next pow2.
+        assert agg.op.max_groups >= 100_000
+        assert agg.op.max_groups <= 1 << 22  # max_groups_limit clamp
+
+    def test_presize_never_shrinks(self):
+        compiled, _ = _compile(
+            """
+import px
+df = px.DataFrame(table='t')
+out = df.groupby('svc').agg(n=('v', px.count))
+px.display(out)
+""",
+            STATS,  # svc NDV 8, far below the default 4096
+        )
+        agg = _node_of(compiled.plan, AggOp)
+        assert agg.op.max_groups >= 4096
+
+    def test_presize_flag_off(self):
+        stats = {
+            "t": {"rows": 500_000, "ndv": {"v": 100_000}, "zones": {}},
+        }
+        with override_flag("bounds_presize", False):
+            compiled, _ = _compile(
+                """
+import px
+df = px.DataFrame(table='t')
+out = df.groupby('v').agg(n=('k', px.count))
+px.display(out)
+""",
+                stats,
+            )
+        agg = _node_of(compiled.plan, AggOp)
+        assert agg.op.max_groups == 4096
+
+
+class TestObservedVsPredicted:
+    def test_engine_observed_within_predicted(self):
+        from pixie_tpu.exec.engine import Engine
+
+        engine = Engine()
+        rng = np.random.default_rng(3)
+        n = 6_000
+        engine.append_data("t", {
+            "time_": np.arange(n, dtype=np.int64),
+            "k": rng.integers(0, 50, n).astype(np.int64),
+            "v": rng.integers(0, 1_000, n).astype(np.int64),
+            "svc": [f"s-{i % 5}" for i in range(n)],
+        })
+        engine.execute_query("""
+import px
+df = px.DataFrame(table='t')
+df = df[df.v > 10]
+out = df.groupby('svc').agg(n=('v', px.count), s=('v', px.sum))
+px.display(out)
+""")
+        report = engine.last_resource_report
+        usage = engine.tracer.recent()[0]["usage"]
+        assert report is not None and report.origin == "sketch"
+        cost = report.cost()
+        for obs_key, pred_key in (
+            ("bytes_staged", "bytes_staged_hi"),
+            ("rows_in", "rows_in_hi"),
+            ("rows_out", "rows_out_hi"),
+        ):
+            pred = cost[pred_key]
+            assert pred is not None
+            assert usage[obs_key] <= pred, (obs_key, usage, cost)
+
+    def test_report_memo_hits_on_repeat_compile(self):
+        q = """
+import px
+df = px.DataFrame(table='t')
+out = df.groupby('svc').agg(n=('v', px.count))
+px.display(out)
+"""
+        c1, _ = _compile(q, STATS)
+        c2, _ = _compile(q, STATS)
+        assert c1.plan.resource_report is c2.plan.resource_report
+        # A changed stats snapshot misses (new rows -> new bounds).
+        stats2 = {**STATS, "t": {**STATS["t"], "rows": 20_000}}
+        c3, _ = _compile(q, stats2)
+        assert c3.plan.resource_report is not c1.plan.resource_report
+        assert (
+            c3.plan.resource_report.nodes[
+                _node_of(c3.plan, MemorySourceOp).id
+            ].rows.hi == 20_000
+        )
+
+
+class TestAdmission:
+    def _predicted(self, nbytes):
+        return {"bytes_staged_hi": nbytes, "origin": "sketch",
+                "safety": 2.0}
+
+    def test_reject_over_whole_budget(self):
+        from pixie_tpu.services.query_broker import (
+            AdmissionError, _Admission,
+        )
+
+        adm = _Admission()
+        with override_flag("admission_bytes_budget_mb", 1.0):
+            with pytest.raises(AdmissionError) as ei:
+                adm.admit("q1", self._predicted(2 << 20))
+        assert ei.value.diagnostic.code == "admission-reject"
+        assert adm.in_flight() == {}
+
+    def test_unknown_cost_admitted(self):
+        from pixie_tpu.services.query_broker import _Admission
+
+        adm = _Admission()
+        with override_flag("admission_bytes_budget_mb", 1.0):
+            adm.admit("q1", None)
+            adm.admit("q2", {"bytes_staged_hi": None})
+        assert adm.in_flight() == {}
+
+    def test_queue_then_admit_on_release(self):
+        import threading
+
+        from pixie_tpu.services.query_broker import _Admission
+
+        adm = _Admission()
+        order = []
+        with override_flag("admission_bytes_budget_mb", 1.0), \
+                override_flag("admission_queue_s", 5.0):
+            adm.admit("q1", self._predicted(800 << 10))
+
+            def second():
+                adm.admit("q2", self._predicted(800 << 10))
+                order.append("q2-admitted")
+
+            t = threading.Thread(target=second)
+            t.start()
+            time.sleep(0.15)
+            assert order == []  # q2 queued behind q1
+            order.append("release-q1")
+            adm.release("q1")
+            t.join(5.0)
+        assert order == ["release-q1", "q2-admitted"]
+        assert list(adm.in_flight()) == ["q2"]
+
+    def test_queue_timeout_rejects(self):
+        from pixie_tpu.services.query_broker import (
+            AdmissionError, _Admission,
+        )
+
+        adm = _Admission()
+        with override_flag("admission_bytes_budget_mb", 1.0), \
+                override_flag("admission_queue_s", 0.1):
+            adm.admit("q1", self._predicted(800 << 10))
+            with pytest.raises(AdmissionError) as ei:
+                adm.admit("q2", self._predicted(800 << 10))
+        assert "queued past" in str(ei.value)
+        assert list(adm.in_flight()) == ["q1"]
+
+    def test_broker_rejects_end_to_end(self):
+        """A cluster-path over-budget query is refused before any
+        dispatch, with the structured diagnostic in the error."""
+        from pixie_tpu.services import (
+            AgentTracker, KelvinAgent, MessageBus, PEMAgent, QueryBroker,
+        )
+        from pixie_tpu.services.query_broker import AdmissionError
+
+        bus = MessageBus()
+        tracker = AgentTracker(bus, expiry_s=60.0, check_interval_s=60.0)
+        pem = PEMAgent(bus, "pem-0", heartbeat_interval_s=30.0).start()
+        kelvin = KelvinAgent(
+            bus, "kelvin-0", heartbeat_interval_s=30.0
+        ).start()
+        try:
+            n = 4_000
+            rng = np.random.default_rng(0)
+            pem.append_data("http_events", {
+                "time_": np.arange(n, dtype=np.int64),
+                "latency_ns": rng.integers(1_000, 1_000_000, n),
+                "resp_status": rng.choice(np.array([200, 404]), n),
+                "service": [f"svc-{i % 4}" for i in range(n)],
+            })
+            pem._register()  # ship post-ingest schemas + table stats
+            deadline = time.time() + 5
+            while time.time() < deadline and not tracker.table_stats():
+                time.sleep(0.01)
+            assert tracker.table_stats()["http_events"]["rows"] == n
+            broker = QueryBroker(bus, tracker)
+            q = """
+import px
+df = px.DataFrame(table='http_events')
+out = df.groupby('service').agg(n=('latency_ns', px.count))
+px.display(out)
+"""
+            # Sanity: admitted when the budget is off.
+            res = broker.execute_script(q, timeout_s=20)
+            assert res["tables"]["output"].length == 4
+            assert broker.tracer.recent()[0]["predicted"][
+                "bytes_staged_hi"
+            ] is not None
+            with override_flag("admission_bytes_budget_mb", 0.001):
+                with pytest.raises(AdmissionError) as ei:
+                    broker.execute_script(q, timeout_s=20)
+            assert ei.value.diagnostic.code == "admission-reject"
+            # Nothing leaked: the forwarder has no active query and the
+            # admission ledger is empty.
+            assert broker.admission.in_flight() == {}
+        finally:
+            pem.stop()
+            kelvin.stop()
+            tracker.close()
+            bus.close()
+
+
+class TestCapacityCacheLRU:
+    def test_evicts_oldest_and_counts(self, monkeypatch):
+        from pixie_tpu.exec import joins
+
+        class Eng:
+            _join_capacity_cache: dict = {}
+
+        eng = Eng()
+        eng._join_capacity_cache = {}
+        monkeypatch.setattr(joins, "_CAPACITY_CACHE_MAX", 3)
+        base = joins._eviction_counter().value()
+        for i in range(3):
+            joins.remember_capacity(eng, ("k", i), 100 + i)
+        # Touch k0 so it is most-recent; inserting k3 must evict k1.
+        assert joins.learned_capacity(eng, ("k", 0)) == 100
+        joins.remember_capacity(eng, ("k", 3), 103)
+        assert joins.learned_capacity(eng, ("k", 1)) is None
+        assert joins.learned_capacity(eng, ("k", 0)) == 100
+        assert joins.learned_capacity(eng, ("k", 3)) == 103
+        assert joins._eviction_counter().value() == base + 1
+
+    def test_rewrite_refreshes_entry(self, monkeypatch):
+        from pixie_tpu.exec import joins
+
+        class Eng:
+            pass
+
+        eng = Eng()
+        eng._join_capacity_cache = {}
+        monkeypatch.setattr(joins, "_CAPACITY_CACHE_MAX", 2)
+        joins.remember_capacity(eng, "a", 1)
+        joins.remember_capacity(eng, "b", 2)
+        joins.remember_capacity(eng, "a", 3)  # re-learn: refresh, no evict
+        assert set(eng._join_capacity_cache) == {"a", "b"}
+        joins.remember_capacity(eng, "c", 4)  # evicts b (oldest now)
+        assert set(eng._join_capacity_cache) == {"a", "c"}
+
+
+class TestBlockingCallUnderLockRule:
+    def _lint(self, tmp_path, src):
+        from pixie_tpu.analysis.lint import run_lint
+
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent(src))
+        return run_lint(
+            [str(tmp_path)], rules={"blocking-call-under-lock"},
+            baseline_path=str(tmp_path / "nb.json"),
+            repo_root=str(tmp_path),
+        )
+
+    def test_flags_blocking_calls_under_lock(self, tmp_path):
+        report = self._lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self, bus):
+                    self._lock = threading.Lock()
+                    self.bus = bus
+
+                def bad(self, x):
+                    with self._lock:
+                        r = self.bus.request("t", {})
+                        x.block_until_ready()
+                        v = x.item()
+                    return r, v
+        """)
+        msgs = [f.message for f in report.findings]
+        assert len(msgs) == 3
+        assert any("request" in m for m in msgs)
+        assert any("block_until_ready" in m for m in msgs)
+        assert any(".item()" in m for m in msgs)
+        assert all(f.symbol == "C.bad" for f in report.findings)
+
+    def test_outside_lock_and_nested_def_clean(self, tmp_path):
+        report = self._lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self, bus):
+                    self._lock = threading.Lock()
+                    self.bus = bus
+                    self.state = {}
+
+                def ok(self, x):
+                    with self._lock:
+                        s = dict(self.state)
+                    return self.bus.request("t", s)
+
+                def deferred(self):
+                    with self._lock:
+                        def later():
+                            return self.bus.request("t", {})
+                    return later
+        """)
+        assert report.findings == []
+
+    def test_no_false_positive_on_requests_lib(self, tmp_path):
+        report = self._lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def fetch(self, session):
+                    with self._lock:
+                        return session.request("GET", "http://x")
+        """)
+        assert report.findings == []
+
+    def test_suppression(self, tmp_path):
+        report = self._lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self, bus):
+                    self._lock = threading.Lock()
+                    self.bus = bus
+
+                def justified(self):
+                    with self._lock:
+                        # pxlint: disable=blocking-call-under-lock
+                        return self.bus.request("t", {})
+        """)
+        assert report.findings == []
+        assert report.suppressed == 1
+
+    def test_repo_is_green(self):
+        import os
+
+        from pixie_tpu.analysis.lint import run_lint
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        report = run_lint(
+            [os.path.join(repo, "pixie_tpu")],
+            rules={"blocking-call-under-lock"},
+        )
+        assert report.findings == [], "\n".join(
+            f.render() for f in report.findings
+        )

@@ -141,8 +141,15 @@ px.display(out)
     ), "guard failed: pre-grouped build side was re-aggregated"
 
 
+@pytest.mark.slow
 def test_quantiles_blocks_rewrite():
-    """Non-decomposable aggregates must not be pushed through the join."""
+    """Non-decomposable aggregates must not be pushed through the join.
+
+    Marked slow: the t-digest compress kernel over the joined stream is
+    the single heaviest XLA:CPU compile in the suite (~300s on the seed
+    — over a third of the 870s tier-1 budget by itself); the rewrite
+    GUARD half is covered fast by test_pre_aggregated_build_not_reaggregated
+    above, and the digest numerics by test_native_fold's fast cases."""
     eng = Engine(window_rows=1 << 13)
     lk, lb, rk, rv, n_keys = _two_tables(eng, n=5_000, n_keys=50)
     q = """

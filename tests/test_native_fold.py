@@ -289,9 +289,17 @@ class TestNativeDigestFold:
             m = sc == svcs.index(s)
             assert lat[m].min() <= p50 <= lat[m].max()
 
+    @pytest.mark.slow
     def test_windowed_quantiles_script_path(self):
         """service_let-style windowed quantiles run through the digest
-        fold (strided dense window keys + sketch aggs together)."""
+        fold (strided dense window keys + sketch aggs together).
+
+        Marked slow: the windowed-digest fragment is the second-
+        heaviest XLA:CPU compile in the suite (~195s on the seed);
+        together with test_quantiles_blocks_rewrite it pushed the full
+        'not slow' sweep past the 870s tier-1 timeout (ROADMAP). The
+        digest-fold numerics stay covered by the fast cases in this
+        class."""
         eng, cols, svcs = _mk_engine(n=40_000, seed=6)
         got = eng.execute_query("""
 import px

@@ -153,7 +153,7 @@ def live_cluster():
         _push(pem, 0, 1000, seed=i)
         pem._register()
     deadline = time.time() + 5
-    while time.time() < deadline and len(tracker.schemas()) < 1:
+    while time.time() < deadline and 'http_events' not in tracker.schemas():
         time.sleep(0.01)
     broker = QueryBroker(bus, tracker)
     broker.serve()
@@ -369,7 +369,7 @@ class TestNetbusStreaming:
         _push(pem, 0, 500, seed=3)
         pem._register()
         deadline = time.time() + 5
-        while time.time() < deadline and len(tracker.schemas()) < 1:
+        while time.time() < deadline and 'http_events' not in tracker.schemas():
             time.sleep(0.01)
         broker = QueryBroker(bus, tracker)
         updates = []
@@ -422,7 +422,7 @@ class TestNetbusStreaming:
         _push(pem, 0, 500, seed=4)
         pem._register()
         deadline = time.time() + 5
-        while time.time() < deadline and len(tracker.schemas()) < 1:
+        while time.time() < deadline and 'http_events' not in tracker.schemas():
             time.sleep(0.01)
         broker = QueryBroker(bus, tracker)
         updates = []
