@@ -138,6 +138,7 @@ def _check_one(name, engine, query, verbose) -> tuple[int, float, float]:
     hit = best_of(lambda: apply_plan_bounds(
         compiled.plan, state.schemas, state.registry, state.table_stats,
         script=query,
+        plan_params=(state.max_output_rows, state.max_groups),
     ))
     # The cold walk an ingest-invalidated snapshot pays (uncached).
     cold = best_of(lambda: plan_bounds(

@@ -671,11 +671,16 @@ def _stats_key(table_stats: dict) -> str:
 
 def apply_plan_bounds(plan: Plan, schemas, registry, table_stats=None, *,
                       plan_name: str = "logical",
-                      script: str | None = None) -> PlanResourceReport:
+                      script: str | None = None,
+                      plan_params: tuple = ()) -> PlanResourceReport:
     """The compile-path entry point (``compile_pxl``): compute bounds,
     enforce budgets, pre-size aggregates, and attach the report to the
     plan (``plan.resource_report``) for the engine and broker.
-    ``script`` enables the repeat-compile memo."""
+    ``script`` enables the repeat-compile memo; ``plan_params`` must
+    carry every compile input that shapes the plan beyond the script
+    text (max_output_rows sizes the injected LimitOp that caps row/byte
+    bounds, max_groups sizes AggOps) — same contract as
+    ``check_script_plan``."""
     from ..config import get_flag, get_flags
 
     key = None
@@ -688,6 +693,7 @@ def apply_plan_bounds(plan: Plan, schemas, registry, table_stats=None, *,
                 )),
                 id(registry),
                 _stats_key(table_stats or {}),
+                plan_params,
                 # Every flag the walk or its budget checks read.
                 get_flags(
                     "bounds_safety", "bounds_query_budget_mb",
@@ -769,9 +775,11 @@ def merged_cost(logical: PlanResourceReport | None,
         return None
     cost = logical.cost()
     if distributed:
-        w = distributed.get("wire_bytes_hi")
-        if w is not None:
-            cost["wire_bytes_hi"] = w
+        # Unconditional: the logical plan's wire bound is a known 0 (no
+        # BridgeSinkOps), but a distributed query ships bridge bytes —
+        # an unknown wire bound (sketch-less data fragment) must stay
+        # None per PlanResourceReport's contract, never that stale 0.
+        cost["wire_bytes_hi"] = distributed.get("wire_bytes_hi")
         # Merge-side staging (bridge payload re-staging on the kelvin)
         # rides the safety factor; per-agent peak is the data fragment's.
         data = distributed.get("data")

@@ -647,14 +647,25 @@ class BlockingCallUnderLockRule:
 
     def _scan(self, ctx, node, qn, locks, locked):
         if isinstance(node, ast.With):
-            held = locked or any(
-                _self_attr(item.context_expr) in locks
-                or (
-                    isinstance(item.context_expr, ast.Call)
-                    and _self_attr(item.context_expr.func) in locks
-                )
-                for item in node.items
-            )
+            # Items evaluate in order, each after the previous item's
+            # __enter__ — so a context expression AFTER a lock item (or
+            # inside a nested `with` header under an outer lock) is a
+            # held-lock call site too.
+            held = locked
+            for item in node.items:
+                yield from self._scan(ctx, item.context_expr, qn, locks,
+                                      held)
+                if item.optional_vars is not None:
+                    yield from self._scan(ctx, item.optional_vars, qn,
+                                          locks, held)
+                if (
+                    _self_attr(item.context_expr) in locks
+                    or (
+                        isinstance(item.context_expr, ast.Call)
+                        and _self_attr(item.context_expr.func) in locks
+                    )
+                ):
+                    held = True
             for child in node.body:
                 if isinstance(
                     child, (ast.FunctionDef, ast.AsyncFunctionDef)

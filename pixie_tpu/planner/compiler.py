@@ -159,6 +159,7 @@ def compile_pxl(query: str, state: CompilerState) -> CompiledScript:
     apply_plan_bounds(
         builder.plan, builder.schemas, state.registry, state.table_stats,
         script=query,
+        plan_params=(state.max_output_rows, state.max_groups),
     )
     return CompiledScript(
         plan=builder.plan, outputs=list(builder.sinks), funcs=visitor.funcs,

@@ -186,6 +186,20 @@ px.display(out)
         cost = merged_cost(compiled.plan.resource_report, rep)
         assert cost["wire_bytes_hi"] == rep["wire_bytes_hi"]
 
+    def test_merged_cost_unknown_wire_stays_none(self):
+        # A sketch-less data fragment has an unknown wire bound; the
+        # logical plan's wire_bytes_hi is a known 0 (no bridges) and
+        # must not leak into the merged cost as a false-precise bound.
+        compiled, _ = _compile(
+            "import px\npx.display(px.DataFrame(table='t'))\n", STATS,
+        )
+        assert compiled.plan.resource_report.wire_bytes_hi == 0
+        cost = merged_cost(
+            compiled.plan.resource_report,
+            {"data": None, "merge": None, "wire_bytes_hi": None},
+        )
+        assert cost["wire_bytes_hi"] is None
+
 
 class TestGoldenDiagnostics:
     QUERY = """
@@ -322,6 +336,22 @@ px.display(out)
             c3.plan.resource_report.nodes[
                 _node_of(c3.plan, MemorySourceOp).id
             ].rows.hi == 20_000
+        )
+
+    def test_memo_keys_on_plan_params(self):
+        # max_output_rows shapes the injected LimitOp that caps the
+        # row/byte bounds — two compiles of one script with different
+        # limits must not share a memoized report (the broker compiles
+        # with client limits AND with 1<<62 on the live path).
+        q = "import px\npx.display(px.DataFrame(table='t'))\n"
+        small, _ = _compile(q, STATS, max_output_rows=5)
+        big, _ = _compile(q, STATS, max_output_rows=1 << 62)
+        assert (
+            small.plan.resource_report is not big.plan.resource_report
+        )
+        assert (
+            small.plan.resource_report.rows_out_hi
+            < big.plan.resource_report.rows_out_hi
         )
 
 
@@ -517,6 +547,36 @@ class TestBlockingCallUnderLockRule:
         assert any("block_until_ready" in m for m in msgs)
         assert any(".item()" in m for m in msgs)
         assert all(f.symbol == "C.bad" for f in report.findings)
+
+    def test_flags_calls_in_with_headers(self, tmp_path):
+        report = self._lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self, bus):
+                    self._lock = threading.Lock()
+                    self.bus = bus
+
+                def nested_header(self):
+                    with self._lock:
+                        with wrap(self.bus.request("t", {})):
+                            pass
+
+                def same_statement(self):
+                    with self._lock, wrap(self.bus.request("t", {})):
+                        pass
+
+                def header_before_lock(self):
+                    with wrap(self.bus.request("t", {})), self._lock:
+                        pass
+        """)
+        by_symbol = {f.symbol for f in report.findings}
+        assert "C.nested_header" in by_symbol
+        assert "C.same_statement" in by_symbol
+        # Evaluated BEFORE the lock item's __enter__ — not a held-lock
+        # call site.
+        assert "C.header_before_lock" not in by_symbol
+        assert len(report.findings) == 2
 
     def test_outside_lock_and_nested_def_clean(self, tmp_path):
         report = self._lint(tmp_path, """
