@@ -688,8 +688,12 @@ def apply_plan_bounds(plan: Plan, schemas, registry, table_stats=None, *,
         try:
             key = (
                 script,
+                # items_tuple() is cached on the (immutable) Relation —
+                # rebuilding ~20 canonical tables' tuples per compile
+                # was the dominant cost of a memo hit.
                 tuple(sorted(
-                    (t, tuple(r.items())) for t, r in (schemas or {}).items()
+                    (t, r.items_tuple())
+                    for t, r in (schemas or {}).items()
                 )),
                 id(registry),
                 _stats_key(table_stats or {}),

@@ -1,7 +1,8 @@
 """Self-observability gate (``run_tests.sh --obs``; runs in --tier1).
 
 Compiles every bundled self-monitoring PxL script (px/slow_queries,
-px/query_cost, px/agent_health) against the telemetry table schemas
+px/query_cost, px/agent_health, px/program_cost, px/bound_accuracy)
+against the telemetry table schemas
 (``ingest/schemas.py`` TELEMETRY_SCHEMAS) with the always-on plan
 verifier active, then splits each through the DistributedPlanner (2
 PEMs + 1 Kelvin) and runs the full distributed schema walk — the same
@@ -16,7 +17,12 @@ from __future__ import annotations
 import sys
 
 #: The bundled self-monitoring scripts this gate covers.
-OBS_SCRIPTS = ("px/slow_queries", "px/query_cost", "px/agent_health")
+OBS_SCRIPTS = (
+    "px/slow_queries", "px/query_cost", "px/agent_health",
+    # Device tier (PR 12): the program registry's __programs__ table
+    # and the predicted-vs-observed calibration over __queries__.
+    "px/program_cost", "px/bound_accuracy",
+)
 
 
 def check_obs_scripts(verbose: bool = True) -> int:

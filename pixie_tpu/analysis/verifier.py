@@ -577,8 +577,10 @@ def check_script_plan(plan: Plan, script: str, schemas, registry,
     try:
         key = (
             script,
+            # items_tuple(): cached on the immutable Relation (see
+            # apply_plan_bounds' key — same memo-hit cost argument).
             tuple(sorted(
-                (t, tuple(r.items())) for t, r in schemas.items()
+                (t, r.items_tuple()) for t, r in schemas.items()
             )),
             id(registry),
             plan_params,

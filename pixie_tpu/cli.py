@@ -274,8 +274,8 @@ def cmd_debug(args) -> int:
         print("no recent queries")
         return 0
     hdr = (f"{'qid':12s} {'status':8s} {'ms':>9s} {'rows':>9s} "
-           f"{'staged':>9s} {'pred':>9s} {'device':>9s} {'wire':>9s} "
-           "agents")
+           f"{'staged':>9s} {'pred':>9s} {'pred/obs':>8s} {'device':>9s} "
+           f"{'wire':>9s} agents")
     print(hdr)
     for row in res["in_flight"] + rows:
         u = row.get("usage", {})
@@ -283,7 +283,22 @@ def cmd_debug(args) -> int:
         # pxbound predicted staged bytes next to the observed column —
         # the admission-control signal, auditable per query (a observed
         # > predicted row is a soundness bug; see docs/ANALYSIS.md).
-        pb = (row.get("predicted") or {}).get("bytes_staged_hi")
+        pred = row.get("predicted") or {}
+        pb = pred.get("bytes_staged_hi")
+        # Calibration ratio: how far the plan-time prediction over-
+        # shoots reality (>= 1 is pxbound's soundness contract; huge =
+        # the over-conservatism the observed floor narrows). Blank when
+        # either side is unknown — a sketch-less prediction, a fully
+        # device-resident run with zero staged bytes — or when the
+        # "prediction" IS observed history (origin contains
+        # "observed"): that number is yesterday's max, not a pxbound
+        # bound, and a < 1 ratio there is growth, not unsoundness.
+        obs = u.get("bytes_staged", 0)
+        floored = "observed" in str(pred.get("origin", ""))
+        ratio = (
+            f"{pb / obs:.2f}" if pb is not None and obs and not floored
+            else "-"
+        )
         print(
             f"{row.get('qid') or row['id'][:12]:12s} "
             f"{row['status']:8s} "
@@ -291,6 +306,7 @@ def cmd_debug(args) -> int:
             f"{row.get('rows_out', u.get('rows_out', 0)):>9d} "
             f"{_fmt_bytes(u.get('bytes_staged', 0)):>9s} "
             f"{'-' if pb is None else _fmt_bytes(pb):>9s} "
+            f"{ratio:>8s} "
             f"{u.get('device_ms', 0.0):>8.1f}ms "
             f"{_fmt_bytes(u.get('wire_bytes', 0)):>9s} "
             f"{','.join(agents)}"

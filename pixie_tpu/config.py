@@ -336,6 +336,36 @@ define_flag(
     "0 rejects immediately when the budget is full.",
 )
 
+# -- device-tier observability (exec/programs.py) ----------------------------
+define_flag(
+    "program_registry_size",
+    512,
+    "Compiled-program registry capacity (exec/programs.py): tracked "
+    "(program, shape-signature) records — each holding its XLA "
+    "executable, compile wall-time and cost/memory analysis — kept in "
+    "an LRU; oldest evicted (and recompiled on next use). 0 disables "
+    "tracking entirely (jit entry points run unwrapped).",
+)
+define_flag(
+    "device_memory_poll_s",
+    0.0,
+    "Background device.memory_stats() poll period for per-query peak "
+    "device-memory attribution (QueryResourceUsage.device_peak_bytes). "
+    "0 disables the poll thread; peaks then come from the query-"
+    "boundary samples alone. Gauges refresh at every /metrics scrape "
+    "regardless.",
+)
+define_flag(
+    "admission_observed_floor",
+    True,
+    "Broker admission control floors predicted_cost at the observed "
+    "per-script-hash bytes_staged history from finished query traces "
+    "(the __queries__ feedback loop): a sketch-less UNKNOWN prediction "
+    "with history is admitted against the observed bytes instead of "
+    "zero, and a known prediction below observed reality is raised to "
+    "it. Only matters while admission_bytes_budget_mb > 0.",
+)
+
 # -- self-observability (services/telemetry.py) ------------------------------
 define_flag(
     "self_telemetry", True,

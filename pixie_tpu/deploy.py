@@ -61,6 +61,7 @@ def run_broker() -> int:
         # view (broker + agent spans per trace id) backs /debug/tracez.
         tracer=broker.tracer,
         trace_view=broker.trace_view,
+        programs=_program_registry(),
     )
     obs_port = obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "6101")))
     print(
@@ -163,9 +164,20 @@ def _agent_obs(agent, extra=None) -> int:
 
     default_registry.register_collector(engine_collector(agent.engine))
     obs = ObservabilityServer(
-        statusz_fn=statusz, tracer=agent.engine.tracer
+        statusz_fn=statusz, tracer=agent.engine.tracer,
+        # Device-tier surfaces: the process program registry backs
+        # /debug/programz; pixie_device_memory_bytes gauges refresh at
+        # scrape through the default monitor's collector (installed by
+        # the engine).
+        programs=_program_registry(),
     )
     return obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "0")))
+
+
+def _program_registry():
+    from .exec.programs import default_program_registry
+
+    return default_program_registry()
 
 
 def _wait_forever() -> None:

@@ -219,6 +219,15 @@ class Engine:
         # _compile_table_stats. None = off (the default for bare
         # engines; agents/deploy roles wire it).
         self.telemetry = None
+        # Device-tier observability (exec/programs.py): the shared
+        # device-memory monitor brackets every execute_plan so the
+        # query's high-water device bytes land in
+        # QueryResourceUsage.device_peak_bytes (memory_stats() is None
+        # on CPU — the bracket then costs two no-op samples).
+        from .programs import default_device_monitor
+
+        self.device_memory = default_device_monitor()
+        self.device_memory.start()  # no-op unless device_memory_poll_s
 
     @property
     def tables(self) -> dict:
@@ -405,6 +414,19 @@ class Engine:
         # the soundness gate compares it against the trace's observed
         # QueryResourceUsage.
         self.last_resource_report = getattr(plan, "resource_report", None)
+        # Predicted-vs-observed calibration (__queries__ feedback loop):
+        # stamp the plan's predicted cost on the trace so the telemetry
+        # fold records it NEXT TO the observed usage — px/bound_accuracy
+        # computes the per-script calibration ratio from the pair. The
+        # broker path stamps its merged (logical + wire) cost instead.
+        if trace.predicted is None and self.last_resource_report is not None:
+            from ..analysis.bounds import merged_cost
+
+            trace.predicted = merged_cost(self.last_resource_report, None)
+        mem_token = (
+            self.device_memory.query_begin()
+            if self.device_memory is not None else None
+        )
         # The trace's stats spine IS the per-fragment stats object —
         # analyze just runs it with sync=True (see analyze.py).
         self._query_stats = trace.stats
@@ -423,6 +445,10 @@ class Engine:
                 trace.usage.retries += int(getattr(jd, "retries", 0))
                 trace.usage.skipped_windows += int(
                     getattr(jd, "skipped_windows", 0)
+                )
+            if mem_token is not None:
+                trace.usage.device_peak_bytes = (
+                    self.device_memory.query_end(mem_token)
                 )
 
     @staticmethod

@@ -164,7 +164,7 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry,
     try:
         key = (
             _struct_key(tuple(ops)),
-            tuple(input_relation.items()),
+            input_relation.items_tuple(),
             tuple(
                 sorted((n, id(d), len(d)) for n, d in input_dicts.items())
             ),
@@ -188,6 +188,7 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry,
             ops, input_relation, input_dicts, registry, allow_dense,
             col_stats=col_stats,
         )
+        _track_fragment_programs(frag, ops, key, input_dicts, registry)
         if len(_FRAGMENT_CACHE) >= _FRAGMENT_CACHE_MAX:
             _FRAGMENT_CACHE.pop(next(iter(_FRAGMENT_CACHE)))
         # The entry pins the id()-keyed objects (dicts, registry): a freed
@@ -197,6 +198,41 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry,
     else:
         frag = hit[0]
     return frag
+
+
+def _track_fragment_programs(frag, ops, cache_key, input_dicts,
+                             registry) -> None:
+    """Wrap a fresh fragment's jit entry points in the process program
+    registry (exec/programs.py): per-shape compile wall-time + XLA
+    cost/memory analysis, hit/miss counts, /debug/programz and the
+    ``__programs__`` telemetry table. Keyed by the fragment cache key —
+    the same structural identity that keys THIS cache — so a repeated
+    plan's second run is a registry hit, and a fragment-cache eviction
+    can still reuse the registry's executable instead of recompiling
+    (the registry pins the id()-keyed objects exactly like the entry
+    above). No-op when program_registry_size is 0."""
+    from .programs import default_program_registry
+
+    preg = default_program_registry()
+    label = ",".join(type(o).__name__ for o in ops) or "(scan)"
+    pins = (tuple(input_dicts.values()), registry)
+    frag.update = preg.wrap(
+        frag.update, "fragment_update", (cache_key, "update"), label,
+        pins=pins,
+    )
+    frag.update_all = preg.wrap(
+        frag.update_all, "fragment_scan_fold", (cache_key, "update_all"),
+        label, pins=pins,
+    )
+    frag.finalize = preg.wrap(
+        frag.finalize, "fragment_finalize", (cache_key, "finalize"),
+        label, pins=pins,
+    )
+    if frag.native_fold is not None:
+        frag.native_fold["inputs_jit"] = preg.wrap(
+            frag.native_fold["inputs_jit"], "native_fold_inputs",
+            (cache_key, "native_inputs"), label, pins=pins,
+        )
 
 
 def _range_valid(cols, valid):

@@ -540,13 +540,23 @@ def _join_key_planes(hb, cols, remaps):
 
 @functools.lru_cache(maxsize=64)
 def _device_join_cache(n_build, n_probe, dtypes, capacity, how):
-    """One jitted kernel per (bucketed shapes, key dtypes, capacity, how)."""
+    """One jitted kernel per (bucketed shapes, key dtypes, capacity, how).
+    Tracked in the program registry (exec/programs.py): the lru key
+    params fully determine the traced program, so they ARE the program
+    key — compile wall-time, XLA cost/memory analysis and hit counts
+    land in /debug/programz and ``__programs__``."""
     import jax
 
     from ..ops.join import device_join
+    from .programs import default_program_registry
 
-    return jax.jit(
+    fn = jax.jit(
         lambda bk, bv, pk, pv: device_join(bk, bv, pk, pv, capacity, how)
+    )
+    return default_program_registry().wrap(
+        fn, "join_single_shot",
+        ("join", "single", n_build, n_probe, dtypes, capacity, how),
+        f"single nb={n_build} np={n_probe} cap={capacity} {how}",
     )
 
 
@@ -555,13 +565,20 @@ def _probe_sorted_cache(n_build_cap, n_probe_cap, capacity, how):
     """One jitted presorted-probe kernel per (bucketed shapes, capacity,
     how); the sorted build side and its row count are runtime args, so
     every probe window of a query (and across queries of the same
-    shapes) reuses one program."""
+    shapes) reuses one program. Registry-tracked (see
+    ``_device_join_cache``)."""
     import jax
 
     from ..ops.join import probe_sorted_join
+    from .programs import default_program_registry
 
-    return jax.jit(
+    fn = jax.jit(
         lambda sbk, rb, pk, pv: probe_sorted_join(sbk, rb, pk, pv, capacity, how)
+    )
+    return default_program_registry().wrap(
+        fn, "join_probe_sorted",
+        ("join", "sorted", n_build_cap, n_probe_cap, capacity, how),
+        f"sorted nb={n_build_cap} w={n_probe_cap} cap={capacity} {how}",
     )
 
 
@@ -570,15 +587,24 @@ def _radix_probe_cache(n_build_cap, n_probe_cap, capacity, how, radix_bits,
                        steps):
     """One jitted radix-partitioned probe kernel per (bucketed shapes,
     capacity, how, partition count, search depth); the partitioned build
-    keys and offsets are runtime args — see ``_probe_sorted_cache``."""
+    keys and offsets are runtime args — see ``_probe_sorted_cache``.
+    Registry-tracked (see ``_device_join_cache``)."""
     import jax
 
     from ..ops.join import radix_probe_join
+    from .programs import default_program_registry
 
-    return jax.jit(
+    fn = jax.jit(
         lambda sbk, starts, pk, pv: radix_probe_join(
             sbk, starts, pk, pv, capacity, how, radix_bits, steps
         )
+    )
+    return default_program_registry().wrap(
+        fn, "join_probe_radix",
+        ("join", "radix", n_build_cap, n_probe_cap, capacity, how,
+         radix_bits, steps),
+        f"radix nb={n_build_cap} w={n_probe_cap} cap={capacity} {how} "
+        f"bits={radix_bits}",
     )
 
 

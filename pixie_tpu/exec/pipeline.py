@@ -78,6 +78,15 @@ PXLINT_HOT_REGIONS = (
     # no-sync contract.
     "exec/trace.py:QueryTrace._finalize_usage",
     "exec/trace.py:TracedFragment.add",
+    # Program registry (exec/programs.py): TrackedProgram.__call__ runs
+    # once per tracked dispatch (i.e. per window) and the registry's
+    # lookup/record/drain paths run under its lock — a host sync in any
+    # of them would serialize every fold loop in the process. The
+    # device-memory query brackets run per query with the same
+    # contract (memory_stats() is a host call, not a device fence).
+    "exec/programs.py:TrackedProgram*",
+    "exec/programs.py:ProgramRegistry*",
+    "exec/programs.py:DeviceMemoryMonitor*",
 )
 
 

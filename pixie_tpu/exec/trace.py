@@ -112,6 +112,10 @@ class QueryResourceUsage:
     - ``retries``       dispatch retries (broker) + join-capacity
       overflow retries (engine)
     - ``skipped_windows`` probe/scan windows never staged (zone maps)
+    - ``device_peak_bytes`` high-water device ``bytes_in_use`` observed
+      while the query ran (``exec/programs.py`` DeviceMemoryMonitor;
+      TPU-real, 0 on backends whose ``memory_stats()`` is None).
+      Merges by MAX across agents — it is a watermark, not a volume.
     """
 
     rows_in: int = 0
@@ -124,6 +128,7 @@ class QueryResourceUsage:
     wire_bytes: int = 0
     retries: int = 0
     skipped_windows: int = 0
+    device_peak_bytes: int = 0
 
     def to_dict(self) -> dict:
         d = asdict(self)
@@ -142,6 +147,11 @@ class QueryResourceUsage:
             setattr(self, k, getattr(self, k) + int(d.get(k, 0)))
         for k in ("device_ms", "compile_ms", "stall_ms"):
             setattr(self, k, getattr(self, k) + float(d.get(k, 0.0)))
+        # A watermark, not a volume: agents sharing a device would
+        # double-count under addition.
+        self.device_peak_bytes = max(
+            self.device_peak_bytes, int(d.get("device_peak_bytes", 0))
+        )
 
 
 @dataclass

@@ -13,6 +13,7 @@ flamegraph-folded encoding the reference emits, queryable by the shipped
 
 from __future__ import annotations
 
+import hashlib
 import os
 import sys
 import threading
@@ -49,16 +50,25 @@ class PerfProfilerConnector(SourceConnector):
         self._lock = threading.Lock()
 
     def sample(self) -> None:
-        """One sampling tick: fold every live thread's current stack."""
+        """One sampling tick: fold every live thread's current stack.
+        Stacks accumulate in a sweep-local dict and merge under ONE
+        lock acquisition — at 100Hz on a many-thread agent, a lock
+        round trip per stack was measurable churn against the drain
+        in ``transfer_data``."""
         me = threading.get_ident()
+        sweep: dict[str, int] = {}
         for tid, frame in sys._current_frames().items():
             if tid == me:
                 continue  # the collector thread observing itself is noise
             folded = _fold_stack(frame)
             if not folded:
                 continue
-            with self._lock:
-                self._counts[folded] = self._counts.get(folded, 0) + 1
+            sweep[folded] = sweep.get(folded, 0) + 1
+        if not sweep:
+            return
+        with self._lock:
+            for folded, n in sweep.items():
+                self._counts[folded] = self._counts.get(folded, 0) + n
 
     def transfer_data(self, ctx, data_tables) -> None:
         # The collector calls transfer_data on the sampling cadence; fold
@@ -74,8 +84,6 @@ class PerfProfilerConnector(SourceConnector):
             self._counts.clear()
         # Stable 63-bit content hash: bounded memory on long-lived PEMs
         # (no per-stack id table), stable across agents and restarts.
-        import hashlib
-
         ids = [
             int.from_bytes(
                 hashlib.blake2b(s.encode(), digest_size=8).digest(), "big"

@@ -270,6 +270,15 @@ QUERIES_RELATION = Relation(
         ("wire_bytes", DataType.INT64),
         ("retries", DataType.INT64),
         ("skipped_windows", DataType.INT64),
+        # Device-tier additions: observed high-water device bytes while
+        # the query ran (0 on stat-less backends), and the pxbound
+        # PREDICTED cost stamped at plan time (0 = unknown/sketch-less)
+        # — observed and predicted side by side is what lets
+        # px/bound_accuracy compute the calibration ratio per script
+        # hash, closing the arXiv:2102.02440 feedback loop.
+        ("device_peak_bytes", DataType.INT64),
+        ("predicted_bytes", DataType.INT64),
+        ("predicted_rows", DataType.INT64),
     ]
 )
 
@@ -283,6 +292,30 @@ SPANS_RELATION = Relation(
         ("name", DataType.STRING),
         ("agent_id", DataType.STRING),
         ("duration_ms", DataType.FLOAT64),
+    ]
+)
+
+# Cumulative-counter snapshots of the process program registry
+# (exec/programs.py): one row per tracked XLA program whose state
+# changed since the previous fold — the LATEST row per program_id is
+# its current state (compiles/hits are monotonic). flops/bytes come
+# from XLA cost_analysis(), the byte columns from memory_analysis();
+# all 0 when the backend reports nothing (timing-only records).
+PROGRAMS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("agent_id", DataType.STRING),
+        ("program_id", DataType.STRING),
+        ("kind", DataType.STRING),  # fragment_update|join_probe_sorted|...
+        ("label", DataType.STRING),  # op chain / join strategy summary
+        ("compiles", DataType.INT64),
+        ("hits", DataType.INT64),
+        ("compile_ms", DataType.FLOAT64),
+        ("flops", DataType.FLOAT64),
+        ("bytes_accessed", DataType.FLOAT64),
+        ("argument_bytes", DataType.INT64),
+        ("temp_bytes", DataType.INT64),
+        ("peak_bytes", DataType.INT64),
     ]
 )
 
@@ -306,6 +339,7 @@ TELEMETRY_SCHEMAS: dict[str, "Relation"] = {
     "__queries__": QUERIES_RELATION,
     "__spans__": SPANS_RELATION,
     "__agents__": AGENTS_RELATION,
+    "__programs__": PROGRAMS_RELATION,
 }
 
 # dns_table.h kDNSTable (subset).

@@ -22,6 +22,14 @@ class LoadReport:
     errors_by_type: dict = field(default_factory=dict)
     latencies_s: list = field(default_factory=list)
     wall_s: float = 0.0
+    # Per-run view through the SERVER's pixie_query_duration_seconds
+    # histogram (the tracer records every finished query there): the
+    # concurrency-bench axis — what the serving process itself measured
+    # between this run's start and end, vs the client-side latencies
+    # above which include bus round trips. None when the histogram is
+    # not in this process (remote broker) or saw no observations.
+    hist_quantiles_s: dict | None = None
+    hist_count: int = 0
 
     @property
     def failure_rate(self) -> float:
@@ -38,7 +46,7 @@ class LoadReport:
         return xs[min(i, len(xs) - 1)]
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "queries": self.queries,
             "errors": self.errors,
             "failure_rate": round(self.failure_rate, 4),
@@ -52,6 +60,11 @@ class LoadReport:
             "p99_ms": round(self.percentile(99) * 1e3, 2),
             "wall_s": round(self.wall_s, 2),
         }
+        if self.hist_quantiles_s is not None:
+            out["hist_count"] = self.hist_count
+            for q, v in sorted(self.hist_quantiles_s.items()):
+                out[f"hist_p{int(q * 100)}_ms"] = round(v * 1e3, 2)
+        return out
 
 
 def run_load(
@@ -95,6 +108,14 @@ def run_load(
                         report.errors_by_type.get(err, 0) + 1
                     )
 
+    # Snapshot the server-side latency histogram around the run so the
+    # report carries per-run quantiles from the SERVING process's own
+    # measurement (delta interpolation over cumulative buckets).
+    from .observability import default_registry, delta_quantiles
+
+    hist_before = default_registry.histogram_state(
+        "pixie_query_duration_seconds"
+    )
     t_start = time.perf_counter()
     threads = [threading.Thread(target=worker) for _ in range(workers)]
     for t in threads:
@@ -102,6 +123,19 @@ def run_load(
     for t in threads:
         t.join()
     report.wall_s = time.perf_counter() - t_start
+    hist_after = default_registry.histogram_state(
+        "pixie_query_duration_seconds"
+    )
+    if hist_before is None and hist_after is not None:
+        # The histogram registers lazily on the FIRST finished query —
+        # a missing before-snapshot in a fresh process means zero
+        # observations, not "no data": synthesize the empty state so
+        # the first run still reports its quantiles.
+        bounds, counts, _total, _sum = hist_after
+        hist_before = (bounds, [0] * len(counts), 0, 0.0)
+    report.hist_quantiles_s = delta_quantiles(hist_before, hist_after)
+    if hist_before is not None and hist_after is not None:
+        report.hist_count = hist_after[2] - hist_before[2]
     return report
 
 

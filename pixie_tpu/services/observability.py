@@ -150,12 +150,14 @@ class MetricsRegistry:
             lines.append(f'{series(m.name + "_sum")} {st["sum"]}')
             lines.append(f'{series(m.name + "_count")} {st["count"]}')
 
-    def quantiles(self, name: str, qs=(0.5, 0.95, 0.99), **labels):
-        """Approximate quantiles of a histogram metric from its buckets
-        (prometheus ``histogram_quantile`` linear interpolation; the
-        +Inf bucket clamps to the highest finite bound). Label kwargs
-        filter; observations are summed across all matching label sets.
-        Returns {q: value} or None when the metric is missing/empty."""
+    def histogram_state(self, name: str, **labels):
+        """Raw cumulative state of a histogram metric, summed across
+        matching label sets: ``(bounds, counts, count, sum)`` with
+        ``counts`` carrying the implicit +Inf slot last, or None when
+        the metric is missing. Callers that want PER-RUN quantiles
+        snapshot this before and after and interpolate over the delta
+        (``delta_quantiles``) — the histograms themselves are
+        process-lifetime cumulative."""
         want = set(labels.items())
         with self._lock:
             m = self._metrics.get(name)
@@ -163,19 +165,52 @@ class MetricsRegistry:
                 return None
             counts = [0] * (len(m.buckets) + 1)
             total = 0
+            sum_ = 0.0
             for lbls, st in m.values.items():
                 if want and not want <= set(lbls):
                     continue
                 for i, c in enumerate(st["counts"]):
                     counts[i] += c
                 total += st["count"]
-            bounds = m.buckets
+                sum_ += st["sum"]
+            return (m.buckets, counts, total, sum_)
+
+    def quantiles(self, name: str, qs=(0.5, 0.95, 0.99), **labels):
+        """Approximate quantiles of a histogram metric from its buckets
+        (prometheus ``histogram_quantile`` linear interpolation; the
+        +Inf bucket clamps to the highest finite bound). Label kwargs
+        filter; observations are summed across all matching label sets.
+        Returns {q: value} or None when the metric is missing/empty."""
+        st = self.histogram_state(name, **labels)
+        if st is None:
+            return None
+        bounds, counts, total, _sum = st
         if total == 0 or not bounds:
             # Zero observations (or a bucketless histogram, where every
             # observation lands in +Inf and no finite interpolation
             # exists): there IS no quantile — None, never a made-up 0.0.
             return None
         return _interpolate_quantiles(bounds, counts, total, qs)
+
+
+def delta_quantiles(before, after, qs=(0.5, 0.95, 0.99)):
+    """Quantiles of the observations recorded BETWEEN two
+    ``MetricsRegistry.histogram_state`` snapshots (bucket-count
+    subtraction + the shared interpolation). Returns {q: value} or None
+    when either snapshot is missing or nothing was observed in between
+    — the load tester's per-run latency report
+    (``services/load_tester.py``)."""
+    if before is None or after is None:
+        return None
+    bounds, counts_b, total_b, _ = before
+    _bounds_a, counts_a, total_a, _ = after
+    total = total_a - total_b
+    if total <= 0 or not bounds or len(counts_a) != len(counts_b):
+        return None
+    counts = [a - b for a, b in zip(counts_a, counts_b)]
+    if any(c < 0 for c in counts):
+        return None  # metric reset between snapshots
+    return _interpolate_quantiles(bounds, counts, total, qs)
 
 
 def _interpolate_quantiles(bounds, counts, total, qs) -> dict:
@@ -320,7 +355,7 @@ class ObservabilityServer:
 
     def __init__(self, registry: MetricsRegistry | None = None,
                  statusz_fn=None, health_fn=None, tracer=None,
-                 trace_view=None):
+                 trace_view=None, programs=None):
         self.registry = registry or default_registry
         self.statusz_fn = statusz_fn  # () -> dict
         self.health_fn = health_fn  # () -> (bool, str)
@@ -328,6 +363,10 @@ class ObservabilityServer:
         # services.telemetry.ClusterTraceView | None: wire one to serve
         # /debug/tracez — the cluster-stitched distributed-trace view.
         self.trace_view = trace_view
+        # exec.programs.ProgramRegistry | None: wire one to serve
+        # /debug/programz — the compiled-program registry (per-program
+        # compile wall-time, XLA cost/memory analysis, hit counts).
+        self.programs = programs
         self._httpd = None
 
     def handle(self, path: str) -> tuple[int, str, str]:
@@ -362,6 +401,13 @@ class ObservabilityServer:
                 },
                 indent=1,
                 default=str,
+            )
+            return (200, "application/json", body)
+        if path == "/debug/programz":
+            if self.programs is None:
+                return (404, "text/plain", "no program registry wired\n")
+            body = json.dumps(
+                self.programs.programz(), indent=1, default=str
             )
             return (200, "application/json", body)
         if path == "/debug/tracez" or path.startswith("/debug/tracez/"):

@@ -483,9 +483,15 @@ class QueryBroker:
         # Cluster-stitched distributed traces (/debug/tracez): the
         # broker's own dispatch spans + the span summaries agents
         # publish on telemetry.spans, grouped by trace id.
-        from .telemetry import ClusterTraceView
+        from .telemetry import ClusterTraceView, ObservedCostIndex
 
         self.trace_view = ClusterTraceView(bus, tracer=self.tracer)
+        # Observed per-script-hash cost history (the __queries__
+        # feedback loop at the broker): every finished distributed
+        # trace's merged usage is indexed so admission control can
+        # floor sketch predictions at observed reality
+        # (admission_observed_floor).
+        self.observed_costs = ObservedCostIndex(tracer=self.tracer)
         # Dynamic-tracing support (the MutationExecutor dependency,
         # mutation_executor.go:84); wire a TracepointRegistry to enable.
         self.tracepoints = None
@@ -904,11 +910,30 @@ class QueryBroker:
         # (predicted-vs-observed in `px debug queries`), attached to
         # every dispatch, and the admission decision's input.
         from ..analysis.bounds import merged_cost
+        from ..config import get_flag
 
         predicted = merged_cost(
             getattr(compiled.plan, "resource_report", None),
             getattr(dplan, "resource_report", None),
         )
+        # Calibration (admission_observed_floor): floor the plan-time
+        # prediction at this script hash's OBSERVED staged-byte history
+        # — a sketch-less unknown becomes the observed bytes (admitted
+        # against reality instead of accounted at zero), and a
+        # prediction below past observations is raised to them. The
+        # floored dict flows everywhere predicted_cost does: the trace
+        # (`px debug queries` pred + pred/obs columns), every dispatch,
+        # the client result, and the admission decision below. Gated on
+        # admission actually being ON: with no budget the floor would
+        # only replace the auditable pxbound prediction (and blank the
+        # pred/obs calibration ratio) without anyone consuming it.
+        if (
+            get_flag("admission_observed_floor")
+            and float(get_flag("admission_bytes_budget_mb")) > 0
+        ):
+            predicted = self.observed_costs.floor_predicted(
+                predicted, trace.script_hash
+            )
         trace.predicted = predicted
 
         # LaunchQuery: merge fragment first (so the router can accept

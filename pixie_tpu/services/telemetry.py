@@ -107,6 +107,11 @@ class TelemetryCollector:
         self._observed: "OrderedDict[str, dict]" = OrderedDict()
         self._installed = False
         self.fold_errors = 0  # visible health of the fold path itself
+        # __programs__ drain cursor into the process program registry
+        # (exec/programs.py): each collector folds the rows that changed
+        # since ITS last fold, so co-resident agents each get the full
+        # program history in their own table.
+        self._programs_seq = 0
 
     # -- lifecycle -----------------------------------------------------------
     def install(self) -> "TelemetryCollector":
@@ -138,6 +143,9 @@ class TelemetryCollector:
         end_ns = trace.end_unix_nano or time.time_ns()
         u = trace.usage
         agent = trace.agent_id or self.agent_id
+        pred = trace.predicted or {}
+        pred_bytes = pred.get("bytes_staged_hi")
+        pred_rows = pred.get("rows_in_hi")
         self.engine.append_data("__queries__", {
             "time_": [end_ns],
             "trace_id": [trace.trace_id],
@@ -158,8 +166,14 @@ class TelemetryCollector:
             "wire_bytes": [int(u.wire_bytes)],
             "retries": [int(u.retries)],
             "skipped_windows": [int(u.skipped_windows)],
+            "device_peak_bytes": [int(u.device_peak_bytes)],
+            # 0 = unknown (sketch-less plan / no bounds pass) — the
+            # calibration scripts filter on > 0.
+            "predicted_bytes": [int(pred_bytes or 0)],
+            "predicted_rows": [int(pred_rows or 0)],
         })
         self.engine.append_data("__spans__", _span_rows(trace, agent, end_ns))
+        self._fold_programs(end_ns)
         with self._lock:
             t = self._totals
             t["queries"] += 1
@@ -188,6 +202,46 @@ class TelemetryCollector:
                 "agent": agent,
                 "spans": _span_summaries(trace),
             })
+
+    def _fold_programs(self, end_ns: int) -> None:
+        """Drain program-registry updates into ``__programs__`` (one
+        cumulative-counter row per changed program; host-list arithmetic
+        only — same no-sync contract as the trace fold)."""
+        from ..exec.programs import default_program_registry
+
+        # The whole fetch-append-commit runs under the collector lock:
+        # listeners fire on whichever thread finished the trace (stream
+        # cursor threads overlap query threads), and the cursor must
+        # advance exactly once per successfully-appended row set — an
+        # early commit would permanently drop rows when append_data
+        # raises (ring budget/schema drift), an unlocked one could
+        # double-fold or regress. Row volume is bounded by the registry
+        # size, so the held append is small host-list work.
+        with self._lock:
+            cursor, rows = default_program_registry().rows(
+                self._programs_seq
+            )
+            if rows:
+                self._append_program_rows(end_ns, rows)
+            self._programs_seq = max(self._programs_seq, cursor)
+
+    def _append_program_rows(self, end_ns: int, rows: list) -> None:
+        n = len(rows)
+        self.engine.append_data("__programs__", {
+            "time_": [end_ns] * n,
+            "agent_id": [self.agent_id] * n,
+            "program_id": [r["program_id"] for r in rows],
+            "kind": [r["kind"] for r in rows],
+            "label": [r["label"] for r in rows],
+            "compiles": [int(r["compiles"]) for r in rows],
+            "hits": [int(r["hits"]) for r in rows],
+            "compile_ms": [float(r["compile_ms"]) for r in rows],
+            "flops": [float(r["flops"]) for r in rows],
+            "bytes_accessed": [float(r["bytes_accessed"]) for r in rows],
+            "argument_bytes": [int(r["argument_bytes"]) for r in rows],
+            "temp_bytes": [int(r["temp_bytes"]) for r in rows],
+            "peak_bytes": [int(r["peak_bytes"]) for r in rows],
+        })
 
     # -- planner feedback ----------------------------------------------------
     def _record_observed(self, trace) -> None:
@@ -219,6 +273,83 @@ class TelemetryCollector:
     def totals(self) -> dict:
         with self._lock:
             return dict(self._totals)
+
+
+class ObservedCostIndex:
+    """Observed per-script-hash resource history → admission floor.
+
+    The observed half of the arXiv:2102.02440 feedback loop at the
+    BROKER: a tracer listener retains, per script hash, the maximum
+    observed ``bytes_staged``/``rows_in`` of finished queries (the same
+    numbers the agents' collectors fold into ``__queries__`` — the
+    broker has no table store, so it indexes its own traces, whose
+    usage is the merged per-agent record). ``floor_predicted`` then
+    calibrates a pxbound prediction against that history the way
+    ``push_agg_through_join`` floors its capacity at observed
+    cardinality: an UNKNOWN (sketch-less) prediction with history
+    becomes the observed bytes instead of zero, and a known prediction
+    below observed reality is raised to it — so admission control
+    (`_Admission`) schedules on calibrated rather than worst-case (or
+    no) bounds. Bounded LRU; lock-guarded (tracer listeners run on
+    whatever thread finished the query).
+    """
+
+    def __init__(self, tracer=None, max_entries: int = MAX_OBSERVED):
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        if tracer is not None:
+            tracer.add_listener(self.on_trace)
+
+    def on_trace(self, trace) -> None:
+        if trace.status not in ("ok", "partial"):
+            return
+        u = trace.usage
+        with self._lock:
+            ent = self._entries.pop(trace.script_hash, None) or {
+                "bytes_staged": 0, "rows_in": 0, "runs": 0,
+            }
+            ent["bytes_staged"] = max(
+                ent["bytes_staged"], int(u.bytes_staged)
+            )
+            ent["rows_in"] = max(ent["rows_in"], int(u.rows_in))
+            ent["runs"] += 1
+            self._entries[trace.script_hash] = ent  # re-insert = recent
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def observed(self, script_hash: str) -> dict | None:
+        with self._lock:
+            ent = self._entries.get(script_hash)
+            return dict(ent) if ent is not None else None
+
+    def floor_predicted(self, predicted: dict | None,
+                        script_hash: str) -> dict | None:
+        """Calibrated prediction: ``predicted`` floored at the observed
+        history for ``script_hash`` (returns a NEW dict when flooring
+        applied; the input is never mutated — it may already be stamped
+        on a trace). No history, or history of zero staged bytes
+        (fully device-resident runs), leaves the prediction unchanged —
+        the floor can only ever RAISE the admission account."""
+        ent = self.observed(script_hash)
+        obs = int(ent["bytes_staged"]) if ent else 0
+        if obs <= 0:
+            return predicted
+        pred_bytes = (predicted or {}).get("bytes_staged_hi")
+        if pred_bytes is not None and int(pred_bytes) >= obs:
+            return predicted
+        out = dict(predicted or {})
+        out["bytes_staged_hi"] = obs
+        out["observed_floor"] = obs
+        out["origin"] = (
+            "observed" if pred_bytes is None
+            else f"{out.get('origin', 'sketch')}+observed"
+        )
+        # Observed history carries no safety multiplier; keep the key
+        # present so admission-reject diagnostics render "x1 safety"
+        # instead of "xNone" when the floor built the dict from scratch.
+        out.setdefault("safety", 1.0)
+        return out
 
 
 class ClusterTraceView:

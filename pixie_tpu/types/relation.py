@@ -14,7 +14,7 @@ from .dtypes import DataType
 class Relation:
     """Immutable ordered schema."""
 
-    __slots__ = ("_names", "_types")
+    __slots__ = ("_names", "_types", "_items")
 
     def __init__(self, columns: Mapping[str, DataType] | Iterable[tuple[str, DataType]] = ()):
         if isinstance(columns, Mapping):
@@ -26,6 +26,7 @@ class Relation:
             raise ValueError(f"duplicate column names in relation: {names}")
         self._names: tuple[str, ...] = tuple(names)
         self._types: dict[str, DataType] = {n: t for n, t in items}
+        self._items: tuple | None = None  # items_tuple cache
 
     @property
     def column_names(self) -> tuple[str, ...]:
@@ -45,6 +46,15 @@ class Relation:
 
     def items(self) -> Iterator[tuple[str, DataType]]:
         return iter((n, self._types[n]) for n in self._names)
+
+    def items_tuple(self) -> tuple:
+        """``tuple(self.items())``, computed once (the relation is
+        immutable). Memo keys build one of these per table per compile
+        (verify/bounds caches, fragment cache) — at ~20 canonical
+        tables the rebuild was the dominant cost of a memo HIT."""
+        if self._items is None:
+            self._items = tuple((n, self._types[n]) for n in self._names)
+        return self._items
 
     def select(self, names: Iterable[str]) -> "Relation":
         return Relation([(n, self.col_type(n)) for n in names])
@@ -77,7 +87,7 @@ class Relation:
         )
 
     def __hash__(self) -> int:
-        return hash(tuple(self.items()))
+        return hash(self.items_tuple())
 
     def __repr__(self) -> str:
         inner = ", ".join(f"{n}:{t.name}" for n, t in self.items())

@@ -44,13 +44,17 @@
 #                                      --tier1.
 #   ./run_tests.sh --obs               self-observability gate: the
 #                                      self-telemetry + trace-stitching
+#                                      + device-tier program-registry
 #                                      suites (tests/test_telemetry.py,
-#                                      tests/test_trace_stitching.py)
+#                                      tests/test_trace_stitching.py,
+#                                      tests/test_programs.py)
 #                                      plus plan-verifier compilation of
 #                                      the bundled self-monitoring PxL
 #                                      scripts against the telemetry
 #                                      table schemas (see
-#                                      pixie_tpu/analysis/obs_check.py).
+#                                      pixie_tpu/analysis/obs_check.py;
+#                                      now incl. px/program_cost and
+#                                      px/bound_accuracy).
 #                                      The script-compile half also runs
 #                                      inside --tier1.
 #   ./run_tests.sh --bench-join        quick join gate: a small
@@ -70,7 +74,7 @@ case "$1" in
       python -m pixie_tpu.analysis.obs_check || rc=$?
     env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python -m pytest -q tests/test_telemetry.py \
-      tests/test_trace_stitching.py "$@" || rc=$?
+      tests/test_trace_stitching.py tests/test_programs.py "$@" || rc=$?
     exit $rc
     ;;
   --bench-join)

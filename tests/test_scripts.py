@@ -387,6 +387,15 @@ def _seed_all_tables(eng, n=3000, seed=11):
         "wire_bytes": rng.integers(0, 10**6, m),
         "retries": rng.integers(0, 3, m),
         "skipped_windows": rng.integers(0, 8, m),
+        "device_peak_bytes": rng.integers(0, 10**9, m),
+        # Predicted >= observed (the soundness contract) so
+        # px/bound_accuracy's ratios look like real history; a few
+        # zero-predicted rows exercise its unknown-filter.
+        "predicted_bytes": rng.integers(0, 10**8, m) * 2,
+        "predicted_rows": [
+            (0, int(r) * 2)[i % 4 > 0]
+            for i, r in enumerate(rng.integers(1, 10**6, m))
+        ],
     })
     eng.append_data("__spans__", {
         "time_": tm,
@@ -407,6 +416,22 @@ def _seed_all_tables(eng, n=3000, seed=11):
         "bytes_staged_total": rng.integers(0, 10**9, m),
         "device_ms_total": rng.uniform(0, 1000, m),
         "wire_bytes_total": rng.integers(0, 10**7, m),
+    })
+    eng.append_data("__programs__", {
+        "time_": tm,
+        "agent_id": [f"pem-{i % 3}" for i in range(m)],
+        "program_id": [f"{i % 6:016x}" for i in range(m)],
+        "kind": [("fragment_update", "fragment_finalize",
+                  "join_probe_sorted")[i % 3] for i in range(m)],
+        "label": ["MapOp,AggOp"] * m,
+        "compiles": np.minimum(np.arange(m, dtype=np.int64) // 6 + 1, 3),
+        "hits": np.arange(m, dtype=np.int64),
+        "compile_ms": rng.uniform(1, 500, m),
+        "flops": rng.uniform(0, 10**9, m),
+        "bytes_accessed": rng.uniform(0, 10**9, m),
+        "argument_bytes": rng.integers(0, 10**8, m),
+        "temp_bytes": rng.integers(0, 10**7, m),
+        "peak_bytes": rng.integers(0, 10**8, m),
     })
 
 
