@@ -726,15 +726,30 @@ class MetricsNamingRule:
     name = "metrics-naming"
     description = (
         "metric names registered via .counter/.gauge/.histogram must "
-        "match ^pixie_[a-z0-9_]+$ and avoid histogram-series suffixes"
+        "match ^pixie_[a-z0-9_]+$ and avoid histogram-series suffixes; "
+        "bounded-cardinality label keys (tenant) must take values from "
+        "their registered-set resolver, never raw client strings"
     )
 
     _KINDS = frozenset({"counter", "gauge", "histogram"})
+    #: Label keys whose value space is an operator-registered set: a
+    #: raw client string here makes Prometheus series cardinality
+    #: unbounded (services/tenancy.py). The value at a ``.labels()``
+    #: call site must visibly come from the resolver — a direct
+    #: ``resolve_tenant(...)`` call, a name assigned from one in an
+    #: enclosing scope, or ``DEFAULT_TENANT``. Reviewed pass-through
+    #: sites (the resolver ran in the caller) live in the counted
+    #: baseline, so any NEW unreviewed site fails the --analyze gate.
+    _BOUNDED_LABELS = {"tenant": "resolve_tenant"}
 
     def prepare(self, ctxs, repo_root=None):
         pass
 
     def check(self, ctx: FileCtx):
+        yield from self._check_names(ctx)
+        yield from self._check_bounded_labels(ctx)
+
+    def _check_names(self, ctx: FileCtx):
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -773,6 +788,107 @@ class MetricsNamingRule:
                     ),
                     symbol=qn,
                 )
+
+    @classmethod
+    def _resolver_bindings(cls, scope_node, resolver: str) -> set:
+        """Names assigned from ``resolver(...)`` directly in ``scope``
+        — nested function/class scopes are NOT searched (they carry
+        their own bindings on the visit stack), so a pass-through
+        parameter that merely shares a name with some other function's
+        resolved variable does not silently pass."""
+        names: set = set()
+        stack = list(ast.iter_child_nodes(scope_node))
+        while stack:
+            n = stack.pop()
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # scope boundary
+            stack.extend(ast.iter_child_nodes(n))
+            # Any assignment form that binds a name to resolver(...):
+            # plain, annotated (`tenant: str = resolve_tenant(x)`), or
+            # walrus (`if (t := resolve_tenant(x)):`).
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                targets, call = n.targets, n.value
+            elif (isinstance(n, ast.AnnAssign)
+                    and isinstance(n.value, ast.Call)):
+                targets, call = [n.target], n.value
+            elif (isinstance(n, ast.NamedExpr)
+                    and isinstance(n.value, ast.Call)):
+                targets, call = [n.target], n.value
+            else:
+                continue
+            f = call.func
+            fname = (
+                f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else None
+            )
+            if fname != resolver:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        return names
+
+    def _value_is_resolved(self, value, resolver: str, bound: set) -> bool:
+        if isinstance(value, ast.Call):
+            f = value.func
+            fname = (
+                f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else None
+            )
+            return fname == resolver
+        if isinstance(value, ast.Name):
+            return value.id == "DEFAULT_TENANT" or value.id in bound
+        if isinstance(value, ast.Attribute):
+            return value.attr == "DEFAULT_TENANT"
+        return False
+
+    def _check_bounded_labels(self, ctx: FileCtx):
+        findings = []
+
+        # Resolver bindings are collected per scope and carried on a
+        # stack: module-level bindings apply everywhere, a function's
+        # bindings apply inside it (and its nested functions).
+        def visit_scoped(node, stack):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+            ):
+                resolved = set()
+                for r in {v for v in self._BOUNDED_LABELS.values()}:
+                    resolved |= self._resolver_bindings(node, r)
+                stack = stack + [resolved]
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels"):
+                bound = set().union(*stack) if stack else set()
+                for kw in node.keywords:
+                    resolver = self._BOUNDED_LABELS.get(kw.arg or "")
+                    if resolver is None:
+                        continue
+                    if not self._value_is_resolved(
+                        kw.value, resolver, bound
+                    ):
+                        findings.append(Finding(
+                            rule=self.name,
+                            path=ctx.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"label {kw.arg}=... must be derived "
+                                f"from {resolver}() (bounded metric-"
+                                "label cardinality: tenants come from "
+                                "the registered set, not raw client "
+                                "strings) — resolve in this scope, or "
+                                "baseline the reviewed pass-through "
+                                "site"
+                            ),
+                            symbol=ctx.qualname(node),
+                        ))
+            for child in ast.iter_child_nodes(node):
+                visit_scoped(child, stack)
+
+        visit_scoped(ctx.tree, [])
+        yield from findings
 
 
 ALL_RULES = (

@@ -45,9 +45,16 @@ class ScriptResults(dict):
         super().__init__(*args, **kw)
         self.partial = False
         self.missing_agents: list = []
+        self.missing_reasons: dict = {}
+        # Why a partial result stopped early: "deadline" | "cancelled"
+        # | None (agent loss keeps the per-agent missing_reasons only).
+        self.interrupted: str | None = None
         self.qid = None
         self.agent_stats: dict = {}
         self.predicted_cost: dict | None = None
+        # Resolved tenant the broker admitted the query under
+        # (services/tenancy.py; "shared" for unscoped callers).
+        self.tenant: str | None = None
 
 
 class TableRecordHandler:
@@ -93,6 +100,15 @@ class Client:
         return {"in_flight": res.get("in_flight", []),
                 "queries": res.get("queries", [])}
 
+    def cancel_query(self, qid: str) -> bool:
+        """Cooperatively cancel a running one-shot query (`px cancel`):
+        the broker stops its agents at their next window boundary and
+        the original caller receives a partial result with reason
+        "cancelled". Returns whether a registered query was found."""
+        return bool(
+            self._request("broker.cancel", {"qid": qid}).get("cancelled")
+        )
+
     def schemas(self) -> dict:
         return self._request("broker.schemas", {})["schemas"]
 
@@ -107,6 +123,9 @@ class Client:
         max_output_rows: int = 10_000,
         handler_factory: Optional[Callable[[str], TableRecordHandler]] = None,
         require_complete: Optional[bool] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
     ):
         """Run a script; returns a ``ScriptResults``
         ({table: pydict-of-columns} with partial/missing_agents/qid/
@@ -117,20 +136,36 @@ class Client:
         model); the return value is unchanged. ``require_complete=True``
         fails instead of returning partial results when a data agent is
         lost mid-query.
+
+        Multi-tenant scheduling: ``tenant`` scopes admission to that
+        registered tenant's budget share (unknown names fold into the
+        shared tenant), ``priority`` (higher first) and ``deadline_ms``
+        order the broker's admission queue; a query past its deadline
+        is shed while queued or returns ``partial`` with
+        ``missing_reasons`` values ``"deadline"`` once dispatched.
         """
         req = {"query": pxl, "timeout_s": timeout_s,
                "max_output_rows": max_output_rows}
         if require_complete is not None:
             req["require_complete"] = bool(require_complete)
+        if tenant is not None:
+            req["tenant"] = str(tenant)
+        if priority is not None:
+            req["priority"] = int(priority)
+        if deadline_ms is not None:
+            req["deadline_ms"] = float(deadline_ms)
         res = self._request(
             "broker.execute", req, timeout_s=timeout_s + 5,
         )
         out = ScriptResults()
         out.partial = bool(res.get("partial"))
         out.missing_agents = list(res.get("missing_agents", []))
+        out.missing_reasons = dict(res.get("missing_reasons", {}))
+        out.interrupted = res.get("interrupted")
         out.qid = res.get("qid")
         out.agent_stats = dict(res.get("agent_stats", {}))
         out.predicted_cost = res.get("predicted_cost")
+        out.tenant = res.get("tenant")
         for name, hb in sorted(res["tables"].items()):
             d = hb.to_pydict()
             out[name] = d

@@ -13,6 +13,7 @@ Usage:
   python -m pixie_tpu.cli explain px/http_stats
   python -m pixie_tpu.cli tables|agents --broker HOST:PORT
   python -m pixie_tpu.cli debug queries --broker HOST:PORT [-v]
+  python -m pixie_tpu.cli cancel QID --broker HOST:PORT
   python -m pixie_tpu.cli docs
 """
 
@@ -94,6 +95,12 @@ def cmd_run(args) -> int:
                "max_output_rows": args.max_rows}
         if args.require_complete:
             req["require_complete"] = True
+        if args.tenant:
+            req["tenant"] = args.tenant
+        if args.priority:
+            req["priority"] = args.priority
+        if args.deadline_ms:
+            req["deadline_ms"] = args.deadline_ms
         with _client(args.broker) as client:
             try:
                 res = client._request(
@@ -105,12 +112,25 @@ def cmd_run(args) -> int:
         for name, hb in sorted(res["tables"].items()):
             _print_batch(name, hb, args.output)
         if res.get("partial"):
-            missing = ", ".join(res.get("missing_agents", []))
-            print(
-                f"warning: PARTIAL results — data agent(s) lost "
-                f"mid-query: {missing}",
-                file=sys.stderr,
-            )
+            reasons = res.get("missing_reasons", {})
+            if set(reasons.values()) <= {"deadline", "cancelled"} and reasons:
+                why = "/".join(sorted(set(reasons.values())))
+                # Keys are agent ids except the broker's "_query"
+                # sentinel (query stopped with no agent outstanding).
+                agents = sorted(k for k in reasons if not k.startswith("_"))
+                suffix = f" ({', '.join(agents)})" if agents else ""
+                print(
+                    f"warning: PARTIAL results — query {why} before "
+                    f"completion{suffix}",
+                    file=sys.stderr,
+                )
+            else:
+                missing = ", ".join(res.get("missing_agents", []))
+                print(
+                    f"warning: PARTIAL results — data agent(s) lost "
+                    f"mid-query: {missing}",
+                    file=sys.stderr,
+                )
         if args.output == "table":
             stats = res.get("agent_stats", {})
             if stats:
@@ -273,9 +293,9 @@ def cmd_debug(args) -> int:
     if not rows and not res["in_flight"]:
         print("no recent queries")
         return 0
-    hdr = (f"{'qid':12s} {'status':8s} {'ms':>9s} {'rows':>9s} "
-           f"{'staged':>9s} {'pred':>9s} {'pred/obs':>8s} {'device':>9s} "
-           f"{'wire':>9s} agents")
+    hdr = (f"{'qid':12s} {'tenant':8s} {'status':8s} {'ms':>9s} "
+           f"{'rows':>9s} {'staged':>9s} {'pred':>9s} {'pred/obs':>8s} "
+           f"{'device':>9s} {'wire':>9s} agents")
     print(hdr)
     for row in res["in_flight"] + rows:
         u = row.get("usage", {})
@@ -301,6 +321,7 @@ def cmd_debug(args) -> int:
         )
         print(
             f"{row.get('qid') or row['id'][:12]:12s} "
+            f"{row.get('tenant', '-') or '-':8s} "
             f"{row['status']:8s} "
             f"{row['duration_ms']:>9.1f} "
             f"{row.get('rows_out', u.get('rows_out', 0)):>9d} "
@@ -321,6 +342,18 @@ def cmd_debug(args) -> int:
                     f"windows={au.get('windows', 0)}"
                 )
     return 0
+
+
+def cmd_cancel(args) -> int:
+    """`px cancel <qid>`: cooperative cancellation — the broker stops
+    the query's agents at their next window boundary and the original
+    caller gets a partial result (reason "cancelled")."""
+    with _client(args.broker) as client:
+        if client.cancel_query(args.qid):
+            print(f"query {args.qid} cancelled")
+            return 0
+    print(f"no running query {args.qid!r}", file=sys.stderr)
+    return 1
 
 
 def cmd_docs(args) -> int:
@@ -361,9 +394,26 @@ def main(argv=None) -> int:
     run.add_argument("--require-complete", action="store_true",
                      help="fail instead of returning partial results "
                           "when a data agent is lost mid-query")
+    run.add_argument("--tenant",
+                     help="tenant to admit the query under (registered "
+                          "via admission_tenant_weights; unknown names "
+                          "run as the shared tenant)")
+    run.add_argument("--priority", type=int, default=0,
+                     help="admission-queue priority (higher first)")
+    run.add_argument("--deadline-ms", type=float, default=0.0,
+                     help="query deadline: shed while queued / abort "
+                          "cooperatively once dispatched, returning "
+                          "partial results")
     run.add_argument("-o", "--output", choices=("table", "json", "csv"),
                      default="table")
     run.set_defaults(fn=cmd_run)
+
+    cn = sub.add_parser(
+        "cancel", help="cooperatively cancel a running query by qid"
+    )
+    cn.add_argument("qid")
+    cn.add_argument("--broker", required=True)
+    cn.set_defaults(fn=cmd_cancel)
 
     lv = sub.add_parser("live", help="subscribe to a live (streaming) view")
     lv.add_argument("script", help="library script name or .pxl path")

@@ -330,10 +330,47 @@ define_flag(
     "at zero.",
 )
 define_flag(
+    "broker_execute_threads", 16,
+    "PER-TENANT worker-thread cap for the served broker.execute topic "
+    "(serve()). Each in-flight remote request holds one daemon worker "
+    "for its whole execution (including admission queueing); a "
+    "tenant's requests past its cap wait in that tenant's own FIFO "
+    "backlog, so one tenant's parked requests can never starve "
+    "another tenant's at the front door, and total threads stay "
+    "bounded by cap x the registered tenant set even with admission "
+    "control disabled.",
+)
+define_flag(
     "admission_queue_s", 5.0,
     "How long an admission-controlled query may wait for in-flight "
     "predicted bytes to drain before it is rejected (queue timeout). "
     "0 rejects immediately when the budget is full.",
+)
+define_flag(
+    "admission_tenant_weights", "",
+    "Registered tenant set with fair-share weights for broker "
+    "admission control, as comma-separated name:weight entries "
+    "(e.g. 'dash:4,batch:1'). Each tenant's slice of "
+    "admission_bytes_budget_mb is budget * weight / sum(weights); the "
+    "default tenant 'shared' is always registered (weight 1 unless "
+    "listed) and absorbs queries with no/unknown tenant. Empty = "
+    "single shared tenant (the whole budget, pre-tenancy behavior). "
+    "Tenant names label metrics, so they MUST come from this set — "
+    "services/tenancy.py resolve_tenant() folds anything else into "
+    "'shared' (bounded label cardinality).",
+)
+define_flag(
+    "admission_priority_holddown_ms", 0.0,
+    "Non-work-conserving grace window for strict-priority admission: "
+    "after a priority-p query releases, strictly-lower-priority "
+    "waiters stay queued for this many milliseconds. Engines execute "
+    "one query at a time (Engine._exec_guard) and an admitted query "
+    "cannot be preempted, so without the hold-down a back-to-back "
+    "high-priority stream is interleaved with unpreemptible "
+    "low-priority work admitted in its ~ms inter-arrival gaps — "
+    "head-of-line blocking that moves the high class's p99 however "
+    "fair the byte shares are. 0 (default) disables: admission is "
+    "work-conserving and purely share/priority ordered.",
 )
 
 # -- device-tier observability (exec/programs.py) ----------------------------

@@ -315,20 +315,26 @@ class Engine:
         column HLL NDV estimates. A few microseconds per column — the
         sketches were maintained at append time."""
         out: dict = {}
-        for n, t in self.tables.items():
+        # Snapshots: the agent's heartbeat thread builds this while a
+        # query/ingest thread appends tables and sketched columns —
+        # iterating the live dicts intermittently dies with "dictionary
+        # changed size during iteration" (observed as a heartbeat-
+        # thread flake that silently killed the heartbeat loop).
+        for n, t in list(self.tables.items()):
             sk = getattr(t, "sketches", None)
             if not sk:
                 continue
+            cols = list(sk.cols.items())
             out[n] = {
                 "rows": sk.rows,
                 "ndv": {
-                    c: s.ndv for c, s in sk.cols.items() if s.rows
+                    c: s.ndv for c, s in cols if s.rows
                 },
                 # Global zone maps per sketched column — pxbound's join
                 # overlap term (analysis/bounds.py) reads them.
                 "zones": {
                     c: (s.lo, s.hi)
-                    for c, s in sk.cols.items()
+                    for c, s in cols
                     if s.rows and s.lo is not None
                 },
             }

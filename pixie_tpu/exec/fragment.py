@@ -155,8 +155,15 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry,
     every ``execute_query`` (~10s/query over the TPU tunnel, BENCH r02's
     real bottleneck — Carnot similarly reuses compiled plan state,
     ``src/carnot/carnot.cc:122``). Keyed on the op chain, input schema,
-    the identity+size of every string dictionary (growth re-encodes
-    string literals), and the registry identity. Unhashable chains (not
+    the CONTENT identity of every string dictionary
+    (``StringDictionary.content_key``: an append-only dictionary's
+    compile-time behavior — literal ``lookup`` ids, out_meta decode —
+    is a pure function of its ordered contents, and growth re-encodes
+    string literals under a new key), and the registry identity.
+    Content- rather than id()-keyed because the merge tier's bridge
+    payloads decode FRESH dictionary objects from the wire on every
+    distributed query: identity keying missed the cache (and recompiled
+    the merge/limit XLA programs) once per run. Unhashable chains (not
     produced by the planner today) fall back to uncached compilation.
     """
     from ..config import get_flag
@@ -165,9 +172,9 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry,
         key = (
             _struct_key(tuple(ops)),
             input_relation.items_tuple(),
-            tuple(
-                sorted((n, id(d), len(d)) for n, d in input_dicts.items())
-            ),
+            tuple(sorted(
+                (n, d.content_key()) for n, d in input_dicts.items()
+            )),
             id(registry),
             get_flag("groupby_impl"),
             get_flag("pallas_dense_fold"),
@@ -191,9 +198,11 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry,
         _track_fragment_programs(frag, ops, key, input_dicts, registry)
         if len(_FRAGMENT_CACHE) >= _FRAGMENT_CACHE_MAX:
             _FRAGMENT_CACHE.pop(next(iter(_FRAGMENT_CACHE)))
-        # The entry pins the id()-keyed objects (dicts, registry): a freed
-        # object's address can be recycled, which would otherwise let a
-        # different same-shaped dictionary hit this entry.
+        # The entry pins the registry (still id()-keyed: a freed
+        # registry's address could be recycled into a false hit) and
+        # the compile-time dictionaries (the fragment's out_meta
+        # resolves ids through them; content-equal callers may outlive
+        # their own copies).
         _FRAGMENT_CACHE[key] = (frag, tuple(input_dicts.values()), registry)
     else:
         frag = hit[0]

@@ -90,6 +90,39 @@ PXLINT_HOT_REGIONS = (
 )
 
 
+class DeadlineEvent:
+    """Event-like cancel handle that also trips at an absolute
+    wall-clock deadline (``time.time()`` seconds).
+
+    The cooperative-cancellation seam polls ``cancel.is_set()`` at
+    every window boundary (``Engine._check_cancel`` and
+    :meth:`WindowPipeline._check_cancel`, which also polls every
+    ``_POLL_S`` while blocked), so wrapping a query's cancel event in
+    one of these makes an expired deadline abort the query between
+    windows — dead work is dropped within one window boundary instead
+    of computed to completion. Wall-clock (not monotonic) because the
+    deadline is stamped by the BROKER and rides the dispatch message
+    across processes; agents and broker are assumed loosely
+    clock-synced (the same assumption the tracker's heartbeat expiry
+    already makes).
+    """
+
+    __slots__ = ("_event", "deadline_unix_s")
+
+    def __init__(self, event, deadline_unix_s: float):
+        self._event = event
+        self.deadline_unix_s = float(deadline_unix_s)
+
+    def set(self) -> None:
+        self._event.set()
+
+    def is_set(self) -> bool:
+        return self._event.is_set() or self.deadline_exceeded()
+
+    def deadline_exceeded(self) -> bool:
+        return time.time() >= self.deadline_unix_s
+
+
 class WindowPipeline:
     """Bounded-depth prefetch over a staged-window generator.
 

@@ -299,6 +299,12 @@ class QueryTrace:
         self.kind = kind  # "query" | "stream" | "fragment" | "merge" | ...
         self.qid = ""  # distributed query id (agents/broker stamp it)
         self.agent_id = ""  # executing agent (agents stamp it)
+        # Tenant the query was admitted under (services/tenancy.py):
+        # the broker stamps its resolved tenant, agents copy it from
+        # the dispatch envelope so per-agent __queries__ rows carry the
+        # same attribution. "" = not a tenant-scoped query (bare local
+        # engines).
+        self.tenant = ""
         self.status = "running"
         self.error = ""
         self.start_unix_nano = time.time_ns()
@@ -451,6 +457,8 @@ class QueryTrace:
             d["qid"] = self.qid
         if self.agent_id:
             d["agent_id"] = self.agent_id
+        if self.tenant:
+            d["tenant"] = self.tenant
         if self.agent_usage:
             d["agent_usage"] = dict(self.agent_usage)
         if self.predicted:

@@ -254,6 +254,10 @@ QUERIES_RELATION = Relation(
         ("time_", DataType.TIME64NS),
         ("trace_id", DataType.STRING),
         ("qid", DataType.STRING),  # distributed query id ("" = local)
+        # Admitting tenant (services/tenancy.py registered set; "" =
+        # not a tenant-scoped query) — per-tenant cost/latency rollups
+        # run over this column.
+        ("tenant", DataType.STRING),
         ("agent_id", DataType.STRING),
         ("kind", DataType.STRING),  # query|stream|fragment|merge|distributed
         ("script_hash", DataType.STRING),

@@ -18,6 +18,8 @@ import traceback
 
 from ..exec import tracectx
 from ..exec.engine import Engine, QueryError
+from ..exec.pipeline import DeadlineEvent
+from ..exec.stream import QueryCancelled
 from ..exec.trace import plan_script
 from .msgbus import MessageBus
 from .tracker import TOPIC_HEARTBEAT, TOPIC_REGISTER
@@ -183,9 +185,12 @@ class Agent:
             )
 
     def _schemas(self) -> dict:
+        # Snapshot: heartbeat thread vs concurrent table creation
+        # (same race as _compile_table_stats — a died heartbeat loop
+        # silently drops this agent from the tracker at expiry).
         return {
             name: t.relation
-            for name, t in self.engine.tables.items()
+            for name, t in list(self.engine.tables.items())
             if t is not None and len(t.relation)
         }
 
@@ -321,7 +326,21 @@ class Agent:
         )
         tr.qid = qid
         tr.agent_id = self.agent_id
+        # Tenant attribution rides the dispatch envelope: this agent's
+        # __queries__/__spans__ rows carry the admitting tenant.
+        tr.tenant = str(msg.get("tenant") or "")
         return tr
+
+    @staticmethod
+    def _cancel_handle(msg, ev):
+        """The fragment's cooperative-cancellation handle: the broker's
+        absolute deadline (when the dispatch carries one) wraps the
+        cancel event, so the window pipeline aborts past-deadline work
+        at its next boundary even before any query.cancel arrives."""
+        deadline = msg.get("deadline_unix_s")
+        if deadline is None:
+            return ev
+        return DeadlineEvent(ev, float(deadline))
 
     def _on_execute(self, msg):
         """Run a data fragment; ship bridge payloads to the merge agent."""
@@ -341,8 +360,19 @@ class Agent:
         trace = self._begin_fragment_trace(msg, qid, plan, "fragment")
         try:
             t0 = time.perf_counter()
-            outputs = self.engine.execute_plan(plan, cancel=ev, trace=trace)
+            outputs = self.engine.execute_plan(
+                plan, cancel=self._cancel_handle(msg, ev), trace=trace
+            )
             elapsed = time.perf_counter() - t0
+        except QueryCancelled:
+            # Deadline lapsed (or a cancel raced its _cancelled mark):
+            # the abort is the INTENDED outcome — dead work dropped at
+            # a window boundary. The broker's deadline/cancel exit
+            # accounts for this agent (missing_reasons), so publishing
+            # an error here would wrongly fail the whole query.
+            with self._lock:
+                self._running.pop(qid, None)
+            return
         except Exception as e:
             with self._lock:
                 self._running.pop(qid, None)
@@ -397,7 +427,8 @@ class Agent:
         # ambient context is some data agent's fragment), so the
         # install-time context is stored, not inherited.
         return {"plan": None, "expect": None, "got": {}, "got_keys": set(),
-                "keep": None, "trace_ctx": None}
+                "keep": None, "trace_ctx": None, "deadline": None,
+                "tenant": ""}
 
     def _on_merge(self, msg):
         """Install a merge fragment; runs once all bridge payloads land."""
@@ -423,6 +454,8 @@ class Agent:
                 )
             pm["plan"] = msg["plan"]
             pm["trace_ctx"] = tracectx.extract(msg) or tracectx.current()
+            pm["deadline"] = msg.get("deadline_unix_s")
+            pm["tenant"] = str(msg.get("tenant") or "")
             pm["expect"] = {
                 (bid, aid)
                 for bid in msg["bridge_ids"]
@@ -519,18 +552,41 @@ class Agent:
         )
         trace.qid = qid
         trace.agent_id = self.agent_id
+        trace.tenant = pm["tenant"]
+        # The merge respects the query deadline AND query.cancel:
+        # folding states for a client the broker already answered is
+        # dead work — the same window-boundary abort as data fragments.
+        # The raw event registers under _running so _on_cancel finds it
+        # (safe from colliding with this agent's own data fragment: the
+        # merge only starts once every expected bridge payload landed,
+        # i.e. after any local fragment finished and popped its entry).
+        ev = threading.Event()
+        with self._lock:
+            if qid in self._cancelled:
+                return
+            self._running[qid] = ev
+        cancel = (
+            DeadlineEvent(ev, float(pm["deadline"]))
+            if pm["deadline"] is not None else ev
+        )
         try:
             t0 = time.perf_counter()
             outputs = self.engine.execute_plan(
-                pm["plan"], bridge_inputs=bridge_inputs, trace=trace
+                pm["plan"], bridge_inputs=bridge_inputs, trace=trace,
+                cancel=cancel,
             )
             elapsed = time.perf_counter() - t0
+        except QueryCancelled:
+            return  # cancelled/past-deadline: the broker already degraded
         except Exception as e:
             self.bus.publish(
                 f"query.{qid}.results",
                 {"error": f"{self.agent_id}: {e}", "trace": traceback.format_exc()},
             )
             return
+        finally:
+            with self._lock:
+                self._running.pop(qid, None)
         for name, batch in outputs.items():
             self.bus.publish(
                 f"query.{qid}.results",

@@ -19,6 +19,11 @@ class LoadReport:
     queries: int = 0
     errors: int = 0
     partials: int = 0  # queries that returned with partial=True
+    # Queries shed at admission before any dispatch (the structured
+    # "admission-shed" refusal: deadline lapsed while queued). Counted
+    # separately from errors_by_type so tenancy gates can assert "the
+    # victim tenant shed ZERO queries" directly.
+    sheds: int = 0
     errors_by_type: dict = field(default_factory=dict)
     latencies_s: list = field(default_factory=list)
     wall_s: float = 0.0
@@ -52,6 +57,7 @@ class LoadReport:
             "failure_rate": round(self.failure_rate, 4),
             "errors_by_type": dict(self.errors_by_type),
             "partials": self.partials,
+            "sheds": self.sheds,
             "qps": (
                 round(self.queries / self.wall_s, 2) if self.wall_s else 0.0
             ),
@@ -67,83 +73,170 @@ class LoadReport:
         return out
 
 
+@dataclass
+class TenantStream:
+    """One tenant's offered load in a mixed-tenant run: ``workers``
+    concurrent clients each firing ``per_worker`` queries of ``query``
+    under this tenant/priority/deadline."""
+
+    tenant: str
+    query: str
+    workers: int = 1
+    per_worker: int = 10
+    priority: int = 0
+    deadline_ms: float | None = None
+    timeout_s: float = 30.0
+
+
+def _worker_loop(execute, query: str, per_worker: int, timeout_s: float,
+                 report: LoadReport, lock: threading.Lock,
+                 exec_kw: dict | None = None) -> None:
+    """Shared per-worker query loop for the plain and mixed modes."""
+    kw = exec_kw or {}
+    for _ in range(per_worker):
+        t0 = time.perf_counter()
+        err = None
+        shed = False
+        partial = False
+        try:
+            res = execute(query, timeout_s, **kw)
+            partial = bool(isinstance(res, dict) and res.get("partial"))
+        except Exception as e:
+            err = type(e).__name__
+            # The admission scheduler's structured deadline shed (never
+            # dispatched) is a distinct outcome from a failure.
+            shed = "admission-shed" in str(e)
+        dt = time.perf_counter() - t0
+        with lock:
+            report.queries += 1
+            if err is None:
+                report.latencies_s.append(dt)
+                if partial:
+                    report.partials += 1
+            else:
+                report.errors += 1
+                if shed:
+                    report.sheds += 1
+                report.errors_by_type[err] = (
+                    report.errors_by_type.get(err, 0) + 1
+                )
+
+
+def _hist_snapshot():
+    from .observability import default_registry
+
+    return default_registry.histogram_state("pixie_query_duration_seconds")
+
+
+def _attach_hist_delta(report: LoadReport, before, after) -> None:
+    from .observability import delta_quantiles
+
+    if before is None and after is not None:
+        # The histogram registers lazily on the FIRST finished query —
+        # a missing before-snapshot in a fresh process means zero
+        # observations, not "no data": synthesize the empty state so
+        # the first run still reports its quantiles.
+        bounds, counts, _total, _sum = after
+        before = (bounds, [0] * len(counts), 0, 0.0)
+    report.hist_quantiles_s = delta_quantiles(before, after)
+    if before is not None and after is not None:
+        report.hist_count = after[2] - before[2]
+
+
 def run_load(
     execute,
     query: str,
     workers: int = 4,
     per_worker: int = 10,
     timeout_s: float = 30.0,
+    tenant: str | None = None,
+    priority: int = 0,
+    deadline_ms: float | None = None,
 ) -> LoadReport:
     """Fire ``workers * per_worker`` queries through ``execute``.
 
-    ``execute(query, timeout_s)`` is any callable that raises on failure —
-    ``broker_executor`` / ``remote_executor`` below adapt the two broker
-    surfaces to it.
+    ``execute(query, timeout_s, **tenancy_kw)`` is any callable that
+    raises on failure — ``broker_executor`` / ``remote_executor`` below
+    adapt the two broker surfaces to it. The optional tenancy kwargs
+    scope every query of the run to one tenant/priority/deadline.
     """
     report = LoadReport()
     lock = threading.Lock()
-
-    def worker():
-        for _ in range(per_worker):
-            t0 = time.perf_counter()
-            err = None
-            partial = False
-            try:
-                res = execute(query, timeout_s)
-                partial = bool(
-                    isinstance(res, dict) and res.get("partial")
-                )
-            except Exception as e:
-                err = type(e).__name__
-            dt = time.perf_counter() - t0
-            with lock:
-                report.queries += 1
-                if err is None:
-                    report.latencies_s.append(dt)
-                    if partial:
-                        report.partials += 1
-                else:
-                    report.errors += 1
-                    report.errors_by_type[err] = (
-                        report.errors_by_type.get(err, 0) + 1
-                    )
+    kw: dict = {}
+    if tenant is not None:
+        kw["tenant"] = tenant
+    if priority:
+        kw["priority"] = priority
+    if deadline_ms is not None:
+        kw["deadline_ms"] = deadline_ms
 
     # Snapshot the server-side latency histogram around the run so the
     # report carries per-run quantiles from the SERVING process's own
     # measurement (delta interpolation over cumulative buckets).
-    from .observability import default_registry, delta_quantiles
-
-    hist_before = default_registry.histogram_state(
-        "pixie_query_duration_seconds"
-    )
+    hist_before = _hist_snapshot()
     t_start = time.perf_counter()
-    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    threads = [
+        threading.Thread(target=_worker_loop, args=(
+            execute, query, per_worker, timeout_s, report, lock, kw,
+        ))
+        for _ in range(workers)
+    ]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     report.wall_s = time.perf_counter() - t_start
-    hist_after = default_registry.histogram_state(
-        "pixie_query_duration_seconds"
-    )
-    if hist_before is None and hist_after is not None:
-        # The histogram registers lazily on the FIRST finished query —
-        # a missing before-snapshot in a fresh process means zero
-        # observations, not "no data": synthesize the empty state so
-        # the first run still reports its quantiles.
-        bounds, counts, _total, _sum = hist_after
-        hist_before = (bounds, [0] * len(counts), 0, 0.0)
-    report.hist_quantiles_s = delta_quantiles(hist_before, hist_after)
-    if hist_before is not None and hist_after is not None:
-        report.hist_count = hist_after[2] - hist_before[2]
+    _attach_hist_delta(report, hist_before, _hist_snapshot())
     return report
+
+
+def run_mixed_load(execute, streams) -> dict:
+    """Mixed-tenant mode: run every :class:`TenantStream` CONCURRENTLY
+    against one broker and report a ``LoadReport`` per stream — the
+    measurement seam for the p99-isolation contract (a saturating noisy
+    tenant queues behind its own backlog; the victim tenant's latency
+    distribution and shed count must hold at its solo baseline;
+    ``run_tests.sh --tenancy``).
+    """
+    # One report PER STREAM: two streams may share a tenant (same
+    # tenant at different priorities/deadlines) and their latency
+    # distributions must not silently merge — duplicates get a
+    # positional suffix ("dash", "dash#1", ...).
+    keys, seen = [], {}
+    for s in streams:
+        n = seen.get(s.tenant, 0)
+        seen[s.tenant] = n + 1
+        keys.append(s.tenant if n == 0 else f"{s.tenant}#{n}")
+    reports = {k: LoadReport() for k in keys}
+    locks = {k: threading.Lock() for k in keys}
+    threads = []
+    for key, s in zip(keys, streams):
+        kw = {"tenant": s.tenant, "priority": s.priority,
+              "deadline_ms": s.deadline_ms}
+        threads.extend(
+            threading.Thread(target=_worker_loop, args=(
+                execute, s.query, s.per_worker, s.timeout_s,
+                reports[key], locks[key], kw,
+            ))
+            for _ in range(s.workers)
+        )
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    for r in reports.values():
+        r.wall_s = wall
+    return reports
 
 
 def broker_executor(broker):
     """Adapter for an in-process QueryBroker."""
 
-    def execute(query, timeout_s):
-        return broker.execute_script(query, timeout_s=timeout_s)
+    def execute(query, timeout_s, **kw):
+        kw = {k: v for k, v in kw.items() if v is not None}
+        return broker.execute_script(query, timeout_s=timeout_s, **kw)
 
     return execute
 
@@ -154,11 +247,11 @@ def remote_executor(host: str, port: int):
 
     bus = RemoteBus(host, port)
 
-    def execute(query, timeout_s):
+    def execute(query, timeout_s, **kw):
+        req = {"query": query, "timeout_s": timeout_s}
+        req.update((k, v) for k, v in kw.items() if v is not None)
         res = bus.request(
-            "broker.execute",
-            {"query": query, "timeout_s": timeout_s},
-            timeout_s=timeout_s + 5,
+            "broker.execute", req, timeout_s=timeout_s + 5,
         )
         if not res.get("ok"):
             raise RuntimeError(res.get("error", "unknown broker error"))

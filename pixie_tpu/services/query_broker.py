@@ -52,36 +52,191 @@ class AdmissionError(QueryError):
 
 
 class _Admission:
-    """Predicted-cost admission control (``admission_bytes_budget_mb``).
+    """Tenant-aware predicted-cost admission control
+    (``admission_bytes_budget_mb`` × ``admission_tenant_weights``).
 
-    Tracks the SUM of in-flight queries' predicted staged bytes
-    (pxbound ``predicted_cost.bytes_staged_hi``). ``admit`` returns
-    immediately when the budget is off or the prediction unknown
-    (sketch-less plans are admitted, accounted at zero — conservative
-    bounds must never turn into false rejections); rejects a query
-    predicted over the WHOLE budget; and queues a query that merely
-    doesn't fit NOW until in-flight predictions drain
-    (``admission_queue_s``), then rejects. ``release`` is idempotent.
+    Per-tenant accounting over the SUM of in-flight queries' predicted
+    staged bytes (pxbound ``predicted_cost.bytes_staged_hi``): each
+    registered tenant owns a weighted slice of the budget
+    (``services/tenancy.py tenant_shares``), so an over-share tenant's
+    burst queues behind *its own* backlog while an under-share tenant
+    admits without ever consulting the noisy one's state. ``admit``
+    returns immediately when the budget is off or the prediction
+    unknown (sketch-less plans are admitted, accounted at zero —
+    conservative bounds must never turn into false rejections);
+    rejects a query predicted over its tenant's WHOLE share; and
+    queues one that merely doesn't fit NOW.
+
+    The wait queue is ordered by (priority desc, earliest deadline
+    first, arrival) — not arrival alone — and every ``release``
+    re-runs the scheduler under the lock, waking admitted waiters
+    through their own events immediately (release-to-admit latency is
+    event-driven, not a poll slice). Priority classes are STRICT: a
+    query only admits when no strictly-higher-priority query is in
+    flight or waiting — on a saturated engine, work-conserving
+    admission would keep a best-effort tenant's compute running
+    back-to-back under an interactive tenant's queries and move their
+    p99 however fair the byte shares are; yielding the whole admission
+    slot is what actually protects the higher class's latency.
+    (Default priority is 0 for everyone, so the discipline is pure
+    weighted-fair until an operator assigns priorities; a starved
+    low-priority query still resolves via its queue timeout or
+    deadline.) ``admission_priority_holddown_ms`` extends the strict
+    rule across a released query's inter-arrival gap: engines run one
+    query at a time (``Engine._exec_guard``) and an admitted query
+    cannot be preempted, so a lower-priority query admitted in the
+    ~ms gap between two high-priority queries head-of-line blocks the
+    next one at the agent — the hold-down keeps lower classes queued
+    for a grace window after each higher-priority release, trading
+    low-class throughput for high-class p99 (non-work-conserving by
+    design; 0 disables). A waiter whose QUERY deadline lapses while queued is
+    shed cheaply — an ``admission-shed`` Diagnostic, never dispatched;
+    one that outlives ``admission_queue_s`` is rejected. ``release``
+    is idempotent. Counters:
+    ``pixie_admission_{queued,shed,rejected}_total{tenant}``.
     """
 
     def __init__(self):
         self._cond = threading.Condition()
-        self._in_flight: dict[str, int] = {}
+        self._in_flight: dict[str, int] = {}  # qid -> predicted bytes
+        self._tenant_of: dict[str, str] = {}  # qid -> resolved tenant
+        self._prio_of: dict[str, int] = {}  # qid -> priority
+        self._waiters: list[dict] = []
+        self._seq = 0
+        # Priority hold-down (admission_priority_holddown_ms): the
+        # highest recently-released priority and when its grace window
+        # lapses — strictly-lower waiters stay queued until then.
+        self._held_prio: int | None = None
+        self._held_until = 0.0
 
     def in_flight(self) -> dict:
         with self._cond:
             return dict(self._in_flight)
 
-    @staticmethod
-    def _diag(message: str) -> "object":
-        from ..analysis.diagnostics import Diagnostic
+    def in_flight_by_tenant(self) -> dict:
+        """{tenant: in-flight predicted bytes} — the queryz view."""
+        with self._cond:
+            out: dict = {}
+            for qid, pred in self._in_flight.items():
+                t = self._tenant_of.get(qid, "")
+                out[t] = out.get(t, 0) + pred
+            return out
 
-        return Diagnostic(
-            code="admission-reject", message=message, plan="distributed"
+    def queued(self) -> list:
+        """Waiter snapshot in scheduling order (queryz / tests)."""
+        with self._cond:
+            return [
+                {"qid": w["qid"], "tenant": w["tenant"],
+                 "priority": w["priority"], "pred": w["pred"],
+                 "deadline": w["deadline"]}
+                for w in sorted(self._waiters, key=self._order)
+            ]
+
+    @staticmethod
+    def _order(w: dict):
+        return (
+            -w["priority"],
+            w["deadline"] if w["deadline"] is not None else float("inf"),
+            w["seq"],
         )
 
-    def admit(self, qid: str, predicted: dict | None) -> None:
+    @staticmethod
+    def _diag(message: str, code: str = "admission-reject") -> "object":
+        from ..analysis.diagnostics import Diagnostic
+
+        return Diagnostic(code=code, message=message, plan="distributed")
+
+    @staticmethod
+    def _count(kind: str, tenant: str) -> None:
+        from .observability import default_counter
+        from .tenancy import resolve_tenant
+
+        # Idempotent for already-resolved names; makes the bounded-
+        # cardinality guard airtight AT the labeling point (and keeps
+        # the metrics-naming lint's no-baseline invariant: every
+        # tenant label value is visibly resolver-derived).
+        tenant = resolve_tenant(tenant)
+        help_by_kind = {
+            "queued": "Queries that waited in the admission queue "
+                      "(tenant share full on arrival)",
+            "shed": "Queued queries shed before dispatch because "
+                    "their deadline lapsed (zero agent work)",
+            "cancelled": "Queued queries cancelled (cancel_query / "
+                         "px cancel) before dispatch (zero agent work)",
+            "rejected": "Queries refused at admission (predicted over "
+                        "the tenant share, or queued past "
+                        "admission_queue_s)",
+        }
+        default_counter(
+            f"pixie_admission_{kind}_total", help_by_kind[kind]
+        ).labels(tenant=tenant).inc()
+
+    def _schedule_locked(self, budget: float) -> None:
+        """Admit every eligible waiter, best-ordered first. Caller
+        holds ``self._cond``. A blocked tenant's waiters are skipped
+        (they queue behind their own backlog) while later-ordered
+        waiters of OTHER tenants still admit — weighted fairness, not
+        head-of-line blocking."""
+        if not self._waiters:
+            return
+        from .tenancy import tenant_shares
+
+        shares = tenant_shares(budget)
+        used: dict = {}
+        running_prio = None
+        if self._held_prio is not None:
+            if time.monotonic() >= self._held_until:
+                self._held_prio = None
+            else:
+                running_prio = self._held_prio
+        for qid, pred in self._in_flight.items():
+            t = self._tenant_of.get(qid, "")
+            used[t] = used.get(t, 0) + pred
+            p = self._prio_of.get(qid, 0)
+            running_prio = p if running_prio is None else max(running_prio, p)
+        blocked_prio = None
+        blocked_tenants: set = set()
+        for w in sorted(self._waiters, key=self._order):
+            if running_prio is not None and w["priority"] < running_prio:
+                break  # strict priority: yield to the running class
+            if blocked_prio is not None and w["priority"] < blocked_prio:
+                break  # ...and to a higher class still waiting
+            if w["tenant"] in blocked_tenants:
+                # FIFO within a tenant: once its best-ordered waiter is
+                # blocked, later same-tenant waiters queue behind it —
+                # a stream of small queries must not indefinitely
+                # overtake (starve) a blocked larger one on budget the
+                # larger query is waiting to accumulate.
+                continue
+            share = shares.get(w["tenant"], budget)
+            if used.get(w["tenant"], 0) + w["pred"] <= share:
+                self._waiters.remove(w)
+                self._in_flight[w["qid"]] = w["pred"]
+                self._tenant_of[w["qid"]] = w["tenant"]
+                self._prio_of[w["qid"]] = w["priority"]
+                used[w["tenant"]] = used.get(w["tenant"], 0) + w["pred"]
+                running_prio = (
+                    w["priority"] if running_prio is None
+                    else max(running_prio, w["priority"])
+                )
+                w["admitted"] = True
+                w["event"].set()
+            else:
+                blocked_tenants.add(w["tenant"])
+                blocked_prio = (
+                    w["priority"] if blocked_prio is None
+                    else max(blocked_prio, w["priority"])
+                )
+
+    def admit(self, qid: str, predicted: dict | None,
+              tenant: str | None = None, priority: int = 0,
+              deadline: float | None = None) -> None:
+        """Admit/queue/reject ``qid``. ``tenant`` is resolved through
+        the registered set; ``deadline`` is an absolute
+        ``time.monotonic()`` instant (the query's own deadline — a
+        waiter past it is shed, never dispatched)."""
         from ..config import get_flag
+        from .tenancy import resolve_tenant, tenant_shares
 
         budget = float(get_flag("admission_bytes_budget_mb")) * (1 << 20)
         if budget <= 0:
@@ -90,35 +245,159 @@ class _Admission:
         if pred is None:
             return  # unknown cost: admit (never falsely reject)
         pred = int(pred)
-        if pred > budget:
+        tenant = resolve_tenant(tenant)
+        share = tenant_shares(budget).get(tenant, budget)
+        if pred > share:
+            self._count("rejected", tenant)
             raise AdmissionError(self._diag(
-                f"query {qid} predicted {pred} staged bytes "
-                f"(x{(predicted or {}).get('safety')} safety, origin "
-                f"{(predicted or {}).get('origin')}) > the per-engine "
-                f"admission budget {int(budget)} "
-                "(admission_bytes_budget_mb) — rejected at admission, "
+                f"query {qid} (tenant {tenant}) predicted {pred} staged "
+                f"bytes (x{(predicted or {}).get('safety')} safety, "
+                f"origin {(predicted or {}).get('origin')}) > the "
+                f"tenant's admission share {int(share)} of budget "
+                f"{int(budget)} (admission_bytes_budget_mb x "
+                "admission_tenant_weights) — rejected at admission, "
                 "not failed at run time"
             ))
         queue_s = float(get_flag("admission_queue_s"))
-        deadline = time.monotonic() + max(queue_s, 0.0)
+        give_up = time.monotonic() + max(queue_s, 0.0)
+        w = {
+            "qid": qid, "tenant": tenant, "pred": pred,
+            "priority": int(priority), "deadline": deadline,
+            "seq": 0, "event": threading.Event(), "admitted": False,
+            "cancelled": False,
+        }
         with self._cond:
-            while sum(self._in_flight.values()) + pred > budget:
-                wait_s = deadline - time.monotonic()
-                if wait_s <= 0:
-                    held = sorted(self._in_flight)
-                    raise AdmissionError(self._diag(
-                        f"query {qid} predicted {pred} staged bytes "
-                        f"queued past admission_queue_s={queue_s}s "
-                        f"behind in-flight {held} "
-                        f"(budget {int(budget)} bytes)"
-                    ))
-                self._cond.wait(wait_s)
-            self._in_flight[qid] = pred
+            self._seq += 1
+            w["seq"] = self._seq
+            self._waiters.append(w)
+            self._schedule_locked(budget)
+            if w["admitted"]:
+                return
+        self._count("queued", tenant)
+        while True:
+            with self._cond:
+                # A lapsed hold-down has no release event behind it, so
+                # waiters re-run the scheduler themselves on every wake
+                # (idempotent; releases still wake admitted waiters
+                # directly through their events).
+                self._schedule_locked(budget)
+                if w["admitted"]:
+                    return
+                if w["cancelled"]:
+                    # cancel() already removed us and rescheduled.
+                    verdict = "cancelled"
+                    break
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    self._waiters.remove(w)
+                    # This waiter may have been the high-priority head
+                    # blocking lower-priority waiters; with it gone the
+                    # queue order changed, and no release event is
+                    # coming — admit the newly eligible NOW.
+                    self._schedule_locked(budget)
+                    verdict = "shed"
+                    break
+                if now >= give_up:
+                    self._waiters.remove(w)
+                    self._schedule_locked(budget)
+                    verdict = "timeout"
+                    break
+                stop = give_up if deadline is None else min(give_up, deadline)
+                holddown_s = (
+                    float(get_flag("admission_priority_holddown_ms")) / 1e3
+                )
+                if holddown_s > 0:
+                    # A hold-down may be ARMED while this waiter sleeps
+                    # (release() wakes only admitted waiters), and its
+                    # lapse has no event behind it either — bounding
+                    # every sleep slice at one hold window keeps the
+                    # staleness within the same "one extra wake per
+                    # window" budget the held-case bound below accepts.
+                    stop = min(stop, now + holddown_s)
+                if self._held_prio is not None:
+                    # Wake at the grace-window lapse even if nothing
+                    # releases in the meantime. Unconditional (not just
+                    # for priorities the CURRENT hold blocks): a later
+                    # release may re-arm the hold at a higher priority
+                    # while this waiter sleeps, and if that was the
+                    # final release there is no further event to wake
+                    # anyone — re-observing within one grace window
+                    # keeps the queue live (at most one extra wake per
+                    # window per waiter).
+                    stop = min(stop, self._held_until)
+            w["event"].wait(timeout=max(stop - now, 0.0))
+        if verdict == "cancelled":
+            self._count("cancelled", tenant)
+            raise AdmissionError(self._diag(
+                f"query {qid} (tenant {tenant}, predicted {pred} staged "
+                "bytes) cancelled while queued for admission — never "
+                "dispatched, zero agent work",
+                code="admission-cancelled",
+            ))
+        if verdict == "shed":
+            self._count("shed", tenant)
+            raise AdmissionError(self._diag(
+                f"query {qid} (tenant {tenant}, predicted {pred} "
+                f"staged bytes) shed from the admission queue: its "
+                f"deadline lapsed while queued behind the tenant's "
+                f"in-flight backlog — never dispatched, zero agent "
+                "work", code="admission-shed",
+            ))
+        held = sorted(self.in_flight())
+        self._count("rejected", tenant)
+        raise AdmissionError(self._diag(
+            f"query {qid} (tenant {tenant}) predicted {pred} staged "
+            f"bytes queued past admission_queue_s={queue_s}s "
+            f"behind in-flight {held} "
+            f"(budget {int(budget)} bytes)"
+        ))
+
+    def cancel(self, qid: str) -> bool:
+        """Cancel a QUEUED (not yet admitted) query — the queued-phase
+        half of ``broker.cancel_query`` (a dispatched query takes the
+        forwarder/agent path instead). The waiter is removed under the
+        lock so the scheduler can never admit it afterwards; its
+        ``admit()`` call raises a structured never-dispatched
+        Diagnostic (``admission-cancelled``)."""
+        from ..config import get_flag
+
+        with self._cond:
+            for w in self._waiters:
+                if w["qid"] == qid and not w["admitted"]:
+                    self._waiters.remove(w)
+                    w["cancelled"] = True
+                    w["event"].set()
+                    # Same reschedule as shed: the departed waiter may
+                    # have been priority-blocking eligible waiters.
+                    self._schedule_locked(
+                        float(get_flag("admission_bytes_budget_mb"))
+                        * (1 << 20)
+                    )
+                    return True
+        return False
 
     def release(self, qid: str) -> None:
+        from ..config import get_flag
+
         with self._cond:
-            if self._in_flight.pop(qid, None) is not None:
-                self._cond.notify_all()
+            self._tenant_of.pop(qid, None)
+            prio = self._prio_of.pop(qid, None)
+            if self._in_flight.pop(qid, None) is None:
+                return
+            holddown_s = (
+                float(get_flag("admission_priority_holddown_ms")) / 1e3
+            )
+            if holddown_s > 0 and prio is not None:
+                now = time.monotonic()
+                if (self._held_prio is None or prio >= self._held_prio
+                        or now >= self._held_until):
+                    self._held_prio = prio
+                    self._held_until = now + holddown_s
+            # Freed budget admits the next eligible waiter NOW — its
+            # event wakes it directly, no timeout slice involved.
+            self._schedule_locked(
+                float(get_flag("admission_bytes_budget_mb")) * (1 << 20)
+            )
 
 
 class QueryResultForwarder:
@@ -205,12 +484,22 @@ class QueryResultForwarder:
             st = self._active.get(qid)
             return set(st["acked"]) if st is not None else None
 
-    def wait(self, qid: str, timeout_s: float) -> dict:
+    def wait(self, qid: str, timeout_s: float,
+             deadline: float | None = None) -> dict:
         """Blocks until eos/error/timeout. Returns {table: HostBatch} plus
         per-agent exec stats and the partial-result marker; raises on
         error, merge-agent loss, require_complete violation, or watchdog
         expiry. The watchdog is an INACTIVITY timeout: any message
-        resets it (the reference's producer watchdog)."""
+        resets it (the reference's producer watchdog).
+
+        ``deadline`` (absolute ``time.monotonic()``) is the query's own
+        deadline: when it passes mid-wait the query is cancelled
+        everywhere (agents abort at their next window boundary) and
+        whatever already arrived returns as a ``partial`` result with
+        the unreported agents marked ``missing_reasons[...] =
+        "deadline"`` — a deadline is degradation, not failure. An
+        ``interrupt()`` (the ``cancel_query`` path) takes the same exit
+        with reason "cancelled"."""
         with self._lock:
             st = self._active[qid]
         outputs: dict = {}
@@ -221,12 +510,16 @@ class QueryResultForwarder:
         # Inactivity watchdog: only QUERY-RELEVANT activity pushes the
         # deadline out — unrelated cluster churn (another query's agent
         # expiring) must not postpone a hung query's timeout forever.
-        deadline = time.monotonic() + timeout_s
+        watchdog = time.monotonic() + timeout_s
         try:
             while True:
                 if eos and self._complete(st, stats):
                     return self._result(st, outputs, stats, merge_stats)
                 now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    return self._interrupted(
+                        qid, st, outputs, stats, merge_stats, "deadline"
+                    )
                 if eos:
                     # After eos, per-agent stats may still be in flight
                     # on their own dispatcher threads — drain them under
@@ -239,23 +532,29 @@ class QueryResultForwarder:
                     if wait_s <= 0:
                         return self._result(st, outputs, stats, merge_stats)
                 else:
-                    wait_s = deadline - now
+                    wait_s = watchdog - now
                     if wait_s <= 0:
                         self.cancel(qid)
                         raise QueryTimeout(
                             self._timeout_message(qid, st, stats, timeout_s)
                         )
+                if deadline is not None:
+                    wait_s = min(wait_s, deadline - now)
                 try:
-                    msg = st["queue"].get(timeout=wait_s)
+                    msg = st["queue"].get(timeout=max(wait_s, 0.0))
                 except queue.Empty:
-                    if eos:
-                        return self._result(st, outputs, stats, merge_stats)
-                    # Watchdog fired (query_result_forwarder.go:241):
-                    # cancel the query everywhere and fail the stream.
-                    self.cancel(qid)
-                    raise QueryTimeout(
-                        self._timeout_message(qid, st, stats, timeout_s)
-                    ) from None
+                    # Loop back: the top of the loop decides which
+                    # limit actually fired (query deadline -> partial,
+                    # post-eos grace -> result, watchdog -> the
+                    # QueryTimeout above; query_result_forwarder.go:241).
+                    continue
+                if "_interrupt" in msg:
+                    # cancel_query(): the same cooperative exit as a
+                    # lapsed deadline, reason "cancelled".
+                    return self._interrupted(
+                        qid, st, outputs, stats, merge_stats,
+                        str(msg["_interrupt"]),
+                    )
                 if "error" in msg:
                     self.cancel(qid)
                     raise QueryError(msg["error"])
@@ -331,13 +630,46 @@ class QueryResultForwarder:
                     eos = True
                 elif "table" in msg:
                     outputs[msg["table"]] = msg["batch"]
-                deadline = time.monotonic() + timeout_s
+                watchdog = time.monotonic() + timeout_s
         finally:
             self._deregister(qid)
 
     @staticmethod
     def _complete(st: dict, stats: dict) -> bool:
         return st["expected"] <= set(stats)
+
+    def interrupt(self, qid: str, reason: str = "cancelled") -> bool:
+        """Cooperatively stop a registered one-shot query: the wait
+        loop returns a partial result with ``reason`` instead of an
+        error (the ``cancel_query`` path). False when ``qid`` is not
+        (or no longer) registered."""
+        with self._lock:
+            st = self._active.get(qid)
+        if st is None:
+            return False
+        st["queue"].put({"_interrupt": reason})
+        return True
+
+    def _interrupted(self, qid: str, st: dict, outputs: dict,
+                     stats: dict, merge_stats: dict,
+                     reason: str) -> dict:
+        """Deadline/cancel exit: stop the agents (they abort at their
+        next window boundary — the shed is cooperative, not advisory),
+        mark every agent that hasn't reported as missing with
+        ``reason``, and return what DID arrive as a partial result. A
+        deadline-exceeded query is a degraded answer, not a failure."""
+        self.cancel(qid)
+        for aid in sorted(st["expected"] - set(stats)):
+            st["missing"][aid] = reason
+            st["dispatch"][f"{aid}:execute"] = f"interrupted ({reason})"
+        res = self._result(st, outputs, stats, merge_stats)
+        res["partial"] = True
+        res["interrupted"] = reason
+        if not res.get("missing_reasons"):
+            # Every data agent reported (only eos/merge was pending):
+            # still a partial answer — attribute it to the query itself.
+            res["missing_reasons"] = {"_query": reason}
+        return res
 
     def _agent_lost(self, qid: str, st: dict, stats: dict, aid: str,
                     reason: str) -> None:
@@ -776,7 +1108,42 @@ class QueryBroker:
             sub.unsubscribe()
         for sub in getattr(self, "_serve_subs", []):
             sub.unsubscribe()
+        self._serve_subs = []  # a re-serve() after close starts fresh
+        if getattr(self, "_exec_gate", None) is not None:
+            # In-flight request workers finish their current query
+            # (replies are best-effort) but drain no further backlog;
+            # daemon threads never block interpreter exit.
+            with self._exec_gate:
+                self._exec_closed = True
+                self._exec_backlog.clear()
         self.trace_view.close()
+
+    def cancel_query(self, qid: str) -> bool:
+        """Cooperatively cancel a running query (`px cancel` /
+        ``broker.cancel``): live streams tear down their cursors, a
+        one-shot query returns a partial result with reason
+        "cancelled", and ``query.cancel`` tells every agent to abort at
+        its next window boundary — the same path a lapsed deadline
+        takes, which is what makes load shedding safe rather than
+        advisory. Returns True when a registered query was found."""
+        # GIL-atomic pop: exactly-once vs a racing aborter, same
+        # protocol as _abort_streams_of (see baseline.json).
+        handle = self._live_streams.pop(qid, None)  # pxlint: disable=thread-shared-state
+        if handle is not None:
+            handle.cancel()
+            return True
+        # A query still WAITING for admission (its qid is visible in
+        # `px debug queries` / /debug/queryz, inviting exactly this
+        # cancel) has no forwarder registration yet — cancel it at the
+        # queue, before any dispatch exists to stop.
+        if self.admission.cancel(qid):
+            return True
+        hit = self.forwarder.interrupt(qid, "cancelled")
+        # Belt and braces: even a query the forwarder no longer tracks
+        # (or one raced between registration steps) gets its agents
+        # stopped — agents drop cancels for unknown qids.
+        self.bus.publish("query.cancel", {"qid": qid})
+        return hit
 
     def execute_script(
         self,
@@ -786,6 +1153,9 @@ class QueryBroker:
         max_output_rows: int = 10_000,
         mutation_timeout_s: float = 10.0,
         require_complete: bool | None = None,
+        tenant: str | None = None,
+        priority: int = 0,
+        deadline_ms: float | None = None,
     ) -> dict:
         """The VizierService.ExecuteScript flow, end to end.
 
@@ -797,16 +1167,33 @@ class QueryBroker:
         ``require_complete`` (default: the flag): True fails the query
         as soon as a data agent is lost; False completes from the
         survivors with ``partial=True`` + ``missing_agents``.
+
+        Multi-tenancy (services/tenancy.py): ``tenant`` scopes the
+        query to a registered tenant's admission share (unknown/None ->
+        the shared tenant), ``priority`` (higher first) and
+        ``deadline_ms`` (relative, from now) order the admission wait
+        queue. The deadline also rides every dispatch: agents abort
+        past-deadline work at window boundaries and the client gets a
+        ``partial`` result with ``missing_reasons=...: "deadline"``
+        instead of dead compute.
         """
         from ..config import get_flag
+        from .tenancy import resolve_tenant
 
         if require_complete is None:
             require_complete = bool(get_flag("require_complete"))
+        tenant = resolve_tenant(tenant)
+        deadline_mono = deadline_unix = None
+        if deadline_ms is not None and float(deadline_ms) > 0:
+            deadline_mono = time.monotonic() + float(deadline_ms) / 1e3
+            deadline_unix = time.time() + float(deadline_ms) / 1e3
         trace = self.tracer.begin_query(script=query, kind="distributed")
+        trace.tenant = tenant
         try:
             result = self._execute_script_inner(
                 query, timeout_s, now_ns, max_output_rows,
                 mutation_timeout_s, require_complete, trace,
+                tenant, int(priority), deadline_mono, deadline_unix,
             )
         except Exception as e:
             self.tracer.end_query(
@@ -829,6 +1216,10 @@ class QueryBroker:
         mutation_timeout_s: float,
         require_complete: bool,
         trace,
+        tenant: str,
+        priority: int,
+        deadline_mono: float | None,
+        deadline_unix: float | None,
     ) -> dict:
         compiler_state = CompilerState(
             schemas=self.tracker.schemas(),
@@ -939,7 +1330,14 @@ class QueryBroker:
         # LaunchQuery: merge fragment first (so the router can accept
         # early bridge chunks), then the per-agent data fragments —
         # every dispatch acked on receipt and retried with backoff
-        # before the agent is declared lost.
+        # before the agent is declared lost. The tenant + absolute
+        # deadline ride every dispatch: agents stamp the tenant onto
+        # their fragment traces (per-agent __queries__ attribution) and
+        # trip the deadline at window boundaries (exec/pipeline.py
+        # DeadlineEvent) so dead work stops instead of completing.
+        envelope = {"tenant": tenant}
+        if deadline_unix is not None:
+            envelope["deadline_unix_s"] = deadline_unix
         dispatches: dict = {
             (merge_agent, "merge"): (
                 f"agent.{merge_agent}.merge",
@@ -951,6 +1349,7 @@ class QueryBroker:
                     ],
                     "data_agents": data_agents,
                     "predicted_cost": predicted,
+                    **envelope,
                 },
             ),
         }
@@ -962,13 +1361,19 @@ class QueryBroker:
                     "plan": dplan.split.before_blocking,
                     "merge_agent": merge_agent,
                     "predicted_cost": predicted,
+                    **envelope,
                 },
             )
-        # Admission control: reject/queue BEFORE any registration or
-        # dispatch — a refused query must leak nothing. admit() either
-        # records the query's predicted bytes (released in the finally
-        # below) or raises without recording.
-        self.admission.admit(qid, predicted)
+        # Admission control: reject/queue/shed BEFORE any registration
+        # or dispatch — a refused query must leak nothing. admit()
+        # either records the query's predicted bytes against its
+        # tenant's share (released in the finally below) or raises
+        # without recording; a queued query whose deadline lapses is
+        # shed here with zero agent work.
+        self.admission.admit(
+            qid, predicted, tenant=tenant, priority=priority,
+            deadline=deadline_mono,
+        )
         try:
             # Verify BEFORE registering the query: a failing check must
             # not leak the forwarder's subscriptions/dispatcher threads
@@ -995,7 +1400,9 @@ class QueryBroker:
                 for key, (topic, msg) in list(dispatches.items()):
                     dispatches[key] = (topic, tracectx.attach(msg, ctx))
                 self._dispatch_with_retry(qid, dispatches, trace=trace)
-            result = self.forwarder.wait(qid, timeout_s)
+            result = self.forwarder.wait(
+                qid, timeout_s, deadline=deadline_mono
+            )
         finally:
             # The query's predicted bytes stop counting against the
             # admission budget the moment it finishes or fails.
@@ -1003,6 +1410,7 @@ class QueryBroker:
         result["qid"] = qid
         result["distributed_plan"] = dplan
         result["predicted_cost"] = predicted
+        result["tenant"] = tenant
         # Fold per-agent resource records into the broker's trace: the
         # distributed query's cost with per-agent attribution (served by
         # broker.debug_queries / `px debug queries` / /debug/queryz).
@@ -1180,8 +1588,12 @@ class QueryBroker:
         (``src/api/proto/vizierpb/vizierapi.proto`` ExecuteScript).
 
         Topics (all request/reply via ``_reply_to``):
-          broker.execute  {query, timeout_s?, max_output_rows?}
+          broker.execute  {query, timeout_s?, max_output_rows?, tenant?,
+                          priority?, deadline_ms?}
                           -> {ok, qid, tables, agent_stats} | {ok: False, error}
+          broker.cancel   {qid} -> {ok, cancelled} — cooperative
+                          cancellation (px cancel); the query returns
+                          partial with reason "cancelled"
           broker.execute_stream {query, update_topic, poll_interval_s?}
                           -> {ok, qid}; incremental updates then flow to
                           ``update_topic`` as {table, batch, seq, mode}
@@ -1194,6 +1606,11 @@ class QueryBroker:
                           recent distributed-query traces with resource
                           usage + per-agent attribution (px debug queries)
         """
+        # Idempotent: a second serve() would double-subscribe every
+        # topic (each request handled twice — duplicate replies,
+        # double-spawned workers, double-counted metrics).
+        if getattr(self, "_serve_subs", None):
+            return
 
         def _reply(msg, payload):
             inbox = msg.get("_reply_to")
@@ -1221,15 +1638,19 @@ class QueryBroker:
 
             return wrapped
 
-        def _on_execute(msg):
+        def _run_execute(msg):
             try:
                 rc = msg.get("require_complete")
+                dl = msg.get("deadline_ms")
                 res = self.execute_script(
                     msg["query"],
                     timeout_s=float(msg.get("timeout_s", 30.0)),
                     now_ns=int(msg.get("now_ns", 0)),
                     max_output_rows=int(msg.get("max_output_rows", 10_000)),
                     require_complete=None if rc is None else bool(rc),
+                    tenant=msg.get("tenant"),
+                    priority=int(msg.get("priority", 0)),
+                    deadline_ms=None if dl is None else float(dl),
                 )
                 _reply(msg, {
                     "ok": True,
@@ -1238,11 +1659,117 @@ class QueryBroker:
                     "agent_stats": res.get("agent_stats", {}),
                     "partial": res.get("partial", False),
                     "missing_agents": res.get("missing_agents", []),
+                    "missing_reasons": res.get("missing_reasons", {}),
+                    "interrupted": res.get("interrupted"),
                     "mutations": res.get("mutations"),
                     "predicted_cost": res.get("predicted_cost"),
+                    "tenant": res.get("tenant"),
                 })
             except Exception as e:  # errors cross the wire as data
                 _reply(msg, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+        # One DAEMON worker thread per in-flight request, capped PER
+        # TENANT: the broker.execute topic has a SINGLE bus dispatcher
+        # thread, so an admission-queued (or merely slow) query handled
+        # inline would head-of-line block every other tenant's
+        # requests — and a single GLOBAL pool merely moves that
+        # blocking up a level (one tenant's requests parked in
+        # admission waits would hold every worker while other tenants'
+        # requests rot in a shared FIFO). Per-tenant caps keep the
+        # isolation contract at the front door: tenant A's backlog
+        # queues behind A's own cap, B's requests spawn their own
+        # workers. Total thread count stays bounded because tenants
+        # are a REGISTERED set (resolve_tenant folds unknowns into
+        # "shared"): <= broker_execute_threads x (registered tenants).
+        # Daemon threads (vs ThreadPoolExecutor): a slow in-flight
+        # query must not block interpreter exit for its whole timeout.
+        from collections import deque
+
+        from ..config import get_flag
+        from .tenancy import resolve_tenant
+
+        self._exec_gate = threading.Lock()
+        self._exec_live: dict = {}     # tenant -> live worker count
+        self._exec_backlog: dict = {}  # tenant -> deque of messages
+        self._exec_closed = False
+
+        # Backlog bound: per tenant, this many waiting requests ride
+        # behind the cap before the front door fails fast (each parked
+        # message holds query text + a reply handle — unbounded growth
+        # at the exact overload moment this layer defends against).
+        _BACKLOG_PER_WORKER = 8
+
+        def _execute_worker(msg, tenant):
+            while msg is not None:
+                _run_execute(msg)
+                msg = None
+                while msg is None:
+                    with self._exec_gate:
+                        backlog = self._exec_backlog.get(tenant)
+                        if backlog and not self._exec_closed:
+                            msg, enq_t, give_up = backlog.popleft()
+                        else:
+                            self._exec_live[tenant] -= 1
+                            if not self._exec_live[tenant]:
+                                del self._exec_live[tenant]
+                            return
+                    if time.monotonic() >= give_up:
+                        # The client's own request timeout elapsed
+                        # while this waited behind the tenant's cap:
+                        # executing it now is dead agent work for a
+                        # caller that already gave up.
+                        _reply(msg, {
+                            "ok": False,
+                            "error": "BrokerOverloaded: request "
+                                     f"expired after {time.monotonic() - enq_t:.1f}s "
+                                     "in the tenant's front-door "
+                                     "backlog (broker_execute_threads)",
+                        })
+                        msg = None
+
+        def _on_execute(msg):
+            tenant = resolve_tenant(msg.get("tenant"), count_unknown=False)
+            cap = max(1, int(get_flag("broker_execute_threads")))
+            with self._exec_gate:
+                if self._exec_closed:
+                    return
+                if self._exec_live.get(tenant, 0) >= cap:
+                    backlog = self._exec_backlog.setdefault(
+                        tenant, deque()
+                    )
+                    if len(backlog) >= cap * _BACKLOG_PER_WORKER:
+                        full = True
+                    else:
+                        full = False
+                        now = time.monotonic()
+                        backlog.append((
+                            msg, now,
+                            now + float(msg.get("timeout_s", 30.0)),
+                        ))
+                else:
+                    full = None
+                    self._exec_live[tenant] = (
+                        self._exec_live.get(tenant, 0) + 1
+                    )
+            if full:  # fail fast OUTSIDE the gate: publish can be slow
+                _reply(msg, {
+                    "ok": False,
+                    "error": "BrokerOverloaded: tenant front-door "
+                             "backlog full (broker_execute_threads x "
+                             f"{_BACKLOG_PER_WORKER} waiting requests)",
+                })
+            elif full is None:
+                threading.Thread(
+                    target=_execute_worker, args=(msg, tenant),
+                    name="broker-execute", daemon=True,
+                ).start()
+
+        def _on_cancel(msg):
+            qid = msg.get("qid")
+            _reply(msg, {
+                "ok": True,
+                "cancelled": bool(qid) and self.cancel_query(str(qid)),
+            })
 
         def _on_execute_stream(msg):
             topic = msg.get("update_topic")
@@ -1307,10 +1834,18 @@ class QueryBroker:
                 "ok": True,
                 "in_flight": self.tracer.in_flight(),
                 "queries": self.tracer.recent()[:n],
+                # Admission-scheduler view: per-tenant in-flight
+                # predicted bytes + the ordered wait queue.
+                "admission": {
+                    "in_flight_by_tenant":
+                        self.admission.in_flight_by_tenant(),
+                    "queued": self.admission.queued(),
+                },
             })
 
         self._serve_subs = [
             self.bus.subscribe("broker.execute", _guarded(_on_execute)),
+            self.bus.subscribe("broker.cancel", _guarded(_on_cancel)),
             self.bus.subscribe(
                 "broker.execute_stream", _guarded(_on_execute_stream)
             ),

@@ -150,6 +150,7 @@ class TelemetryCollector:
             "time_": [end_ns],
             "trace_id": [trace.trace_id],
             "qid": [trace.qid or ""],
+            "tenant": [getattr(trace, "tenant", "") or ""],
             "agent_id": [agent],
             "kind": [trace.kind],
             "script_hash": [trace.script_hash],

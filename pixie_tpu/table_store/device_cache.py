@@ -18,6 +18,7 @@ are evicted. An LRU byte budget (``device_cache_bytes``) bounds HBM use.
 from __future__ import annotations
 
 import itertools
+import threading
 import weakref
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -29,13 +30,23 @@ from ..types.dtypes import device_dtypes, pad_values
 
 # Global LRU accounting: the device_cache_bytes budget bounds the SUM of
 # resident windows across every table's cache (one HBM, many tables), so
-# eviction picks the globally least-recently-used window.
+# eviction picks the globally least-recently-used window. The registry
+# is process-global while engines are per-agent: one agent's staging
+# loop iterates it while another agent's table creation add()s, so
+# every traversal goes through a locked snapshot ("Set changed size
+# during iteration" otherwise — observed as a cluster-test flake).
 _CACHES: "weakref.WeakSet[DeviceWindowCache]" = weakref.WeakSet()
+_CACHES_LOCK = threading.Lock()
 _TICK = itertools.count()
 
 
+def _caches() -> list:
+    with _CACHES_LOCK:
+        return list(_CACHES)
+
+
 def total_resident_bytes() -> int:
-    return sum(c._bytes for c in _CACHES)
+    return sum(c._bytes for c in _caches())
 
 
 def _enforce_global_budget(newest: tuple) -> None:
@@ -44,8 +55,11 @@ def _enforce_global_budget(newest: tuple) -> None:
     budget = get_flag("device_cache_bytes")
     while total_resident_bytes() > budget:
         victim = None  # (tick, cache, key)
-        for c in _CACHES:
-            for k, t in c._ticks.items():
+        for c in _caches():
+            # Snapshot: another engine's concurrent get()/put() moves
+            # its own cache's ticks. Eviction choice is best-effort
+            # under that race; the traversal must not crash.
+            for k, t in list(c._ticks.items()):
                 if (c, k) == newest:
                     continue
                 if victim is None or t < victim[0]:
@@ -79,7 +93,8 @@ class DeviceWindowCache:
         self._entries: OrderedDict[tuple, DeviceWindow] = OrderedDict()
         self._ticks: dict[tuple, int] = {}
         self._bytes = 0
-        _CACHES.add(self)
+        with _CACHES_LOCK:
+            _CACHES.add(self)
 
     def __len__(self) -> int:
         return len(self._entries)

@@ -13,6 +13,9 @@ and hashes them per-row in agg/join maps (``src/carnot/exec/row_tuple.h``).
 
 from __future__ import annotations
 
+import hashlib
+import struct
+import threading
 from typing import Iterable
 
 import numpy as np
@@ -23,13 +26,61 @@ NULL_ID = -1
 class StringDictionary:
     """Append-only string <-> int32 id mapping."""
 
-    __slots__ = ("_str_to_id", "_strings")
+    __slots__ = ("_str_to_id", "_strings", "_fp", "_fp_len", "_fp_digest",
+                 "_fp_lock")
 
     def __init__(self, strings: Iterable[str] = ()):
         self._strings: list[str] = []
         self._str_to_id: dict[str, int] = {}
+        # Incremental content fingerprint (content_key): hasher state,
+        # how many strings it has absorbed, and the digest at that
+        # length. Lazy — dictionaries that never cross a cache key pay
+        # nothing. Per-dictionary lock: a first-call fingerprint of a
+        # LARGE ingest dictionary hashes its whole string table, and a
+        # process-wide lock would stall every other thread's compile
+        # fast path behind that one dictionary.
+        self._fp = None
+        self._fp_len = 0
+        self._fp_digest = b""
+        self._fp_lock = threading.Lock()
         for s in strings:
             self.get_or_add(s)
+
+    def content_key(self) -> tuple:
+        """Content-addressed identity: ``(len, digest)`` over the
+        ordered string table.
+
+        The fragment cache (``exec/fragment.compile_fragment_cached``)
+        keys dictionaries by THIS instead of ``id()``: bridge payloads
+        that cross the wire decode into fresh ``StringDictionary``
+        objects every query, so identity-keyed caching recompiled the
+        merge tier's XLA programs on every distributed query — equal
+        content must hit. Sound because the dictionary is append-only:
+        two dictionaries with equal (ordered) content resolve every id
+        and every compile-time ``lookup`` identically, and a dictionary
+        that later GROWS simply produces a new key (its first
+        ``len`` entries — all any cached fragment resolved against —
+        are immutable). Amortized O(new strings): the hash state
+        extends incrementally under the dictionary's own lock (a query
+        thread can fingerprint while ingest appends on another).
+        """
+        with self._fp_lock:
+            n = len(self._strings)
+            if self._fp is None:
+                self._fp = hashlib.blake2b(digest_size=16)
+            if n > self._fp_len:
+                h = self._fp
+                for s in self._strings[self._fp_len:n]:
+                    b = s.encode("utf-8", "surrogatepass")
+                    # Length-prefixed: ("ab","c") never collides with
+                    # ("a","bc").
+                    h.update(struct.pack("<I", len(b)))
+                    h.update(b)
+                self._fp_len = n
+                self._fp_digest = h.digest()
+            elif not self._fp_digest and n == 0:
+                self._fp_digest = self._fp.digest()
+            return (n, self._fp_digest)
 
     def __len__(self) -> int:
         return len(self._strings)

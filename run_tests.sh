@@ -57,6 +57,20 @@
 #                                      px/bound_accuracy).
 #                                      The script-compile half also runs
 #                                      inside --tier1.
+#   ./run_tests.sh --tenancy           multi-tenant overload gate: the
+#                                      full tests/test_tenancy.py suite
+#                                      INCLUDING the slow-marked p99
+#                                      isolation gate (a saturating
+#                                      noisy tenant must not move the
+#                                      victim tenant's p99 beyond 25%
+#                                      of its bracketed solo baseline,
+#                                      fixed seeds; see
+#                                      docs/RESILIENCE.md "Overload &
+#                                      multi-tenancy"). The fast half
+#                                      of the suite also runs inside
+#                                      the --tier1 sweep; the isolation
+#                                      gate runs via the explicit
+#                                      "$0" --tenancy step there.
 #   ./run_tests.sh --bench-join        quick join gate: a small
 #                                      selectivity/skew sweep (uniform
 #                                      vs zipf keys, low/high match
@@ -76,6 +90,11 @@ case "$1" in
       python -m pytest -q tests/test_telemetry.py \
       tests/test_trace_stitching.py tests/test_programs.py "$@" || rc=$?
     exit $rc
+    ;;
+  --tenancy)
+    shift
+    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest -q tests/test_tenancy.py "$@"
     ;;
   --bench-join)
     shift
@@ -137,6 +156,10 @@ case "$1" in
     # runs inside the main sweep below).
     env JAX_PLATFORMS=cpu python -m pixie_tpu.analysis.obs_check \
       || rc_analyze=1
+    # Multi-tenant overload gate: the slow-marked p99 isolation test is
+    # excluded from the 'not slow' sweep below, so run the tenancy
+    # suite explicitly here.
+    "$0" --tenancy || rc_analyze=1
     # ROADMAP.md "Tier-1 verify", verbatim:
     set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); [ $rc -eq 0 ] && rc=$rc_analyze; exit $rc
     ;;

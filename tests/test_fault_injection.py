@@ -844,6 +844,121 @@ class TestGraceDrain:
         bus.close()
 
 
+class TestDeadlineFault:
+    """ISSUE 13 satellite: the deadline entry in the fault matrix.
+    Cooperative cancellation means a dispatched query past its deadline
+    aborts at the next window boundary with a well-formed ``partial``
+    result (``missing_reasons`` values ``"deadline"``) — and the abort
+    must leak NOTHING: no live prefetch threads, no stuck
+    ``_exec_guard``, engines immediately serviceable."""
+
+    @staticmethod
+    def _slow_windows(pems, delay_s=0.2, window_rows=64):
+        """Make every data fragment mid-pipeline slow: small host
+        windows (the fixture's ~500 rows / 64 ≈ 8 boundaries per
+        fragment) each staged ``delay_s`` apart, so a sub-second
+        deadline deterministically trips BETWEEN windows — with one
+        big default window the whole query could finish before the
+        deadline and nothing would abort."""
+        originals = []
+        for p in pems:
+            eng = p.engine
+            orig = eng._staged_windows
+            originals.append((eng, orig, eng.window_rows))
+            eng.window_rows = window_rows
+
+            def slow(stream, stats=None, _orig=orig):
+                for w in _orig(stream, stats):
+                    time.sleep(delay_s)
+                    yield w
+
+            eng._staged_windows = slow
+        return originals
+
+    @staticmethod
+    def _prefetch_threads():
+        return [
+            t for t in threading.enumerate()
+            if t.is_alive() and t.name == "pixie-window-prefetch"
+        ]
+
+    def test_mid_pipeline_deadline_abort_no_leaks(self, cluster):
+        bus, tracker, pems, kelvin, broker = cluster
+        broker.execute_script(AGG_Q)  # warm compiles outside the clock
+        before_threads = len(self._prefetch_threads())
+        originals = self._slow_windows(pems, delay_s=0.15)
+        t0 = time.monotonic()
+        try:
+            res = broker.execute_script(
+                AGG_Q, timeout_s=30.0, deadline_ms=300.0
+            )
+        finally:
+            for eng, orig, wr in originals:
+                eng._staged_windows = orig
+                eng.window_rows = wr
+        elapsed = time.monotonic() - t0
+        # Well-formed degraded result: partial, every unreported agent
+        # attributed to the deadline — not an error, not a timeout.
+        assert res["partial"] is True
+        assert res["interrupted"] == "deadline"
+        assert res["missing_reasons"], res
+        assert set(res["missing_reasons"].values()) == {"deadline"}
+        # Cooperative: the abort lands within ~one window boundary of
+        # the deadline, far from the 30s watchdog.
+        assert elapsed < 5.0, f"deadline abort took {elapsed:.1f}s"
+        # No leaked prefetch threads once the aborts drain.
+        deadline = time.time() + 5
+        while (
+            time.time() < deadline
+            and len(self._prefetch_threads()) > before_threads
+        ):
+            time.sleep(0.05)
+        assert len(self._prefetch_threads()) <= before_threads, (
+            self._prefetch_threads()
+        )
+        # No stuck _exec_guard: every engine serves a fresh query
+        # immediately (acquire would block forever on a leaked guard).
+        for p in pems:
+            ok = p.engine._exec_guard.acquire(timeout=5.0)
+            assert ok, f"{p.agent_id} _exec_guard still held post-abort"
+            p.engine._exec_guard.release()
+        res = broker.execute_script(AGG_Q, timeout_s=30.0)
+        assert res["partial"] is False
+        assert _total_n(res) == _count_truth(pems, [0, 1, 2])
+
+    def test_delayed_bridge_fault_rule_degrades_at_deadline(self, cluster):
+        """Matrix rule: one agent's bridge payloads are fault-delayed
+        past the query deadline — the result degrades to partial AT the
+        deadline with that agent marked ``"deadline"``, instead of
+        stalling toward the watchdog."""
+        bus, tracker, pems, kelvin, broker = cluster
+        broker.execute_script(AGG_Q)  # warm
+        inj = FaultInjector(seed=SEED)
+        inj.delay(
+            "agent.kelvin-0.bridge", 3.0,
+            where=lambda m: m.get("from_agent") == "pem-2",
+        )
+        inj.delay(
+            "query.*.agent_done", 3.0,
+            where=lambda m: m.get("agent") == "pem-2",
+        )
+        bus.fault_injector = inj
+        t0 = time.monotonic()
+        res = broker.execute_script(
+            AGG_Q, timeout_s=30.0, deadline_ms=500.0
+        )
+        elapsed = time.monotonic() - t0
+        assert res["partial"] is True
+        assert res["interrupted"] == "deadline"
+        assert res["missing_reasons"].get("pem-2") == "deadline"
+        assert elapsed < 3.0, f"took {elapsed:.1f}s — waited for the delay?"
+        # What DID arrive is served (the merge may not have finalized
+        # before the deadline, in which case tables are empty — a
+        # well-formed degraded result either way, never an exception).
+        if "out" in res["tables"]:
+            assert _total_n(res) <= _count_truth(pems, [0, 1, 2])
+
+
 class TestLoadUnderFaults:
     def test_load_tester_reports_failure_rates(self, cluster):
         """Satellite: the load tester, driven into injected faults,

@@ -757,6 +757,102 @@ class TestCliPredObs:
                 assert "0.56" not in cols  # floored 5000/9000 never shown
 
 
+class TestMergeTierIdentity:
+    """ISSUE 13 satellite: merge fragments rebuild string dictionaries
+    from wire payloads, so the fragment cache's old id()-keying missed
+    on every distributed query and XLA recompiled the merge/limit
+    programs each run (PR 12's ``/debug/programz`` showed one new
+    record per repeat). Content-addressed dictionary identity
+    (``StringDictionary.content_key``) must make repeats hit: zero new
+    program records on a repeated distributed query."""
+
+    def test_content_key_semantics(self):
+        from pixie_tpu.types.strings import StringDictionary
+
+        a = StringDictionary(["x", "y"])
+        b = StringDictionary(["x", "y"])  # fresh object, equal content
+        assert a.content_key() == b.content_key()
+        assert a.content_key() == a.content_key()  # stable
+        # Order is identity: ids resolve differently.
+        c = StringDictionary(["y", "x"])
+        assert c.content_key() != a.content_key()
+        # Concatenation ambiguity is length-prefixed away.
+        d = StringDictionary(["xy"])
+        e = StringDictionary(["x", "y"])
+        assert d.content_key() != e.content_key()
+        # Growth re-keys (cached fragments resolved the old prefix);
+        # the incremental hash extends rather than restarts.
+        k2 = a.content_key()
+        a.get_or_add("z")
+        k3 = a.content_key()
+        assert k3 != k2
+        b.get_or_add("z")
+        assert b.content_key() == k3
+        # Empty dictionaries agree too.
+        assert (StringDictionary().content_key()
+                == StringDictionary().content_key())
+
+    def test_repeated_distributed_query_adds_no_programs(self):
+        """Acceptance: repeated distributed queries add ZERO new
+        merge-tier records to /debug/programz."""
+        from pixie_tpu.services import (
+            AgentTracker, KelvinAgent, MessageBus, PEMAgent, QueryBroker,
+        )
+
+        bus = MessageBus()
+        tracker = AgentTracker(bus, expiry_s=60.0, check_interval_s=60.0)
+        pems = [
+            PEMAgent(bus, f"pem-{i}", heartbeat_interval_s=30.0).start()
+            for i in range(2)
+        ]
+        kelvin = KelvinAgent(
+            bus, "kelvin-0", heartbeat_interval_s=30.0
+        ).start()
+        try:
+            n = 4000
+            for pem in pems:
+                pem.append_data("http_events", {
+                    "time_": np.arange(n, dtype=np.int64),
+                    "latency_ns": np.arange(n, dtype=np.int64) * 7 % 9973,
+                    "resp_status": np.full(n, 200, dtype=np.int64),
+                    "service": [f"svc-{i % 3}" for i in range(n)],
+                })
+                pem._register()
+            deadline = time.time() + 5
+            while time.time() < deadline and not tracker.schemas():
+                time.sleep(0.01)
+            broker = QueryBroker(bus, tracker)
+            # String group keys force dictionary-bearing bridge payloads
+            # through the merge agent — the exact path that recompiled.
+            q = (
+                "import px\n"
+                "df = px.DataFrame(table='http_events')\n"
+                "df = df.groupby('service').agg(\n"
+                "    n=('latency_ns', px.count),\n"
+                "    m=('latency_ns', px.mean))\n"
+                "px.display(df, 'out')\n"
+            )
+            res = broker.execute_script(q, timeout_s=30)  # warm: compiles
+            assert res["tables"]["out"].length == 3
+            reg = default_program_registry()
+            before = {r["program_id"] for r in reg.programz()["programs"]}
+            for _ in range(3):
+                res = broker.execute_script(q, timeout_s=30)
+                assert res["tables"]["out"].length == 3
+            after = {r["program_id"] for r in reg.programz()["programs"]}
+            assert after == before, (
+                f"repeated distributed query registered "
+                f"{len(after - before)} new program(s): "
+                f"{sorted(after - before)}"
+            )
+        finally:
+            for a in pems + [kelvin]:
+                a.stop()
+            broker.close()
+            tracker.close()
+            bus.close()
+
+
 class TestProfilerSweep:
     def test_single_lock_sweep_counts(self):
         from pixie_tpu.ingest.profiler import PerfProfilerConnector

@@ -274,6 +274,95 @@ def test_metrics_naming_rule(tmp_path):
     )
 
 
+def test_metrics_tenant_label_cardinality(tmp_path):
+    """ISSUE 13 satellite: {tenant}-labeled metrics are bounded-
+    cardinality — a ``.labels(tenant=...)`` value must visibly derive
+    from resolve_tenant() (or be DEFAULT_TENANT); raw client strings
+    and unresolved names are findings."""
+    report = _lint_src(
+        tmp_path, "tenantlbl.py",
+        """
+        from pixie_tpu.services.tenancy import DEFAULT_TENANT, resolve_tenant
+
+        def ok_direct(reg, raw):
+            reg.counter("pixie_x_total").labels(
+                tenant=resolve_tenant(raw)).inc()
+
+        def ok_bound(reg, raw):
+            tenant = resolve_tenant(raw)
+            reg.counter("pixie_x_total").labels(tenant=tenant).inc()
+
+        def ok_default(reg):
+            reg.counter("pixie_x_total").labels(
+                tenant=DEFAULT_TENANT).inc()
+
+        def bad_raw(reg, msg):
+            reg.counter("pixie_x_total").labels(
+                tenant=msg.get("tenant")).inc()
+
+        def bad_passthrough(reg, tenant):
+            reg.counter("pixie_x_total").labels(tenant=tenant).inc()
+
+        def bad_constant(reg):
+            reg.counter("pixie_x_total").labels(tenant="rando").inc()
+        """,
+        rules={"metrics-naming"},
+    )
+    bad = sorted(f.symbol for f in report.findings)
+    assert bad == ["bad_constant", "bad_passthrough", "bad_raw"], \
+        "\n".join(f.render() for f in report.findings)
+    assert all("resolve_tenant" in f.message for f in report.findings)
+
+
+def test_metrics_tenant_label_assignment_forms(tmp_path):
+    """Annotated and walrus assignments from resolve_tenant() bind the
+    name just like a plain assignment — correct code must not need a
+    baseline entry (false positives teach people to baseline)."""
+    report = _lint_src(
+        tmp_path, "tenantforms.py",
+        """
+        from pixie_tpu.services.tenancy import resolve_tenant
+
+        def ok_annotated(reg, raw):
+            tenant: str = resolve_tenant(raw)
+            reg.counter("pixie_x_total").labels(tenant=tenant).inc()
+
+        def ok_walrus(reg, raw):
+            if (t := resolve_tenant(raw)):
+                reg.counter("pixie_x_total").labels(tenant=t).inc()
+        """,
+        rules={"metrics-naming"},
+    )
+    assert report.findings == [], \
+        "\n".join(f.render() for f in report.findings)
+
+
+def test_metrics_tenant_label_module_scope_binding(tmp_path):
+    """A module-level resolved binding covers module-level label calls,
+    but one function's binding does NOT leak into another function
+    (scope boundaries are real, not whole-file grep)."""
+    report = _lint_src(
+        tmp_path, "tenantscope.py",
+        """
+        from pixie_tpu.services.tenancy import resolve_tenant
+
+        TEN = resolve_tenant("boot")
+        COUNTER.labels(tenant=TEN).inc()
+
+        def resolver_elsewhere(raw):
+            t = resolve_tenant(raw)
+            return t
+
+        def bad_other_scope(reg, t):
+            reg.counter("pixie_x_total").labels(tenant=t).inc()
+        """,
+        rules={"metrics-naming"},
+    )
+    bad = sorted(f.symbol for f in report.findings)
+    assert bad == ["bad_other_scope"], \
+        "\n".join(f.render() for f in report.findings)
+
+
 def test_lock_assigned_in_later_method_still_counts(tmp_path):
     # _worker is defined textually BEFORE the __init__ that creates the
     # lock; the class-wide lock pass must still see it.

@@ -371,6 +371,7 @@ def _seed_all_tables(eng, n=3000, seed=11):
         "time_": tm,
         "trace_id": [f"{i:032x}" for i in range(m)],
         "qid": [("", f"q{i % 5}")[i % 2] for i in range(m)],
+        "tenant": [("", "shared", "dash")[i % 3] for i in range(m)],
         "agent_id": [f"pem-{i % 3}" for i in range(m)],
         "kind": [("query", "fragment", "merge")[i % 3] for i in range(m)],
         "script_hash": [f"hash-{i % 4}" for i in range(m)],
