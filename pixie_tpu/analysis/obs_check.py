@@ -22,6 +22,9 @@ OBS_SCRIPTS = (
     # Device tier (PR 12): the program registry's __programs__ table
     # and the predicted-vs-observed calibration over __queries__.
     "px/program_cost", "px/bound_accuracy",
+    # Storage tier: cluster-merged table health + per-agent watermark
+    # lag over the __tables__ snapshots (TableStatsCollector fold).
+    "px/table_health", "px/ingest_lag",
 )
 
 

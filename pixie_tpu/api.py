@@ -55,6 +55,10 @@ class ScriptResults(dict):
         # Resolved tenant the broker admitted the query under
         # (services/tenancy.py; "shared" for unscoped callers).
         self.tenant: str | None = None
+        # Result staleness (storage-tier observability): worst scanned-
+        # table watermark lag across agents at execute time, ms. 0 =
+        # fresh or no time-indexed scan; None from pre-freshness brokers.
+        self.freshness_lag_ms: float | None = None
 
 
 class TableRecordHandler:
@@ -166,6 +170,7 @@ class Client:
         out.agent_stats = dict(res.get("agent_stats", {}))
         out.predicted_cost = res.get("predicted_cost")
         out.tenant = res.get("tenant")
+        out.freshness_lag_ms = res.get("freshness_lag_ms")
         for name, hb in sorted(res["tables"].items()):
             d = hb.to_pydict()
             out[name] = d

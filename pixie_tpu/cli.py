@@ -280,6 +280,17 @@ def _fmt_bytes(n) -> str:
     return f"{n:.1f}TB"
 
 
+def _fmt_lag(ms) -> str:
+    ms = float(ms or 0.0)
+    if ms <= 0:
+        return "-"
+    if ms < 1000:
+        return f"{ms:.0f}ms"
+    if ms < 3600_000:
+        return f"{ms / 1000:.1f}s"
+    return f"{ms / 3600_000:.1f}h"
+
+
 def cmd_debug(args) -> int:
     """`px debug queries`: recent query traces from the broker with
     per-query resource usage and per-agent attribution (the self-
@@ -295,7 +306,7 @@ def cmd_debug(args) -> int:
         return 0
     hdr = (f"{'qid':12s} {'tenant':8s} {'status':8s} {'ms':>9s} "
            f"{'rows':>9s} {'staged':>9s} {'pred':>9s} {'pred/obs':>8s} "
-           f"{'device':>9s} {'wire':>9s} agents")
+           f"{'device':>9s} {'wire':>9s} {'fresh':>9s} agents")
     print(hdr)
     for row in res["in_flight"] + rows:
         u = row.get("usage", {})
@@ -330,6 +341,9 @@ def cmd_debug(args) -> int:
             f"{ratio:>8s} "
             f"{u.get('device_ms', 0.0):>8.1f}ms "
             f"{_fmt_bytes(u.get('wire_bytes', 0)):>9s} "
+            # Result staleness: worst scanned-table watermark lag at
+            # execute time ("-" = no time-indexed scan recorded).
+            f"{_fmt_lag(u.get('freshness_lag_ms', 0.0)):>9s} "
             f"{','.join(agents)}"
         )
         if args.verbose:

@@ -416,3 +416,11 @@ define_flag(
     "Per-table byte budget (MB) for the self-telemetry tables; each "
     "table's ring expires its own oldest rows at the budget.",
 )
+define_flag(
+    "self_profiling", True,
+    "Deploy roles run the self-sampling perf profiler "
+    "(ingest/profiler.py): PEM/Kelvin agents fold their own Python "
+    "stacks into stack_traces.beta (px/perf_flamegraph-queryable); "
+    "the broker samples into a process-local table store surfaced via "
+    "its statusz. Off = no sampling thread work at all.",
+)

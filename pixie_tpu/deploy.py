@@ -50,11 +50,25 @@ def run_broker() -> int:
     runner.run_forever()
     netbus_port = int(os.environ.get("PIXIE_TPU_NETBUS_PORT", "6100"))
     server = BusServer(bus, host="0.0.0.0", port=netbus_port)
+    # Broker self-profiling (self_profiling flag): the broker has no
+    # agent engine, so its stacks land in a process-local TableStore
+    # (not cluster-queryable — the PEM/Kelvin profilers cover the
+    # query path) surfaced through statusz below.
+    prof_store, prof_coll = _self_profiler("broker")
+    statusz_extra = (
+        (lambda: {"profiler": {
+            "stacks": prof_store.get_table("stack_traces.beta").num_rows
+            if prof_store.get_table("stack_traces.beta") else 0,
+            "collector": dict(prof_coll.stats),
+        }})
+        if prof_coll is not None else (lambda: {})
+    )
     obs = ObservabilityServer(
         statusz_fn=lambda: {
             "agents": tracker.agents_info(),
             "tables": sorted(tracker.schemas()),
             "quarantined": tracker.quarantined(),
+            **statusz_extra(),
         },
         # Broker-side distributed-query traces (dispatch/retry/failover
         # spans) back /debug/queryz on this role; the cluster-stitched
@@ -62,6 +76,12 @@ def run_broker() -> int:
         tracer=broker.tracer,
         trace_view=broker.trace_view,
         programs=_program_registry(),
+        # Cluster-merged storage-tier snapshot: watermark = max across
+        # agents, counters summed, per-agent lag spread.
+        tablez_fn=lambda: {
+            "scope": "cluster",
+            "tables": tracker.table_freshness(),
+        },
     )
     obs_port = obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "6101")))
     print(
@@ -105,13 +125,16 @@ def run_pem() -> int:
     from .ingest.profiler import PerfProfilerConnector
     from .services.agent import PEMAgent
 
+    from .config import get_flag
+
     host, port = _broker_addr()
     bus = _dial_broker(host, port)
     agent = PEMAgent(bus, _agent_id("pem")).start()
     coll = Collector()
     coll.wire_to(agent)
     coll.register_source(ProcessStatsConnector())
-    coll.register_source(PerfProfilerConnector(pod=_agent_id("pem")))
+    if get_flag("self_profiling"):
+        coll.register_source(PerfProfilerConnector(pod=_agent_id("pem")))
     coll.register_source(ProcStatConnector())
     coll.register_source(PIDRuntimeConnector())
     coll.register_source(ProcExitConnector())
@@ -129,11 +152,21 @@ def run_pem() -> int:
 
 
 def run_kelvin() -> int:
+    from .config import get_flag
     from .services.agent import KelvinAgent
 
     host, port = _broker_addr()
     bus = _dial_broker(host, port)
     agent = KelvinAgent(bus, _agent_id("kelvin")).start()
+    if get_flag("self_profiling"):
+        # The kelvin's own collector thread (Agent.start ran it) drains
+        # the profiler into its local stack_traces.beta — merge-tier
+        # stacks are queryable through the agent's own engine/queryz.
+        from .ingest.profiler import PerfProfilerConnector
+
+        agent.collector.register_source(
+            PerfProfilerConnector(pod=_agent_id("kelvin"))
+        )
     obs = _agent_obs(agent)
     print(
         f"[kelvin] {agent.agent_id} -> {host}:{port} obs :{obs}", flush=True
@@ -170,8 +203,37 @@ def _agent_obs(agent, extra=None) -> int:
         # scrape through the default monitor's collector (installed by
         # the engine).
         programs=_program_registry(),
+        # Storage tier: this agent's local freshness snapshot (the
+        # broker's /debug/tablez serves the cluster merge).
+        tablez_fn=lambda: {
+            "scope": "agent",
+            "agent_id": agent.agent_id,
+            "tables": agent.engine.table_store.freshness(),
+        },
     )
     return obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "0")))
+
+
+def _self_profiler(role: str):
+    """Broker-role self-profiling (``self_profiling`` flag): a
+    Collector + PerfProfilerConnector sampling this process into a
+    local TableStore. Returns (store, collector) or (None, None) when
+    the flag is off. Agent roles don't use this — their profiler rides
+    the agent's own collector into the queryable table store."""
+    from .config import get_flag
+
+    if not get_flag("self_profiling"):
+        return None, None
+    from .ingest.collector import Collector
+    from .ingest.profiler import PerfProfilerConnector
+    from .table_store import TableStore
+
+    store = TableStore()
+    coll = Collector()
+    coll.wire_to(store)
+    coll.register_source(PerfProfilerConnector(pod=role))
+    coll.run_as_thread()
+    return store, coll
 
 
 def _program_registry():

@@ -500,6 +500,7 @@ class Engine:
                     raise QueryError(f"no table named {op.table!r}")
                 # Tablets share relation + string dictionaries (enforced by
                 # TableStore); a query scans all of them.
+                self._note_scan_freshness(op, tablets)
                 base = next((t for t in tablets if len(t.relation)), tablets[0])
                 chain = []
                 if op.columns is not None:
@@ -644,6 +645,25 @@ class Engine:
                 if not pure_scan:
                     results[nid] = self._materialize(st)
         return outputs
+
+    def _note_scan_freshness(self, op, tablets) -> None:
+        """Stamp result staleness for one table scan onto the query's
+        trace: the scan's stop-time (or now, for unbounded scans) minus
+        the max event-time watermark across the table's tablets. Host
+        attribute reads only — no backend lock, no device work."""
+        qstats = self._query_stats
+        trace = getattr(qstats, "trace", None) if qstats is not None else None
+        if trace is None:
+            return
+        wm = -1
+        for t in tablets:
+            w = t.watermark_ns
+            if w is not None and w > wm:
+                wm = w
+        if wm < 0:
+            return  # no time index / nothing appended: no signal
+        ref = op.stop_time if op.stop_time is not None else time.time_ns()
+        trace.note_freshness_lag(op.table, (int(ref) - wm) / 1e6)
 
     def export_otel(self, payload: dict, endpoint) -> None:
         """OTel egress. Default: collect in-memory (``otel_exports``);

@@ -73,6 +73,9 @@ PXLINT_HOT_REGIONS = (
     # bookkeeping, so the fold must stay pure host-list arithmetic.
     "services/telemetry.py:TelemetryCollector*",
     "services/telemetry.py:ClusterTraceView*",
+    # Storage-tier fold (__tables__): runs per finished trace on the
+    # query thread and per heartbeat — host-counter arithmetic only.
+    "services/telemetry.py:TableStatsCollector*",
     # Resource accounting on the trace spine: _finalize_usage and the
     # per-window stage/add paths run per query/window with the same
     # no-sync contract.

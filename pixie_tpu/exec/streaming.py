@@ -331,10 +331,28 @@ class StreamingQuery:
         self._state = None
         self._compile()
 
+    def _note_freshness(self) -> None:
+        """Stamp this poll's staleness (now minus the source table's max
+        event-time watermark) on the stream's trace: the usage field
+        keeps the worst round — a live view that fell behind its ingest
+        shows its backlog in __queries__ like any one-shot query."""
+        if self.trace is None:
+            return
+        wm = -1
+        for t in self.tablets:
+            w = getattr(t, "watermark_ns", None)
+            if w is not None and w > wm:
+                wm = w
+        if wm >= 0:
+            self.trace.note_freshness_lag(
+                self.chain.source.table, (time.time_ns() - wm) / 1e6
+            )
+
     def poll(self) -> int:
         """Fold new rows; emit updates. Returns rows consumed."""
         frag = self._frag
         rows = 0
+        self._note_freshness()
         if self.chain.bridge_id is not None:
             return self._poll_bridge(frag)
         if self.chain.is_agg:

@@ -116,6 +116,12 @@ class QueryResourceUsage:
       while the query ran (``exec/programs.py`` DeviceMemoryMonitor;
       TPU-real, 0 on backends whose ``memory_stats()`` is None).
       Merges by MAX across agents — it is a watermark, not a volume.
+    - ``freshness_lag_ms`` result staleness: query stop-time minus the
+      max event-time watermark of each scanned table at execute time,
+      worst table kept (0 = fresh or no time-indexed scan). Merges by
+      MAX across agents — the answer is only as fresh as the most
+      behind shard. The validity predicate a result cache keyed on
+      (script hash, table watermark) would check.
     """
 
     rows_in: int = 0
@@ -129,10 +135,12 @@ class QueryResourceUsage:
     retries: int = 0
     skipped_windows: int = 0
     device_peak_bytes: int = 0
+    freshness_lag_ms: float = 0.0
 
     def to_dict(self) -> dict:
         d = asdict(self)
-        for k in ("device_ms", "compile_ms", "stall_ms"):
+        for k in ("device_ms", "compile_ms", "stall_ms",
+                  "freshness_lag_ms"):
             d[k] = round(d[k], 3)
         return d
 
@@ -151,6 +159,11 @@ class QueryResourceUsage:
         # double-count under addition.
         self.device_peak_bytes = max(
             self.device_peak_bytes, int(d.get("device_peak_bytes", 0))
+        )
+        # Staleness too: the merged answer is only as fresh as the most
+        # behind agent's shard.
+        self.freshness_lag_ms = max(
+            self.freshness_lag_ms, float(d.get("freshness_lag_ms", 0.0))
         )
 
 
@@ -319,6 +332,9 @@ class QueryTrace:
         # was PREDICTED to stage/ship at plan time. The broker stamps
         # it; `px debug queries` renders predicted vs observed.
         self.predicted: dict | None = None
+        # Per-scanned-table staleness detail ({table: lag_ms} at scan
+        # setup; usage.freshness_lag_ms keeps the worst) — queryz rows.
+        self.freshness: dict = {}
         self.exported = False  # OTLP push succeeded (ring-drop counting)
         self.dropped_spans = 0
         self._lock = threading.Lock()
@@ -340,6 +356,20 @@ class QueryTrace:
         """Account bridge egress bytes (BridgeSinkOp payloads)."""
         with self._lock:
             self.usage.wire_bytes += int(n)
+
+    def note_freshness_lag(self, table: str, lag_ms: float) -> None:
+        """Record one scanned table's staleness (query stop-time minus
+        its max event-time watermark at scan setup): the usage field
+        keeps the WORST table/round, ``self.freshness`` the per-table
+        detail (/debug/queryz). Bounded: one key per scanned table."""
+        lag_ms = max(0.0, float(lag_ms))
+        with self._lock:
+            self.usage.freshness_lag_ms = max(
+                self.usage.freshness_lag_ms, lag_ms
+            )
+            self.freshness[table] = max(
+                self.freshness.get(table, 0.0), lag_ms
+            )
 
     # -- span plumbing -------------------------------------------------------
     def _new_span(self, name: str, parent: Span | None) -> Span:
@@ -463,6 +493,12 @@ class QueryTrace:
             d["agent_usage"] = dict(self.agent_usage)
         if self.predicted:
             d["predicted"] = dict(self.predicted)
+        if self.freshness:
+            # dict() snapshot first: queryz renders in-flight traces
+            # while the query thread may still note scans.
+            d["freshness"] = {
+                t: round(v, 3) for t, v in dict(self.freshness).items()
+            }
         if self.parent_ctx:
             d["parent"] = dict(self.parent_ctx)
         if self.error:

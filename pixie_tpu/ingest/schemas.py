@@ -283,6 +283,12 @@ QUERIES_RELATION = Relation(
         ("device_peak_bytes", DataType.INT64),
         ("predicted_bytes", DataType.INT64),
         ("predicted_rows", DataType.INT64),
+        # Storage-tier staleness: query stop-time minus the max event-
+        # time watermark of the scanned tables at execute time (worst
+        # table; max across agents for distributed queries). 0 = fully
+        # fresh OR no time-indexed scan — the exact validity predicate
+        # a result cache keyed on (script hash, table watermark) checks.
+        ("freshness_lag_ms", DataType.FLOAT64),
     ]
 )
 
@@ -323,6 +329,36 @@ PROGRAMS_RELATION = Relation(
     ]
 )
 
+# Storage-tier snapshots (services/telemetry.py TableStatsCollector):
+# one row per (agent, table) whose stats CHANGED since the collector's
+# previous fold — heartbeat cadence + every finished trace. The
+# *_total columns are monotonic (latest row per (agent_id, table) is
+# current state; cluster merges sum them across agents), `watermark`
+# is the max event-time ns ever appended (never regresses; cluster
+# merges take the max), live sizes (rows/bytes/...) are gauges.
+# Reference analog: the table stats every agent heartbeat ships
+# (``table_store.h`` GetTableStats -> agent heartbeat proto).
+TABLES_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("agent_id", DataType.STRING),
+        ("table", DataType.STRING),
+        ("rows", DataType.INT64),  # live rows
+        ("bytes", DataType.INT64),  # live bytes (hot + cold)
+        ("hot_bytes", DataType.INT64),
+        ("cold_bytes", DataType.INT64),
+        ("device_bytes", DataType.INT64),  # HBM-resident staged windows
+        ("rows_total", DataType.INT64),  # rows ever appended
+        ("bytes_total", DataType.INT64),
+        ("expired_rows_total", DataType.INT64),
+        ("expired_bytes_total", DataType.INT64),
+        ("watermark", DataType.INT64),  # max event-time ns (-1 = none)
+        ("min_time", DataType.INT64),  # oldest live event-time ns
+        ("last_append", DataType.INT64),  # unix ns of latest append
+        ("ingest_rows_per_s", DataType.FLOAT64),  # per-append EWMA
+    ]
+)
+
 # One row per finished trace: the folding agent's running totals (the
 # latest row per agent_id is its current health snapshot).
 AGENTS_RELATION = Relation(
@@ -344,6 +380,7 @@ TELEMETRY_SCHEMAS: dict[str, "Relation"] = {
     "__spans__": SPANS_RELATION,
     "__agents__": AGENTS_RELATION,
     "__programs__": PROGRAMS_RELATION,
+    "__tables__": TABLES_RELATION,
 }
 
 # dns_table.h kDNSTable (subset).

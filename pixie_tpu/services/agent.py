@@ -175,12 +175,26 @@ class Agent:
 
     def _heartbeat_loop(self):
         while not self._stop.wait(self.heartbeat_interval_s):
+            # ONE freshness sweep per heartbeat, shared by the storage-
+            # tier fold and the envelope: the fold is forced (a row per
+            # table per heartbeat, the reference's stats-on-every-
+            # heartbeat shape) so a STOPPED ingest still advances fold
+            # time past its frozen watermark — px/ingest_lag's signal.
+            # Ring-bounded; the per-trace fold stays change-cursored so
+            # query load can't multiply rows.
+            fresh = self.engine.table_store.freshness()
+            tel = getattr(self, "telemetry", None)
+            if tel is not None:
+                try:
+                    tel.table_stats.fold(force=True, snapshot=fresh)
+                except Exception:
+                    pass  # telemetry must never kill the heartbeat loop
             self.bus.publish(
                 TOPIC_HEARTBEAT,
                 {
                     "agent_id": self.agent_id,
                     "schemas": self._schemas(),
-                    "table_stats": self._table_stats(),
+                    "table_stats": self._table_stats(freshness=fresh),
                 },
             )
 
@@ -194,15 +208,27 @@ class Agent:
             if t is not None and len(t.relation)
         }
 
-    def _table_stats(self) -> dict:
-        """Ingest-sketch summaries for the tracker ({table: {rows, ndv,
-        zones}}): the broker-side seed for pxbound predicted costs and
-        the planner's NDV sizing. Microseconds per column — the
-        sketches were maintained at append time; the per-engine
-        __observed__ feedback stays local (script hashes are engine-
-        scoped history, not cluster state)."""
+    def _table_stats(self, freshness: dict | None = None) -> dict:
+        """Ingest-sketch summaries + freshness for the tracker
+        ({table: {rows, ndv, zones, freshness}}): the sketch half is
+        the broker-side seed for pxbound predicted costs and the
+        planner's NDV sizing; the ``freshness`` sub-dict (watermarks,
+        monotonic append/expiry counters, ingest-rate EWMA — see
+        ``Table.freshness``) is what ``AgentTracker.table_stats()``
+        merges cluster-wide for /debug/tablez. Tables without sketches
+        ship a freshness-only entry WITHOUT a "rows" key — pxbound
+        treats a missing "rows" as unbounded, so an unsketched table
+        never gets a bogus known-zero row bound. Microseconds per
+        column — everything was maintained at append time; the
+        per-engine __observed__ feedback stays local (script hashes
+        are engine-scoped history, not cluster state). ``freshness``
+        lets the heartbeat loop reuse its already-taken sweep."""
         stats = self.engine._compile_table_stats()
         stats.pop("__observed__", None)
+        if freshness is None:
+            freshness = self.engine.table_store.freshness()
+        for name, fresh in freshness.items():
+            stats.setdefault(name, {})["freshness"] = fresh
         return stats
 
     # -- data push (Stirling's RegisterDataPushCallback target) --------------
@@ -542,7 +568,15 @@ class Agent:
         keep = pm["keep"]
         bridge_inputs = {}
         for bid, contributions in pm["got"].items():
-            payloads = [p for (a, p) in contributions
+            # Canonical agent-id order (not arrival order): the merge
+            # re-encodes later payloads' string ids into the FIRST
+            # payload's dictionary, so arrival-ordered payloads made the
+            # merged dictionary CONTENTS depend on bus scheduling — and
+            # the content-keyed fragment cache then compiled one XLA
+            # program per observed ordering. Merge folds are
+            # commutative; ordering by agent id costs one sort of a
+            # handful of tuples.
+            payloads = [p for (a, p) in sorted(contributions)
                         if keep is None or a in keep]
             if payloads:
                 bridge_inputs[bid] = payloads
@@ -817,7 +851,11 @@ class Agent:
                     st["dirty"] = False
                     plan = st["plan"]
                     by_bridge: dict = {}
-                    for (bid, _aid), p in st["latest"].items():
+                    # Canonical (bridge, agent) order — same dictionary-
+                    # content determinism as the one-shot merge path.
+                    for (bid, _aid), p in sorted(
+                        st["latest"].items(), key=lambda kv: kv[0]
+                    ):
                         if p is not None:
                             by_bridge.setdefault(bid, []).append(p)
                 if by_bridge:

@@ -26,6 +26,12 @@ class LoadReport:
     sheds: int = 0
     errors_by_type: dict = field(default_factory=dict)
     latencies_s: list = field(default_factory=list)
+    # Worst result staleness any query of the run reported
+    # (freshness_lag_ms: the same number the agents fold into the
+    # __queries__ column — worst scanned-table watermark lag at execute
+    # time). A staleness regression (ingest stalling under load, a PEM
+    # falling behind) shows up here even when latency holds.
+    max_freshness_lag_ms: float = 0.0
     wall_s: float = 0.0
     # Per-run view through the SERVER's pixie_query_duration_seconds
     # histogram (the tracer records every finished query there): the
@@ -64,6 +70,7 @@ class LoadReport:
             "p50_ms": round(self.percentile(50) * 1e3, 2),
             "p95_ms": round(self.percentile(95) * 1e3, 2),
             "p99_ms": round(self.percentile(99) * 1e3, 2),
+            "max_freshness_lag_ms": round(self.max_freshness_lag_ms, 1),
             "wall_s": round(self.wall_s, 2),
         }
         if self.hist_quantiles_s is not None:
@@ -98,9 +105,16 @@ def _worker_loop(execute, query: str, per_worker: int, timeout_s: float,
         err = None
         shed = False
         partial = False
+        fresh_ms = 0.0
         try:
             res = execute(query, timeout_s, **kw)
             partial = bool(isinstance(res, dict) and res.get("partial"))
+            # Broker replies carry the staleness as a dict key;
+            # api.ScriptResults (a dict of TABLES) as an attribute.
+            v = res.get("freshness_lag_ms") if isinstance(res, dict) else None
+            if v is None:
+                v = getattr(res, "freshness_lag_ms", None)
+            fresh_ms = float(v or 0.0)
         except Exception as e:
             err = type(e).__name__
             # The admission scheduler's structured deadline shed (never
@@ -111,6 +125,9 @@ def _worker_loop(execute, query: str, per_worker: int, timeout_s: float,
             report.queries += 1
             if err is None:
                 report.latencies_s.append(dt)
+                report.max_freshness_lag_ms = max(
+                    report.max_freshness_lag_ms, fresh_ms
+                )
                 if partial:
                     report.partials += 1
             else:

@@ -355,7 +355,7 @@ class ObservabilityServer:
 
     def __init__(self, registry: MetricsRegistry | None = None,
                  statusz_fn=None, health_fn=None, tracer=None,
-                 trace_view=None, programs=None):
+                 trace_view=None, programs=None, tablez_fn=None):
         self.registry = registry or default_registry
         self.statusz_fn = statusz_fn  # () -> dict
         self.health_fn = health_fn  # () -> (bool, str)
@@ -367,6 +367,11 @@ class ObservabilityServer:
         # /debug/programz — the compiled-program registry (per-program
         # compile wall-time, XLA cost/memory analysis, hit counts).
         self.programs = programs
+        # () -> dict | None: wire one to serve /debug/tablez — the
+        # storage-tier freshness snapshot (an agent serves its local
+        # TableStore.freshness(); a broker serves the tracker's
+        # cluster merge — watermark max, counters summed, lag spread).
+        self.tablez_fn = tablez_fn
         self._httpd = None
 
     def handle(self, path: str) -> tuple[int, str, str]:
@@ -402,6 +407,11 @@ class ObservabilityServer:
                 indent=1,
                 default=str,
             )
+            return (200, "application/json", body)
+        if path == "/debug/tablez":
+            if self.tablez_fn is None:
+                return (404, "text/plain", "no table stats wired\n")
+            body = json.dumps(self.tablez_fn(), indent=1, default=str)
             return (200, "application/json", body)
         if path == "/debug/programz":
             if self.programs is None:
@@ -462,16 +472,45 @@ def engine_collector(engine):
     (table_metrics.h / pem_manager.h:63 node-memory gauges analog)."""
 
     def collect(reg: MetricsRegistry) -> None:
+        import time as _time
+
         from ..table_store.device_cache import total_resident_bytes
 
         g_rows = reg.gauge("pixie_table_rows", "Rows resident per table")
         g_bytes = reg.gauge("pixie_table_bytes", "Bytes resident per table")
+        # Storage-tier freshness (monotonic counters rendered as gauges
+        # set to the counter value at scrape — the pipeline-totals
+        # idiom; `table` label cardinality is bounded by the process's
+        # created-table set, like pixie_table_rows above).
+        g_rows_t = reg.gauge(
+            "pixie_table_rows_total", "Rows ever appended per table"
+        )
+        g_bytes_t = reg.gauge(
+            "pixie_table_bytes_total", "Bytes ever appended per table"
+        )
+        g_exp_t = reg.gauge(
+            "pixie_table_expired_bytes_total",
+            "Bytes dropped by ring expiry per table",
+        )
+        g_lag = reg.gauge(
+            "pixie_table_watermark_lag_seconds",
+            "Now minus the max event-time watermark per table "
+            "(ingest staleness; absent without a time index)",
+        )
+        now_ns = _time.time_ns()
         for name, t in engine.tables.items():
             if t is None:
                 continue
             st = t.stats()
             g_rows.labels(table=name).set(st.num_rows)
             g_bytes.labels(table=name).set(st.bytes)
+            g_rows_t.labels(table=name).set(st.rows_added)
+            g_bytes_t.labels(table=name).set(st.bytes_added)
+            g_exp_t.labels(table=name).set(st.bytes_expired)
+            if st.watermark >= 0:
+                g_lag.labels(table=name).set(
+                    round((now_ns - st.watermark) / 1e9, 3)
+                )
         reg.gauge(
             "pixie_device_cache_bytes",
             "Device-resident window bytes (all tables)",

@@ -1425,6 +1425,12 @@ class QueryBroker:
                 agent_usage[aid] = dict(u)
                 trace.usage.merge(u)
         trace.agent_usage = agent_usage
+        # Result staleness (storage tier): the worst scanned-table
+        # watermark lag any agent reported — how stale this answer is,
+        # the validity predicate a result cache would check.
+        result["freshness_lag_ms"] = round(
+            trace.usage.freshness_lag_ms, 3
+        )
         if mutation_states is not None:
             result["mutations"] = mutation_states
         return result
@@ -1664,6 +1670,7 @@ class QueryBroker:
                     "mutations": res.get("mutations"),
                     "predicted_cost": res.get("predicted_cost"),
                     "tenant": res.get("tenant"),
+                    "freshness_lag_ms": res.get("freshness_lag_ms"),
                 })
             except Exception as e:  # errors cross the wire as data
                 _reply(msg, {"ok": False, "error": f"{type(e).__name__}: {e}"})

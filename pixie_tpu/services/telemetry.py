@@ -90,6 +90,105 @@ def _span_summaries(trace) -> list:
     return out
 
 
+class TableStatsCollector:
+    """Folds table-store freshness snapshots into ``__tables__``.
+
+    One row per (agent, table) whose stats CHANGED since this
+    collector's previous fold (a change cursor, like the ``__programs__``
+    drain: an idle table contributes zero rows however often the fold
+    runs). Fired from two cadences — every finished trace (so a query
+    immediately sees current storage state in its own history) and the
+    agent heartbeat loop (so a query-less ingesting agent still records
+    its watermark advance). ``__tables__`` itself is excluded: folding
+    it would make every fold a change (each fold appends to it), one
+    self-perpetuating row per fold forever on an idle system.
+
+    Host-only arithmetic over already-maintained counters (registered
+    in ``PXLINT_HOT_REGIONS`` alongside the trace fold); the lock
+    serializes the cursor against concurrent trace listeners +
+    heartbeat threads.
+    """
+
+    def __init__(self, engine, agent_id: str = "engine"):
+        self.engine = engine
+        self.agent_id = agent_id
+        self._lock = threading.Lock()
+        self._last: dict = {}  # table -> change signature tuple
+
+    @staticmethod
+    def _signature(f: dict) -> tuple:
+        """What 'changed' means: any counter/watermark/size movement.
+        ``last_append``/EWMA excluded on purpose — they only move when a
+        counter does, and including wall-clock would defeat the cursor."""
+        return (
+            f["rows_total"], f["expired_rows_total"], f["bytes_total"],
+            f["expired_bytes_total"], f["watermark"], f["device_bytes"],
+            f["hot_bytes"],
+        )
+
+    def fold(self, end_ns: int | None = None, force: bool = False,
+             snapshot: dict | None = None) -> int:
+        """Append a ``__tables__`` row per changed table (every table
+        when ``force`` — the heartbeat cadence, matching the reference's
+        stats-on-every-heartbeat: an idle table's row still advances
+        ``time_`` past its frozen watermark, which is exactly how
+        px/ingest_lag sees a STOPPED ingest as growing lag). The
+        change-cursored (per-trace) form covers USER tables only: the
+        fold pass itself just appended to ``__queries__``/``__spans__``,
+        so dunder tables are "changed" on every finished trace — rows
+        for them at query rate would let self-telemetry snapshots evict
+        the user-table history out of the ring; they fold at the
+        bounded heartbeat cadence instead. ``snapshot`` lets the
+        heartbeat reuse one ``TableStore.freshness()`` sweep for both
+        the fold and the envelope. Returns the row count."""
+        end_ns = end_ns or time.time_ns()
+        snap = dict(
+            snapshot if snapshot is not None
+            else self.engine.table_store.freshness()
+        )
+        snap.pop("__tables__", None)
+        with self._lock:
+            changed = {
+                name: f for name, f in snap.items()
+                if (force or not name.startswith("__"))
+                and (force or self._last.get(name) != self._signature(f))
+            }
+            if not changed:
+                return 0
+            names = sorted(changed)
+            rows = [changed[n] for n in names]
+            n = len(names)
+            self.engine.append_data("__tables__", {
+                "time_": [end_ns] * n,
+                "agent_id": [self.agent_id] * n,
+                "table": names,
+                "rows": [f["rows"] for f in rows],
+                "bytes": [f["bytes"] for f in rows],
+                "hot_bytes": [f["hot_bytes"] for f in rows],
+                "cold_bytes": [f["cold_bytes"] for f in rows],
+                "device_bytes": [f["device_bytes"] for f in rows],
+                "rows_total": [f["rows_total"] for f in rows],
+                "bytes_total": [f["bytes_total"] for f in rows],
+                "expired_rows_total": [
+                    f["expired_rows_total"] for f in rows
+                ],
+                "expired_bytes_total": [
+                    f["expired_bytes_total"] for f in rows
+                ],
+                "watermark": [f["watermark"] for f in rows],
+                "min_time": [f["min_time"] for f in rows],
+                "last_append": [f["last_append"] for f in rows],
+                "ingest_rows_per_s": [
+                    float(f["ingest_rows_per_s"]) for f in rows
+                ],
+            })
+            # Commit the cursor only after a successful append (the
+            # __programs__ contract: a raising ring must not eat rows).
+            for name, f in changed.items():
+                self._last[name] = self._signature(f)
+            return n
+
+
 class TelemetryCollector:
     """Folds one engine's finished traces into its own table store."""
 
@@ -99,6 +198,9 @@ class TelemetryCollector:
         self.agent_id = agent_id
         self.kind = kind
         self.bus = bus
+        # Storage-tier fold (``__tables__``): shared with the agent
+        # heartbeat loop, which calls table_stats.fold() on its cadence.
+        self.table_stats = TableStatsCollector(engine, agent_id)
         self._lock = threading.Lock()
         self._totals = {
             "queries": 0, "errors": 0, "bytes_staged": 0,
@@ -172,9 +274,11 @@ class TelemetryCollector:
             # calibration scripts filter on > 0.
             "predicted_bytes": [int(pred_bytes or 0)],
             "predicted_rows": [int(pred_rows or 0)],
+            "freshness_lag_ms": [float(u.freshness_lag_ms)],
         })
         self.engine.append_data("__spans__", _span_rows(trace, agent, end_ns))
         self._fold_programs(end_ns)
+        self.table_stats.fold(end_ns)
         with self._lock:
             t = self._totals
             t["queries"] += 1

@@ -286,38 +286,85 @@ class AgentTracker:
         return out
 
     def table_stats(self) -> dict:
-        """Cluster-wide ingest-sketch summary: per table, rows SUMMED
-        across agents (each agent holds a disjoint shard), per-column
-        NDV summed (an upper bound — per-agent HLL registers don't
-        cross the heartbeat, so exact merge isn't available here) and
-        zone bounds unioned. Feeds the broker's CompilerState so
-        pxbound's predicted costs (and the planner's NDV sizing) work
-        cluster-wide, not just engine-locally."""
+        """Cluster-wide per-table summary, merged with per-field
+        semantics (each agent holds a disjoint shard):
+
+        - sketch fields — ``rows`` summed, per-column NDV summed (an
+          upper bound: per-agent HLL registers don't cross the
+          heartbeat, so the sums can't dedup values shared between
+          agents), zone bounds unioned. Emitted only when at least one
+          agent actually shipped sketch data for the table: a table
+          known only through its freshness record must stay UNBOUNDED
+          to pxbound (a synthesized ``rows: 0`` would be an unsound
+          known-zero bound).
+        - ``freshness`` — monotonic counters (``rows_total``,
+          ``bytes_total``, ``expired_*``) and live sizes SUM, the
+          event-time ``watermark`` and ``last_append`` take the MAX,
+          ``min_time`` the min; plus ``agents`` (contributing agent
+          count) and ``watermark_spread_ns`` (max - min of per-agent
+          watermarks — the "which PEM is behind" lag spread).
+
+        Feeds the broker's CompilerState so pxbound's predicted costs
+        (and the planner's NDV sizing) work cluster-wide, and
+        ``/debug/tablez`` + the bundled storage scripts cluster-merged.
+        """
+        from ..table_store.table_store import merge_freshness
+
         out: dict = {}
+        agent_wms: dict[str, list] = {}  # table -> per-agent watermarks
         with self._lock:
             records = [rec.table_stats for rec in self._agents.values()]
         for stats in records:
             for table, st in (stats or {}).items():
                 if not isinstance(st, dict):
                     continue
-                cur = out.setdefault(
-                    table, {"rows": 0, "ndv": {}, "zones": {}}
+                cur = out.setdefault(table, {})
+                if "rows" in st:
+                    cur.setdefault("rows", 0)
+                    cur.setdefault("ndv", {})
+                    cur.setdefault("zones", {})
+                    cur["rows"] += int(st.get("rows", 0) or 0)
+                    for c, v in (st.get("ndv") or {}).items():
+                        cur["ndv"][c] = cur["ndv"].get(c, 0) + int(v)
+                    for c, z in (st.get("zones") or {}).items():
+                        lo, hi = z[0], z[1]
+                        if c in cur["zones"]:
+                            plo, phi = cur["zones"][c]
+                            lo, hi = min(plo, lo), max(phi, hi)
+                        cur["zones"][c] = (lo, hi)
+                fresh = st.get("freshness")
+                if isinstance(fresh, dict):
+                    cur["freshness"] = merge_freshness(
+                        cur.get("freshness"), fresh
+                    )
+                    cur["freshness"]["agents"] = (
+                        cur["freshness"].get("agents", 0) + 1
+                    )
+                    wm = int(fresh.get("watermark", -1))
+                    if wm >= 0:
+                        agent_wms.setdefault(table, []).append(wm)
+        for table, st in out.items():
+            if "ndv" in st:
+                # NDV can never exceed the row count.
+                st["ndv"] = {
+                    c: min(v, st["rows"])
+                    for c, v in st["ndv"].items() if v
+                }
+            wms = agent_wms.get(table)
+            if wms and "freshness" in st:
+                st["freshness"]["watermark_spread_ns"] = (
+                    max(wms) - min(wms)
                 )
-                cur["rows"] += int(st.get("rows", 0) or 0)
-                for c, v in (st.get("ndv") or {}).items():
-                    cur["ndv"][c] = cur["ndv"].get(c, 0) + int(v)
-                for c, z in (st.get("zones") or {}).items():
-                    lo, hi = z[0], z[1]
-                    if c in cur["zones"]:
-                        plo, phi = cur["zones"][c]
-                        lo, hi = min(plo, lo), max(phi, hi)
-                    cur["zones"][c] = (lo, hi)
-        for st in out.values():
-            # NDV can never exceed the row count.
-            st["ndv"] = {
-                c: min(v, st["rows"]) for c, v in st["ndv"].items() if v
-            }
         return out
+
+    def table_freshness(self) -> dict:
+        """{table: merged freshness} view of :meth:`table_stats` — the
+        ``/debug/tablez`` payload on a broker."""
+        return {
+            table: st["freshness"]
+            for table, st in self.table_stats().items()
+            if "freshness" in st
+        }
 
     def agent_ids(self) -> list[str]:
         with self._lock:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
 from dataclasses import dataclass
 from typing import Iterable, Optional, Sequence
 
@@ -35,6 +36,11 @@ from ..types.strings import StringDictionary
 TIME_COLUMN = "time_"
 DEFAULT_COMPACTED_ROWS = 64 * 1024
 
+#: EWMA smoothing factor for the per-append ingest rate: ~the last five
+#: appends dominate, so the rate reflects the current push cadence
+#: rather than table-lifetime throughput.
+INGEST_EWMA_ALPHA = 0.2
+
 
 @dataclass
 class TableStats:
@@ -48,6 +54,21 @@ class TableStats:
     compacted_batches: int
     min_time: int
     num_rows: int
+    # -- freshness surface (storage-tier observability) ----------------------
+    # Derived/maintained OUTSIDE the backend stats buffer (the native ABI
+    # stays 10 slots): monotonic append/expiry counters come from the
+    # backend's existing row-id space (row ids are never reused, so
+    # end_row_id == rows ever appended and first_row_id == rows expired),
+    # the watermark from the col_stats bounds the append path already
+    # maintains, and the wall-clock/EWMA fields from two attribute writes
+    # per append. Defaults let bare positional constructions keep working.
+    rows_added: int = 0  # rows ever appended (monotonic)
+    rows_expired: int = 0  # rows dropped by ring expiry (monotonic)
+    bytes_expired: int = 0  # bytes_added - live bytes (monotonic)
+    watermark: int = -1  # max event-time ns ever appended (never regresses)
+    last_append_unix_ns: int = 0  # wall time of the latest append
+    ingest_rows_per_s: float = 0.0  # per-append EWMA ingest rate
+    device_bytes: int = 0  # device-resident (HBM) staged window bytes
 
 
 @dataclass(frozen=True)
@@ -435,6 +456,15 @@ class Table:
         # eager-aggregation sizing (PAPERS.md 2102.02440). Gated by the
         # ingest_sketches flag; None until the first sketched append.
         self.sketches = None
+        # Freshness bookkeeping (storage-tier observability): wall time
+        # of the latest append + a per-append ingest-rate EWMA. Plain
+        # attribute writes on the push path — same unlocked-wrapper
+        # convention as col_stats/sketches above (the backend holds the
+        # only append-path lock); readers snapshot via stats().
+        self._last_append_unix_ns = 0
+        self._last_append_mono = None
+        self._last_append_rows = 0
+        self._ingest_ewma = 0.0
         if len(self.relation):
             self._init_backend()
 
@@ -528,13 +558,23 @@ class Table:
                 )
         times = cols[TIME_COLUMN][0] if (TIME_COLUMN, 0) == self._plane_layout[0] else None
         rid = self._backend.append(planes, times)
+        if rid >= 0:
+            self._note_append_freshness(hb.length)
         from ..config import get_flag
 
-        if get_flag("ingest_sketches") and rid >= 0:
+        if (
+            get_flag("ingest_sketches") and rid >= 0
+            and not self.name.startswith("__")
+        ):
             # Per-column NDV/zone-map sketches for join routing: the
             # single-plane INT64 columns col_stats already bounds, plus
             # dictionary string code planes (their ids ARE the join key
             # space). time_ is skipped — the time index supersedes it.
+            # Dunder telemetry tables are excluded: they are never join
+            # build sides, their bounds path is the documented
+            # sketch-less fallback, and sketching a dozen INT64 columns
+            # per __tables__/__queries__ fold row taxed every finished
+            # trace AND bloated the bounds-memo stats key.
             if self.sketches is None:
                 from .sketches import TableSketches
 
@@ -553,6 +593,22 @@ class Table:
             # device_put is async) so queries find them resident.
             self.stage_resident()
         return hb
+
+    def _note_append_freshness(self, n: int) -> None:
+        """Freshness bookkeeping per appended batch: two clock reads +
+        EWMA arithmetic (the watermark itself is the ``time_`` col_stats
+        bound append already maintains — no extra min/max pass). A
+        separate method so the append-overhead A/B test can strip
+        exactly this addition."""
+        self._last_append_unix_ns = time.time_ns()
+        self._last_append_rows = n
+        mono = time.monotonic()
+        prev, self._last_append_mono = self._last_append_mono, mono
+        if prev is not None and mono > prev:
+            rate = n / (mono - prev)
+            self._ingest_ewma += (
+                INGEST_EWMA_ALPHA * (rate - self._ingest_ewma)
+            )
 
     def compact(self) -> int:
         """CompactHotToCold analog; call periodically (service loop)."""
@@ -694,7 +750,68 @@ class Table:
     def num_rows(self) -> int:
         return self.stats().num_rows if self._backend is not None else 0
 
+    @property
+    def watermark_ns(self):
+        """Max event-time ns ever appended (None without a time index).
+        Monotonic by construction — ring expiry never regresses it."""
+        st = self.col_stats.get(TIME_COLUMN)
+        return st[1] if st is not None else None
+
     def stats(self) -> TableStats:
+        """Snapshot of the backend counters + the freshness surface.
+        The backend half is one locked stats() read; the row-id counters
+        are two more locked reads (row ids are never reused, so
+        end_row_id == rows ever appended and first_row_id == rows
+        expired) — under concurrent appends the trio can straddle a
+        batch, so exact cross-field reconciliation holds at quiesce."""
         if self._backend is None:
             return TableStats(0, 0, 0, 0, 0, 0, 0, 0, -1, 0)
-        return TableStats(*self._backend.stats())
+        be = self._backend
+        st = TableStats(*be.stats())
+        st.rows_added = be.end_row_id()
+        st.rows_expired = be.first_row_id()
+        st.bytes_expired = st.bytes_added - st.bytes
+        wm = self.watermark_ns
+        st.watermark = wm if wm is not None else -1
+        st.last_append_unix_ns = self._last_append_unix_ns
+        st.ingest_rows_per_s = self._current_ingest_rate()
+        dc = self._device_cache
+        st.device_bytes = dc.nbytes if dc is not None else 0
+        return st
+
+    def _current_ingest_rate(self) -> float:
+        """The EWMA, decayed at READ time: the EWMA itself only moves on
+        appends, so a STOPPED ingest would report its last healthy rate
+        forever. Capping at last-batch-rows / silence-elapsed decays the
+        reported rate toward 0 as the silence grows, while an actively
+        appending table (elapsed <= its inter-append interval) reports
+        the EWMA unchanged."""
+        last = self._last_append_mono
+        if last is None:
+            return 0.0
+        elapsed = time.monotonic() - last
+        if elapsed <= 0:
+            return self._ingest_ewma
+        return min(self._ingest_ewma, self._last_append_rows / elapsed)
+
+    def freshness(self) -> dict:
+        """Wire form of the freshness surface (agent heartbeat envelope
+        + ``__tables__`` telemetry fold): live sizes, monotonic append/
+        expiry counters, the event-time watermark pair, wall time of the
+        last append and the ingest-rate EWMA."""
+        st = self.stats()
+        return {
+            "rows": st.num_rows,
+            "bytes": st.bytes,
+            "hot_bytes": st.hot_bytes,
+            "cold_bytes": st.cold_bytes,
+            "device_bytes": st.device_bytes,
+            "rows_total": st.rows_added,
+            "bytes_total": st.bytes_added,
+            "expired_rows_total": st.rows_expired,
+            "expired_bytes_total": st.bytes_expired,
+            "watermark": st.watermark,
+            "min_time": st.min_time,
+            "last_append": st.last_append_unix_ns,
+            "ingest_rows_per_s": round(st.ingest_rows_per_s, 3),
+        }

@@ -171,3 +171,47 @@ class TableStore:
         if not tablets:
             return None
         return next(iter(tablets.values())).relation
+
+    def freshness(self) -> dict:
+        """{table: merged freshness dict} across each table's tablets —
+        the per-AGENT half of the cluster merge the tracker performs:
+        monotonic counters and live sizes sum, watermarks/last-append
+        take the max, min_time the min (tablets of one logical table
+        are disjoint row shards)."""
+        out: dict = {}
+        for name in self.table_names():
+            merged = None
+            for t in self.tablets(name):
+                if t._backend is None:
+                    continue
+                merged = merge_freshness(merged, t.freshness())
+            if merged is not None:
+                out[name] = merged
+        return out
+
+
+#: Freshness keys that merge by summation (live sizes + monotonic
+#: counters over disjoint shards); the rest are watermark-style.
+_FRESHNESS_SUM_KEYS = (
+    "rows", "bytes", "hot_bytes", "cold_bytes", "device_bytes",
+    "rows_total", "bytes_total", "expired_rows_total",
+    "expired_bytes_total", "ingest_rows_per_s",
+)
+
+
+def merge_freshness(into: dict | None, fresh: dict) -> dict:
+    """Fold one tablet/agent freshness record into an accumulator
+    (shared by TableStore.freshness and the tracker's cluster merge):
+    sums for counters, max for ``watermark``/``last_append``, min for
+    ``min_time`` (-1 = no live rows, ignored)."""
+    if into is None:
+        return dict(fresh)
+    for k in _FRESHNESS_SUM_KEYS:
+        into[k] = into.get(k, 0) + fresh.get(k, 0)
+    for k in ("watermark", "last_append"):
+        into[k] = max(into.get(k, -1), fresh.get(k, -1))
+    mt_a, mt_b = into.get("min_time", -1), fresh.get("min_time", -1)
+    into["min_time"] = (
+        mt_b if mt_a < 0 else (mt_a if mt_b < 0 else min(mt_a, mt_b))
+    )
+    return into

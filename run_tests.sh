@@ -45,16 +45,19 @@
 #   ./run_tests.sh --obs               self-observability gate: the
 #                                      self-telemetry + trace-stitching
 #                                      + device-tier program-registry
-#                                      suites (tests/test_telemetry.py,
+#                                      + storage-tier suites
+#                                      (tests/test_telemetry.py,
 #                                      tests/test_trace_stitching.py,
-#                                      tests/test_programs.py)
+#                                      tests/test_programs.py,
+#                                      tests/test_table_obs.py)
 #                                      plus plan-verifier compilation of
 #                                      the bundled self-monitoring PxL
 #                                      scripts against the telemetry
 #                                      table schemas (see
 #                                      pixie_tpu/analysis/obs_check.py;
-#                                      now incl. px/program_cost and
-#                                      px/bound_accuracy).
+#                                      incl. px/program_cost,
+#                                      px/bound_accuracy,
+#                                      px/table_health, px/ingest_lag).
 #                                      The script-compile half also runs
 #                                      inside --tier1.
 #   ./run_tests.sh --tenancy           multi-tenant overload gate: the
@@ -88,7 +91,8 @@ case "$1" in
       python -m pixie_tpu.analysis.obs_check || rc=$?
     env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python -m pytest -q tests/test_telemetry.py \
-      tests/test_trace_stitching.py tests/test_programs.py "$@" || rc=$?
+      tests/test_trace_stitching.py tests/test_programs.py \
+      tests/test_table_obs.py "$@" || rc=$?
     exit $rc
     ;;
   --tenancy)

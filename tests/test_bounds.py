@@ -444,8 +444,16 @@ class TestAdmission:
                 "service": [f"svc-{i % 4}" for i in range(n)],
             })
             pem._register()  # ship post-ingest schemas + table stats
+
+            def _sketched():
+                # Wait for the POST-ingest register specifically: the
+                # startup register already populates table_stats with
+                # freshness-only entries (no "rows" key).
+                st = tracker.table_stats().get("http_events")
+                return st is not None and st.get("rows") == n
+
             deadline = time.time() + 5
-            while time.time() < deadline and not tracker.table_stats():
+            while time.time() < deadline and not _sketched():
                 time.sleep(0.01)
             assert tracker.table_stats()["http_events"]["rows"] == n
             broker = QueryBroker(bus, tracker)

@@ -397,6 +397,34 @@ def _seed_all_tables(eng, n=3000, seed=11):
             (0, int(r) * 2)[i % 4 > 0]
             for i, r in enumerate(rng.integers(1, 10**6, m))
         ],
+        "freshness_lag_ms": rng.uniform(0, 2000, m),
+    })
+    # Storage-tier snapshots (TableStatsCollector fold shape): a few
+    # rows per (agent, table) with monotonic counters and advancing
+    # watermarks so px/table_health and px/ingest_lag have rows.
+    rows = []
+    for agent in ("pem-0", "pem-1"):
+        for table, wm0 in (("http_events", 10**9), ("conn_stats", 2 * 10**9)):
+            for step in range(3):
+                rows.append((agent, table, step, wm0))
+    k = len(rows)
+    eng.append_data("__tables__", {
+        "time_": np.arange(k, dtype=np.int64) * 10**6,
+        "agent_id": [r[0] for r in rows],
+        "table": [r[1] for r in rows],
+        "rows": [1000 * (r[2] + 1) for r in rows],
+        "bytes": [64_000 * (r[2] + 1) for r in rows],
+        "hot_bytes": [32_000 * (r[2] + 1) for r in rows],
+        "cold_bytes": [32_000 * (r[2] + 1) for r in rows],
+        "device_bytes": [16_000 * r[2] for r in rows],
+        "rows_total": [2000 * (r[2] + 1) for r in rows],
+        "bytes_total": [128_000 * (r[2] + 1) for r in rows],
+        "expired_rows_total": [1000 * r[2] for r in rows],
+        "expired_bytes_total": [64_000 * r[2] for r in rows],
+        "watermark": [r[3] + r[2] * 10**8 for r in rows],
+        "min_time": [r[3] for r in rows],
+        "last_append": [r[3] + r[2] * 10**8 for r in rows],
+        "ingest_rows_per_s": [1000.0 + 10 * r[2] for r in rows],
     })
     eng.append_data("__spans__", {
         "time_": tm,
