@@ -23,6 +23,20 @@ Rules:
   context (``Thread(target=...)`` entry methods and bus
   ``subscribe`` callbacks, transitively through same-class calls) and
   from a public method, with at least one side not holding a lock.
+- ``lock-order``: whole-tree interprocedural lock-acquisition graph —
+  ``with self.<lock>`` nesting tracked transitively through same-class
+  ``self.m()`` calls and cross-module ``self.attr.m()`` calls (attr
+  types inferred from ``self.attr = ClassName(...)`` assignments); any
+  cycle in the (class, lock-attr) order graph is a potential deadlock,
+  reported with both acquisition chains. Re-acquiring a non-reentrant
+  lock already held on the path (directly or through a call chain) is
+  a certain self-deadlock and is reported too.
+- ``request-from-handler``: a bus ``subscribe`` callback that
+  (transitively through same-class calls and nested defs) issues a
+  blocking ``bus.request``/``RemoteBus.request`` — the dispatcher
+  thread blocks for the reply, and if the responder (or the reply
+  inbox) is served by this same dispatcher the handler self-deadlocks
+  until the timeout (the PR 3 netbus-race shape).
 - ``metrics-naming``: metric names registered via
   ``.counter/.gauge/.histogram`` must match ``^pixie_[a-z0-9_]+$``
   and must not end in a Prometheus histogram-series suffix.
@@ -608,6 +622,683 @@ class ThreadSharedStateRule:
                               method=method))
 
 
+# -- rule: lock-order ---------------------------------------------------------
+
+#: Lock constructors that are reentrant for the acquiring thread. A bare
+#: ``Condition()`` wraps a fresh RLock; ``Condition(self._lock)`` takes
+#: the wrapped lock's reentrancy (aliased in ``_LockClassInfo``).
+_REENTRANT_CTORS = frozenset({"RLock"})
+
+
+@dataclass
+class _LockClassInfo:
+    name: str
+    relpath: str
+    qualname: str
+    bases: list = field(default_factory=list)  # simple base-class names
+    lock_ctors: dict = field(default_factory=dict)  # attr -> ctor name
+    # Condition(self._x) shares _x's underlying lock: both attrs are ONE
+    # lock node in the order graph.
+    lock_aliases: dict = field(default_factory=dict)  # attr -> attr
+    attr_types: dict = field(default_factory=dict)  # attr -> class name
+    # method -> [(held, kind, data, line)]: held = ((attr, line), ...)
+    # for this method's enclosing `with self.<attr>` scopes; kind is
+    # "acquire" (data = attr) or "call" (data = ("self", m) |
+    # ("attr", (attr, m))).
+    methods: dict = field(default_factory=dict)
+
+
+def _parse_lock_class(ctx: "FileCtx", cls: ast.ClassDef) -> _LockClassInfo:
+    info = _LockClassInfo(
+        name=cls.name, relpath=ctx.relpath, qualname=ctx.qualname(cls),
+    )
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            info.bases.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            info.bases.append(b.attr)
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        vf = node.value.func
+        ctor = (
+            vf.attr if isinstance(vf, ast.Attribute)
+            else vf.id if isinstance(vf, ast.Name) else None
+        )
+        if ctor is None:
+            continue
+        for t in node.targets:
+            a = _self_attr(t)
+            if a is None:
+                continue
+            if ctor in _LOCK_CTORS:
+                info.lock_ctors[a] = ctor
+                if ctor == "Condition" and node.value.args:
+                    wrapped = _self_attr(node.value.args[0])
+                    if wrapped is not None:
+                        info.lock_aliases[a] = wrapped
+            elif ctor[:1].isupper():
+                # Type inference seed: self.X = ClassName(...).
+                info.attr_types.setdefault(a, ctor)
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            actions: list = []
+            _scan_lock_actions(item, (), actions)
+            info.methods[item.name] = actions
+            _infer_param_attr_types(item, info.attr_types)
+    return info
+
+
+def _ann_name(ann) -> str | None:
+    """Simple class name from an annotation node ('Engine',
+    'exec.engine.Engine', '"Engine"', 'Engine | None')."""
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.split(".")[-1].strip() or None
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        return _ann_name(ann.left) or _ann_name(ann.right)
+    if isinstance(ann, ast.Subscript):  # Optional[X]
+        return _ann_name(ann.slice)
+    return None
+
+
+def _infer_param_attr_types(fn, attr_types: dict) -> None:
+    """``self.X = param`` where the param carries a class annotation
+    (and ``self.X: Cls = ...``) seed the cross-module call resolution —
+    the ``self.bus = bus`` constructor-injection idiom."""
+    params = {}
+    args = fn.args
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        name = _ann_name(a.annotation) if a.annotation is not None else None
+        if name is not None and name[:1].isupper():
+            params[a.arg] = name
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Name):
+            t = params.get(node.value.id)
+            if t is None:
+                continue
+            for tgt in node.targets:
+                a = _self_attr(tgt)
+                if a is not None:
+                    attr_types.setdefault(a, t)
+        elif isinstance(node, ast.AnnAssign):
+            a = _self_attr(node.target)
+            t = _ann_name(node.annotation)
+            if a is not None and t is not None and t[:1].isupper():
+                attr_types.setdefault(a, t)
+
+
+def _scan_lock_actions(node, held, out):
+    """Collect acquire/call actions with the enclosing held-lock set.
+    ``held`` is a tuple of (attr, line) for ``with self.<attr>`` scopes
+    currently open in THIS method (filtered to real lock attrs later)."""
+    if isinstance(node, ast.With):
+        inner = held
+        for item in node.items:
+            _scan_lock_actions(item.context_expr, inner, out)
+            a = _self_attr(item.context_expr)
+            if a is not None:
+                out.append((inner, "acquire", a, item.context_expr.lineno))
+                inner = inner + ((a, item.context_expr.lineno),)
+        for child in node.body:
+            _scan_lock_actions(child, inner, out)
+        return
+    if isinstance(node, ast.Call):
+        f = node.func
+        a = _self_attr(f)
+        if a is not None:
+            out.append((held, "call", ("self", a), node.lineno))
+        elif (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Attribute)
+        ):
+            recv = _self_attr(f.value)
+            if recv is not None:
+                out.append(
+                    (held, "call", ("attr", (recv, f.attr)), node.lineno)
+                )
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue  # nested bodies run on a later call, not here
+        _scan_lock_actions(child, held, out)
+
+
+class LockOrderRule:
+    """Whole-program lock-order verification.
+
+    Nodes are (defining class, lock attr); an edge A -> B is recorded
+    whenever code may acquire B while holding A — directly via nested
+    ``with self.<lock>`` scopes, or transitively through same-class
+    ``self.m()`` and typed cross-class ``self.attr.m()`` calls. A cycle
+    means two threads taking the locks in opposite orders can deadlock;
+    the diagnostic carries one acquisition chain per edge. Re-acquiring
+    a held non-reentrant lock is reported as a certain self-deadlock.
+
+    Static blind spots (covered by the runtime validator,
+    ``analysis/lockdep.py``): locks stored in containers/locals,
+    ``.acquire()`` calls without a ``with``, duck-typed receivers, and
+    cross-instance aliasing of one class's lock attr."""
+
+    name = "lock-order"
+    description = (
+        "cycle in the interprocedural (class, lock-attr) acquisition-"
+        "order graph, or a held non-reentrant lock re-acquired on the "
+        "same path — a potential deadlock"
+    )
+
+    def __init__(self):
+        self._by_path: dict = {}
+
+    # -- whole-program analysis (prepare) -------------------------------------
+    def prepare(self, ctxs, repo_root=None):
+        classes: dict[str, list] = {}
+        for ctx in ctxs:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ClassDef):
+                    classes.setdefault(node.name, []).append(
+                        _parse_lock_class(ctx, node)
+                    )
+        self._classes = classes
+        self._lockmap_memo: dict = {}
+        self._methodmap_memo: dict = {}
+        self._reach_memo: dict = {}
+        edges: dict = {}  # (hkey, akey) -> evidence dict
+        self_deadlocks: dict = {}  # dedup key -> finding
+        for infos in classes.values():
+            for info in infos:
+                self._class_edges(info, edges, self_deadlocks)
+        findings = list(self_deadlocks.values())
+        findings.extend(self._cycle_findings(edges))
+        self._by_path = {}
+        for f in findings:
+            self._by_path.setdefault(f.path, []).append(f)
+
+    def check(self, ctx: FileCtx):
+        yield from self._by_path.get(ctx.relpath, ())
+
+    # -- class/attr resolution ------------------------------------------------
+    def _resolve_class(self, name: str):
+        infos = self._classes.get(name)
+        # Ambiguous simple names (two modules, one class name) stay
+        # unresolved: merging them would invent cross-module edges.
+        return infos[0] if infos and len(infos) == 1 else None
+
+    def _mro(self, info: _LockClassInfo) -> list:
+        out, seen = [], set()
+        frontier = [info]
+        while frontier:
+            c = frontier.pop(0)
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            out.append(c)
+            for b in c.bases:
+                bc = self._resolve_class(b)
+                if bc is not None:
+                    frontier.append(bc)
+        return out
+
+    def _lockmap(self, info: _LockClassInfo) -> dict:
+        """attr -> ((relpath, class, attr) node key, reentrant) over the
+        class and its resolvable bases, own declarations first.
+        ``Condition(self._x)`` aliases to ``_x``'s node (the two attrs
+        are ONE underlying lock) — resolved through the MRO, so a
+        subclass Condition wrapping a base-class lock still collapses
+        onto the base lock's node and takes ITS reentrancy."""
+        key = (info.relpath, info.qualname)
+        hit = self._lockmap_memo.get(key)
+        if hit is not None:
+            return hit
+        # attr -> (defining class, ctor, alias target) — own-first.
+        decl: dict = {}
+        for c in self._mro(info):
+            for a, ctor in c.lock_ctors.items():
+                if a not in decl:
+                    decl[a] = (c, ctor, c.lock_aliases.get(a))
+        out: dict = {}
+        for a, (c, ctor, alias) in decl.items():
+            if alias is not None and alias in decl:
+                tc, tctor, _ = decl[alias]
+                out[a] = (
+                    (tc.relpath, tc.name, alias),
+                    tctor in _REENTRANT_CTORS
+                    or tctor == "Condition",  # bare Condition = RLock
+                )
+            else:
+                # Own node. A bare Condition() wraps a fresh RLock
+                # (reentrant); a Condition over an UNKNOWN lock (ctor
+                # param, container) cannot be analyzed — treat as
+                # reentrant so it never false-positives a self-nest.
+                reentrant = (
+                    ctor in _REENTRANT_CTORS or ctor == "Condition"
+                )
+                out[a] = ((c.relpath, c.name, a), reentrant)
+        self._lockmap_memo[key] = out
+        return out
+
+    def _methodmap(self, info: _LockClassInfo) -> dict:
+        key = (info.relpath, info.qualname)
+        hit = self._methodmap_memo.get(key)
+        if hit is not None:
+            return hit
+        out: dict = {}
+        for c in self._mro(info):
+            for m, actions in c.methods.items():
+                out.setdefault(m, (c, actions))
+        self._methodmap_memo[key] = out
+        return out
+
+    def _attr_type(self, info: _LockClassInfo, attr: str):
+        for c in self._mro(info):
+            t = c.attr_types.get(attr)
+            if t is not None:
+                return self._resolve_class(t)
+        return None
+
+    def _resolve_call(self, info: _LockClassInfo, data):
+        """(receiver class info, method name) for a call action, or
+        None when the receiver/method cannot be resolved statically."""
+        kind, payload = data
+        if kind == "self":
+            return (info, payload) if payload in self._methodmap(info) \
+                else None
+        attr, m = payload
+        target = self._attr_type(info, attr)
+        if target is not None and m in self._methodmap(target):
+            return (target, m)
+        return None
+
+    # -- interprocedural acquisition summaries --------------------------------
+    def _reach(self, info: _LockClassInfo, method: str,
+               stack: frozenset = frozenset()) -> dict:
+        """{lock node key: (reentrant, chain)} of every lock a call to
+        ``info.method`` may acquire, transitively. ``chain`` is a tuple
+        of "Class.method" steps ending at the acquiring method."""
+        key = (info.relpath, info.qualname, method)
+        hit = self._reach_memo.get(key)
+        if hit is not None:
+            return hit
+        if key in stack:
+            return {}
+        stack = stack | {key}
+        entry = self._methodmap(info).get(method)
+        if entry is None:
+            return {}
+        owner, actions = entry
+        lm = self._lockmap(info)
+        out: dict = {}
+        step = f"{info.name}.{method}"
+        for _held, kind, data, _line in actions:
+            if kind == "acquire":
+                node = lm.get(data)
+                if node is not None:
+                    out.setdefault(node[0], (node[1], (step,)))
+            else:
+                callee = self._resolve_call(info, data)
+                if callee is None:
+                    continue
+                for k, (reent, chain) in self._reach(
+                    callee[0], callee[1], stack
+                ).items():
+                    if k not in out and len(chain) < 8:
+                        out[k] = (reent, (step,) + chain)
+        self._reach_memo[key] = out
+        return out
+
+    # -- edge + finding generation --------------------------------------------
+    @staticmethod
+    def _lock_name(node_key) -> str:
+        return f"{node_key[1]}.{node_key[2]}"
+
+    def _class_edges(self, info, edges, self_deadlocks):
+        lm = self._lockmap(info)
+        for method, (owner, actions) in self._methodmap(info).items():
+            symbol = f"{info.qualname}.{method}"
+            for held, kind, data, line in actions:
+                held_nodes = [
+                    (lm[a][0], hl) for a, hl in held if a in lm
+                ]
+                if not held_nodes:
+                    continue
+                if kind == "acquire":
+                    node = lm.get(data)
+                    targets = (
+                        {node[0]: (node[1], (f"{info.name}.{method}",))}
+                        if node is not None else {}
+                    )
+                else:
+                    callee = self._resolve_call(info, data)
+                    if callee is None:
+                        continue
+                    targets = {
+                        k: (reent,
+                            (f"{info.name}.{method} -> "
+                             f"{callee[0].name}.{callee[1]}",) + ch[1:])
+                        for k, (reent, ch) in self._reach(
+                            callee[0], callee[1]
+                        ).items()
+                    }
+                for k, (reent, chain) in targets.items():
+                    for h, _hline in held_nodes:
+                        if h == k:
+                            if reent:
+                                continue
+                            dk = (owner.relpath, symbol, k)
+                            if dk not in self_deadlocks:
+                                self_deadlocks[dk] = Finding(
+                                    rule=self.name,
+                                    path=owner.relpath,
+                                    line=line,
+                                    message=(
+                                        f"non-reentrant lock "
+                                        f"{self._lock_name(k)} re-"
+                                        f"acquired while held (via "
+                                        f"{' -> '.join(chain)}) — "
+                                        "certain self-deadlock"
+                                    ),
+                                    symbol=symbol,
+                                )
+                            continue
+                        edges.setdefault((h, k), {
+                            "path": owner.relpath, "line": line,
+                            "symbol": symbol, "chain": chain,
+                        })
+
+    def _cycle_findings(self, edges) -> list:
+        adj: dict = {}
+        for (h, k) in edges:
+            adj.setdefault(h, set()).add(k)
+        findings = []
+        for cycle in self._cycles(adj):
+            # Canonical rotation: start at the smallest node so the
+            # finding (and its baseline key) is order-stable.
+            i = cycle.index(min(cycle))
+            cycle = cycle[i:] + cycle[:i]
+            names = [self._lock_name(n) for n in cycle]
+            parts = []
+            for j, n in enumerate(cycle):
+                nxt = cycle[(j + 1) % len(cycle)]
+                ev = edges[(n, nxt)]
+                parts.append(
+                    f"{self._lock_name(n)} -> {self._lock_name(nxt)} "
+                    f"via {' -> '.join(ev['chain'])}"
+                )
+            first = edges[(cycle[0], cycle[1 % len(cycle)])]
+            findings.append(Finding(
+                rule=self.name,
+                path=first["path"],
+                line=first["line"],
+                message=(
+                    "potential deadlock: lock-order cycle "
+                    + " -> ".join(names + [names[0]])
+                    + " [" + "; ".join(parts) + "]"
+                ),
+                symbol=first["symbol"],
+            ))
+        findings.sort(key=lambda f: (f.path, f.message))
+        return findings
+
+    @staticmethod
+    def _cycles(adj) -> list:
+        """One shortest cycle per strongly-connected component (Tarjan;
+        fixing any edge of it re-exposes whatever remains)."""
+        index: dict = {}
+        low: dict = {}
+        on: set = set()
+        order: list = []
+        sccs: list = []
+        counter = [0]
+
+        def strongconnect(v):
+            work = [(v, iter(sorted(adj.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            order.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        order.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(adj.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = order.pop()
+                        on.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(set(scc))
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+
+        cycles = []
+        for scc in sccs:
+            # BFS from the smallest node back to itself inside the SCC.
+            start = min(scc)
+            parent = {start: None}
+            frontier = [start]
+            found = None
+            while frontier and found is None:
+                nxt = []
+                for u in frontier:
+                    for w in sorted(adj.get(u, ())):
+                        if w == start:
+                            found = u
+                            break
+                        if w in scc and w not in parent:
+                            parent[w] = u
+                            nxt.append(w)
+                    if found is not None:
+                        break
+                frontier = nxt
+            if found is None:
+                continue
+            path = [found]
+            while parent[path[-1]] is not None:
+                path.append(parent[path[-1]])
+            cycles.append(list(reversed(path)))
+        return cycles
+
+
+# -- rule: request-from-handler -----------------------------------------------
+
+def _bus_recv_name(f) -> str | None:
+    """Receiver of a ``.request`` call when it looks like a message bus
+    (``*bus`` / ``RemoteBus``) — shared with blocking-call-under-lock."""
+    if not (isinstance(f, ast.Attribute) and f.attr == "request"):
+        return None
+    recv = f.value
+    name = (
+        recv.id if isinstance(recv, ast.Name)
+        else recv.attr if isinstance(recv, ast.Attribute)
+        else None
+    )
+    if name is not None and (
+        name == "RemoteBus" or name.lstrip("_").endswith("bus")
+    ):
+        return name
+    return None
+
+
+class RequestFromHandlerRule:
+    """A bus ``subscribe`` callback that issues a blocking
+    ``bus.request`` (directly, through same-class ``self.m()`` calls,
+    or through nested defs of the registering method). The callback
+    runs on its subscription's dispatcher thread; ``request`` blocks
+    that thread up to its timeout — and when the responder (or the
+    one-shot reply inbox) is dispatched by the same thread, the handler
+    deadlocks outright until the timeout (the netbus close-vs-read-loop
+    race PR 3 fixed came from this shape). Move the request onto a
+    worker thread, or reply asynchronously."""
+
+    name = "request-from-handler"
+    description = (
+        "blocking bus.request/RemoteBus.request reachable from a bus "
+        "subscribe callback — the dispatcher thread blocks on a reply "
+        "it may itself have to dispatch (self-deadlock shape)"
+    )
+
+    def prepare(self, ctxs, repo_root=None):
+        pass
+
+    def check(self, ctx: FileCtx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(self, ctx: FileCtx, cls: ast.ClassDef):
+        methods = {
+            item.name: item
+            for item in cls.body
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        entries: list = []  # (entry label, start node kind)
+        for mname, fn in methods.items():
+            nested = {
+                n.name: n for n in ast.walk(fn)
+                if isinstance(n, ast.FunctionDef) and n is not fn
+            }
+
+            def register(arg, _m=mname, _nested=nested):
+                a = _self_attr(arg)
+                if a is not None:
+                    entries.append((a, ("method", a)))
+                elif isinstance(arg, ast.Name) and arg.id in _nested:
+                    entries.append(
+                        (f"{_m}.<{arg.id}>", ("nested", (_m, arg.id)))
+                    )
+                elif isinstance(arg, ast.Call):
+                    for inner in list(arg.args) + [
+                        kw.value for kw in arg.keywords
+                    ]:
+                        register(inner)
+
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "subscribe"
+                ):
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        register(arg)
+        if not entries:
+            return
+        reported: set = set()
+        for label, start in entries:
+            for site in self._reachable_requests(ctx, cls, methods, start):
+                key = (site[0], site[1])
+                if key in reported:
+                    continue
+                reported.add(key)
+                yield Finding(
+                    rule=self.name,
+                    path=ctx.relpath,
+                    line=site[0],
+                    message=(
+                        f"{site[2]}.request() blocks the subscribe "
+                        f"callback {label!r}'s dispatcher thread "
+                        "(self-deadlock if the reply routes through "
+                        "this dispatcher) — move the request off the "
+                        "handler"
+                    ),
+                    symbol=site[1],
+                )
+
+    @staticmethod
+    def _walk_scoped(root):
+        """Walk ``root``'s body WITHOUT descending into nested defs —
+        a nested def's body runs only when CALLED (the explicit
+        ``nested`` frontier models that), not where it is defined."""
+        stack = list(ast.iter_child_nodes(root))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _reachable_requests(self, ctx, cls, methods, start):
+        """(line, symbol, recv) request sites reachable from ``start``
+        through same-class self-calls and CALLED nested defs of the
+        enclosing method (a nested def that is merely defined — e.g.
+        handed to a worker thread — is not a dispatcher-thread site)."""
+        sites: list = []
+        seen: set = set()
+        frontier = [start]
+        while frontier:
+            kind, payload = frontier.pop()
+            if (kind, payload) in seen:
+                continue
+            seen.add((kind, payload))
+            if kind == "method":
+                mname = payload
+                fn = methods.get(mname)
+                if fn is None:
+                    continue
+                body, qual = fn, f"{ctx.qualname(cls)}.{mname}"
+            else:
+                mname, nname = payload
+                fn = methods.get(mname)
+                if fn is None:
+                    continue
+                body = next(
+                    (n for n in ast.walk(fn)
+                     if isinstance(n, ast.FunctionDef) and n is not fn
+                     and n.name == nname),
+                    None,
+                )
+                if body is None:
+                    continue
+                qual = f"{ctx.qualname(cls)}.{mname}.{nname}"
+            nested_names = {
+                n.name for n in ast.walk(fn)
+                if isinstance(n, ast.FunctionDef) and n is not fn
+            }
+            for node in self._walk_scoped(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                recv = _bus_recv_name(node.func)
+                if recv is not None:
+                    sites.append((node.lineno, qual, recv))
+                a = _self_attr(node.func)
+                if a is not None and a in methods:
+                    frontier.append(("method", a))
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in nested_names
+                    and not (kind == "nested"
+                             and node.func.id == payload[1])
+                ):
+                    frontier.append(("nested", (mname, node.func.id)))
+        return sites
+
+
 # -- rule: blocking-call-under-lock -------------------------------------------
 
 class BlockingCallUnderLockRule:
@@ -623,9 +1314,10 @@ class BlockingCallUnderLockRule:
 
     name = "blocking-call-under-lock"
     description = (
-        "bus.request/block_until_ready/.item() while holding a "
-        "`with self.<lock>` — a blocking round trip inside a critical "
-        "section (deadlock-prone; serializes other threads)"
+        "bus.request/block_until_ready/.item()/time.sleep/timeout-less "
+        "queue get-put while holding a `with self.<lock>` — a blocking "
+        "call inside a critical section (deadlock-prone; serializes "
+        "other threads)"
     )
 
     def prepare(self, ctxs, repo_root=None):
@@ -697,26 +1389,57 @@ class BlockingCallUnderLockRule:
         f = node.func
         if not isinstance(f, ast.Attribute):
             return None
-        if f.attr == "request":
-            # bus.request / self.bus.request / self._bus.request /
-            # RemoteBus.request — the message-bus request/reply round
-            # trip. Receiver must look like a bus so `requests`-style
-            # libraries don't false-positive.
+        # bus.request / self.bus.request / self._bus.request /
+        # RemoteBus.request — the message-bus request/reply round trip.
+        # Receiver must look like a bus so `requests`-style libraries
+        # don't false-positive.
+        bus = _bus_recv_name(f)
+        if bus is not None:
+            return f"{bus}.request() (blocks up to its timeout)"
+        if f.attr == "block_until_ready":
+            return "block_until_ready() (device fence)"
+        if f.attr == "item" and not node.args:
+            return ".item() (device-to-host readback)"
+        if (
+            f.attr == "sleep"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "time"
+        ):
+            return "time.sleep() (unconditional stall)"
+        if f.attr in ("get", "put"):
+            # Timeout-less Queue.get blocks forever on an empty queue,
+            # and put on a full bounded one — inside a critical section
+            # that is a deadlock waiting for its producer/consumer to
+            # need the same lock. Receiver must look like a queue
+            # (q / _q / *queue / *_q) so dict.get etc. don't
+            # false-positive; any positional arg or timeout/block
+            # keyword makes get non-blocking-or-bounded.
             recv = f.value
             name = (
                 recv.id if isinstance(recv, ast.Name)
                 else recv.attr if isinstance(recv, ast.Attribute)
                 else None
             )
-            if name is not None and (
-                name == "RemoteBus" or name.lstrip("_").endswith("bus")
-            ):
-                return f"{name}.request() (blocks up to its timeout)"
-            return None
-        if f.attr == "block_until_ready":
-            return "block_until_ready() (device fence)"
-        if f.attr == "item" and not node.args:
-            return ".item() (device-to-host readback)"
+            if name is None:
+                return None
+            base = name.lstrip("_").lower()
+            queueish = (
+                base in ("q", "queue", "inbox")
+                or base.endswith("queue") or name.endswith("_q")
+            )
+            if not queueish:
+                return None
+            kwargs = {kw.arg for kw in node.keywords}
+            if kwargs & {"timeout", "block"}:
+                return None
+            if f.attr == "get" and node.args:
+                return None  # get(False) / get(timeout) forms
+            if f.attr == "put" and len(node.args) >= 2:
+                return None  # put(item, False) / put(item, True, t)
+            return (
+                f"{name}.{f.attr}() without a timeout (may block "
+                "indefinitely)"
+            )
         return None
 
 
@@ -895,6 +1618,8 @@ ALL_RULES = (
     HostSyncHotPathRule,
     JitRecompileHazardRule,
     ThreadSharedStateRule,
+    LockOrderRule,
+    RequestFromHandlerRule,
     BlockingCallUnderLockRule,
     MetricsNamingRule,
 )

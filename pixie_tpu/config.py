@@ -363,14 +363,28 @@ define_flag(
     "admission_priority_holddown_ms", 0.0,
     "Non-work-conserving grace window for strict-priority admission: "
     "after a priority-p query releases, strictly-lower-priority "
-    "waiters stay queued for this many milliseconds. Engines execute "
-    "one query at a time (Engine._exec_guard) and an admitted query "
-    "cannot be preempted, so without the hold-down a back-to-back "
+    "waiters stay queued for this many milliseconds. An admitted "
+    "query's compute cannot be preempted (queries now overlap on an "
+    "engine — pxlock, docs/ANALYSIS.md — but still contend for the "
+    "same cores/devices), so without the hold-down a back-to-back "
     "high-priority stream is interleaved with unpreemptible "
     "low-priority work admitted in its ~ms inter-arrival gaps — "
     "head-of-line blocking that moves the high class's p99 however "
     "fair the byte shares are. 0 (default) disables: admission is "
     "work-conserving and purely share/priority ordered.",
+)
+
+# -- concurrency verification (analysis/lockdep.py) --------------------------
+define_flag(
+    "lockdep", False,
+    "Runtime lock-order validation (Linux-lockdep style): wraps "
+    "threading.Lock/RLock/Condition creation, maintains per-thread "
+    "held-stacks and a process-wide observed acquisition-order graph, "
+    "and raises (with both stack pairs) at the first acquisition that "
+    "would close a cycle. Test/deploy instrumentation — off by "
+    "default, zero overhead when off (the raw C lock types are "
+    "untouched). run_tests.sh --locks runs the concurrency suites "
+    "under it; deploy roles honor it at process start.",
 )
 
 # -- device-tier observability (exec/programs.py) ----------------------------

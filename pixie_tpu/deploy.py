@@ -315,6 +315,15 @@ def main(argv=None) -> int:
     from .services.crash import install as install_crash
 
     install_crash(role=args[0], sigterm_exits=False)
+    # Runtime lock-order validation (pxlock's dynamic half): with the
+    # lockdep flag set, enable BEFORE the role constructs any engine/
+    # broker/agent — only locks created after enable() are tracked.
+    from .config import get_flag
+
+    if get_flag("lockdep"):
+        from .analysis import lockdep
+
+        lockdep.enable()
     return roles[args[0]]()
 
 

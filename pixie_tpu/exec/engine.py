@@ -169,6 +169,29 @@ class DeviceResult:
         return self.to_host().to_pydict(**kw)
 
 
+class _QueryScratch:
+    """Per-query execution state, one instance per in-flight
+    ``execute_plan`` (thread-local on the engine). This is what used to
+    live as engine attributes under ``_exec_guard``'s one-query-at-a-
+    time serialization — moving it here is what lets independent
+    queries overlap on one engine (certified by pxlock: the lock-order/
+    request-from-handler rules repo-green + lockdep-clean concurrency
+    suites; see docs/ANALYSIS.md "pxlock")."""
+
+    __slots__ = (
+        "cancel", "stats", "pipeline", "join_decision",
+        "resource_report", "table_sinks",
+    )
+
+    def __init__(self, cancel=None, stats=None):
+        self.cancel = cancel  # per-query cancel event (execute_plan arg)
+        self.stats = stats  # the trace's stats spine (QueryStats)
+        self.pipeline: dict | None = None
+        self.join_decision = None
+        self.resource_report = None
+        self.table_sinks: dict = {}
+
+
 class Engine:
     """Owns tables + registry; executes plans. (EngineState analog,
     ``src/carnot/engine_state.h``.)"""
@@ -185,28 +208,48 @@ class Engine:
         # Window-executor prefetch depth (pipeline.py): staging of window
         # N+1 overlaps compute of window N; 1 = serial.
         self.pipeline_depth = int(pipeline_depth or get_flag("pipeline_depth"))
+        # Per-query execution scratch (thread-local: each concurrent
+        # execute_plan runs on its own caller thread). The ``last_*``
+        # attributes below are engine-level LAST-FINISHED-QUERY
+        # snapshots for bench/tests/observability — under concurrency
+        # they are last-writer-wins by design; anything correctness-
+        # bearing reads the scratch, never these.
+        self._tls = threading.local()
+        # Guards the last-* snapshots, pipeline totals and the
+        # in-flight counters (tiny critical sections, no blocking calls
+        # inside — lock-order leaf).
+        self._state_lock = threading.Lock()
+        self._inflight = 0
+        self.max_inflight = 0  # high-water concurrent queries (tests/obs)
         # Pipeline accounting: per-query snapshot + engine-lifetime totals
         # (exported by services.observability.engine_collector).
-        self.last_pipeline: dict | None = None
+        self._last_pipeline: dict | None = None
         self.pipeline_totals = {
             "windows": 0, "stage_secs": 0.0, "stall_secs": 0.0,
         }
         self.last_stats = None
-        self._query_stats = None
-        self._cancel = None  # per-query cancel event (execute_plan arg)
         # Always-on query-lifecycle tracing (exec/trace.py): every
         # execute_plan gets a trace (spans + stats spine, ring-buffered,
         # /debug/queryz). Cheap: timestamps only, no device sync.
         self.tracer = Tracer()
-        # One query at a time; reentrant so subclasses can hold it across
-        # their own engine-state mutations around super().execute_plan().
+        # Engine-STATE mutation guard. Queries no longer serialize on it
+        # (per-query state lives on ``_QueryScratch``); it remains for
+        # subclasses that mutate engine-scoped execution state around
+        # super().execute_plan() (DistributedEngine's replan swaps the
+        # mesh) and as the "engine not stuck" probe the fault tests
+        # acquire. Reentrant so such a subclass can nest.
         self._exec_guard = threading.RLock()
-        self.last_table_sinks: dict = {}  # {table: rows} from TableSinkOps
+        self._last_table_sinks: dict = {}  # {table: rows} from TableSinkOps
         # Routing outcome of the most recent materialized JoinOp
         # (joins.JoinDecision): strategy, build-side swap, capacity,
         # overflow retries, zone-skipped windows. Bench and tests read
         # it; None until a query joins.
-        self.last_join_decision = None
+        self._last_join_decision = None
+        self._last_resource_report = None
+        # OTel egress collection (export_otel): init here, not lazily —
+        # a hasattr-then-assign under concurrent queries could lose an
+        # export.
+        self.otel_exports: list = []
         # Learned join-output capacities, keyed by (mode, plan hash,
         # node): a repeated query starts at the rung its last run
         # settled on. Engine-scoped — plan hashes don't capture table
@@ -228,6 +271,60 @@ class Engine:
 
         self.device_memory = default_device_monitor()
         self.device_memory.start()  # no-op unless device_memory_poll_s
+
+    # -- per-query scratch plumbing ------------------------------------------
+    # The underscore accessors keep the long-standing call sites in
+    # joins.py / bridge.py (`getattr(engine, "_query_stats", None)`,
+    # `engine.last_join_decision = ...`) working unchanged while the
+    # state behind them became per-query.
+    @property
+    def _scratch(self) -> "_QueryScratch | None":
+        return getattr(self._tls, "scratch", None)
+
+    @property
+    def _query_stats(self):
+        s = self._scratch
+        return s.stats if s is not None else None
+
+    @property
+    def _cancel(self):
+        s = self._scratch
+        return s.cancel if s is not None else None
+
+    @property
+    def last_join_decision(self):
+        s = self._scratch
+        if s is not None and s.join_decision is not None:
+            return s.join_decision
+        return self._last_join_decision
+
+    @last_join_decision.setter
+    def last_join_decision(self, jd) -> None:
+        s = self._scratch
+        if s is not None:
+            s.join_decision = jd
+        self._last_join_decision = jd
+
+    @property
+    def last_resource_report(self):
+        s = self._scratch
+        if s is not None:
+            return s.resource_report
+        return self._last_resource_report
+
+    @property
+    def last_pipeline(self) -> dict | None:
+        s = self._scratch
+        if s is not None and s.pipeline is not None:
+            return s.pipeline
+        return self._last_pipeline
+
+    @property
+    def last_table_sinks(self) -> dict:
+        s = self._scratch
+        if s is not None:
+            return s.table_sinks
+        return self._last_table_sinks
 
     @property
     def tables(self) -> dict:
@@ -376,17 +473,25 @@ class Engine:
         ``analyze`` records per-fragment, per-stage execution stats
         (exec_node.h:40 ExecNodeStats analog) on ``self.last_stats``.
 
-        One query at a time per Engine: the cancel handle and stats are
-        engine-scoped, so concurrent ``execute_plan`` calls (the Agent's
-        bus dispatcher threads can overlap execute/merge/bridge work)
-        serialize on an engine lock rather than corrupting each other's
-        cancel handles.
+        Concurrent queries overlap on one Engine: every per-query
+        execution state (cancel handle, stats spine, pipeline snapshot,
+        join decision, resource report, table sinks) lives on a
+        thread-local :class:`_QueryScratch`, so the Agent's bus
+        dispatcher threads (execute/merge/bridge work) and broker-side
+        worker threads run independent queries side by side. Shared
+        engine state is individually thread-safe: TableStore/Tracer/
+        ProgramRegistry/DeviceMemoryMonitor carry their own locks, the
+        learned join-capacity cache locks in joins.py, and the
+        ``last_*`` snapshots are last-finished-query observability
+        (``_state_lock``). Subclasses that mutate engine-SCOPED
+        execution state around super() (DistributedEngine's mesh
+        replan) still serialize on ``_exec_guard``.
 
         ``trace`` is the query's in-progress QueryTrace when the caller
         (execute_query) already began one; otherwise a fresh trace is
-        started here. Either way this call ends it — AFTER releasing the
-        exec guard, so the trace sinks (slow-query log, OTLP push to a
-        possibly-slow collector) never serialize the next query.
+        started here. Either way this call ends it — after execution,
+        so the trace sinks (slow-query log, OTLP push to a possibly-
+        slow collector) never run inside the scratch scope.
         """
         if trace is None:
             trace = self.tracer.begin_query(
@@ -394,10 +499,9 @@ class Engine:
             )
         status, error = "ok", ""
         try:
-            with self._exec_guard:
-                return self._execute_plan_guarded(
-                    plan, bridge_inputs, analyze, materialize, cancel, trace
-                )
+            return self._execute_plan_scoped(
+                plan, bridge_inputs, analyze, materialize, cancel, trace
+            )
         except QueryCancelled as e:
             status, error = "cancelled", str(e)
             raise
@@ -407,46 +511,46 @@ class Engine:
         finally:
             self.tracer.end_query(trace, status=status, error=error)
 
-    def _execute_plan_guarded(
+    def _execute_plan_scoped(
         self, plan, bridge_inputs, analyze, materialize, cancel, trace
     ) -> dict:
-        self._cancel = cancel
-        self.last_pipeline = None  # fresh per-query pipeline snapshot
-        # Fresh per-query join outcome: a non-join query must not
-        # inherit (and re-account) the previous query's decision.
-        self.last_join_decision = None
+        # The trace's stats spine IS the per-fragment stats object —
+        # analyze just runs it with sync=True (see analyze.py).
+        scratch = _QueryScratch(cancel=cancel, stats=trace.stats)
         # pxbound's plan-time resource envelope (analysis/bounds.py),
         # attached by compile_pxl: join-buffer pre-sizing reads it, and
         # the soundness gate compares it against the trace's observed
         # QueryResourceUsage.
-        self.last_resource_report = getattr(plan, "resource_report", None)
+        scratch.resource_report = getattr(plan, "resource_report", None)
         # Predicted-vs-observed calibration (__queries__ feedback loop):
         # stamp the plan's predicted cost on the trace so the telemetry
         # fold records it NEXT TO the observed usage — px/bound_accuracy
         # computes the per-script calibration ratio from the pair. The
         # broker path stamps its merged (logical + wire) cost instead.
-        if trace.predicted is None and self.last_resource_report is not None:
+        if trace.predicted is None and scratch.resource_report is not None:
             from ..analysis.bounds import merged_cost
 
-            trace.predicted = merged_cost(self.last_resource_report, None)
+            trace.predicted = merged_cost(scratch.resource_report, None)
         mem_token = (
             self.device_memory.query_begin()
             if self.device_memory is not None else None
         )
-        # The trace's stats spine IS the per-fragment stats object —
-        # analyze just runs it with sync=True (see analyze.py).
-        self._query_stats = trace.stats
+        prev = self._scratch  # defensive: a nested call restores it
+        self._tls.scratch = scratch
+        with self._state_lock:
+            self._inflight += 1
+            if self._inflight > self.max_inflight:
+                self.max_inflight = self._inflight
         try:
             return self._execute_plan_inner(plan, bridge_inputs, materialize)
         finally:
+            self._tls.scratch = prev
             if analyze:
                 self.last_stats = trace.stats
-            self._query_stats = None
-            self._cancel = None
             trace.pipeline = (
-                dict(self.last_pipeline) if self.last_pipeline else None
+                dict(scratch.pipeline) if scratch.pipeline else None
             )
-            jd = self.last_join_decision
+            jd = scratch.join_decision
             if jd is not None:
                 trace.usage.retries += int(getattr(jd, "retries", 0))
                 trace.usage.skipped_windows += int(
@@ -456,6 +560,17 @@ class Engine:
                 trace.usage.device_peak_bytes = (
                     self.device_memory.query_end(mem_token)
                 )
+            # Publish the last-finished-query snapshots (observability/
+            # bench/test seams; last-writer-wins under concurrency).
+            with self._state_lock:
+                self._inflight -= 1
+                self._last_pipeline = scratch.pipeline
+                self._last_table_sinks = scratch.table_sinks
+                # jd may be None: a finished non-join query clears the
+                # snapshot (callers must not re-account a previous
+                # query's decision).
+                self._last_join_decision = jd
+                self._last_resource_report = scratch.resource_report
 
     @staticmethod
     def _plan_fingerprint(plan: Plan) -> int:
@@ -475,7 +590,6 @@ class Engine:
         self, plan: Plan, bridge_inputs: dict | None = None,
         materialize: bool = True,
     ) -> dict:
-        self.last_table_sinks = {}
         results: dict[int, object] = {}
         outputs: dict = {}
         consumers: dict[int, int] = {}
@@ -666,11 +780,11 @@ class Engine:
         trace.note_freshness_lag(op.table, (int(ref) - wm) / 1e6)
 
     def export_otel(self, payload: dict, endpoint) -> None:
-        """OTel egress. Default: collect in-memory (``otel_exports``);
-        deployments override/replace with an OTLP pusher (the reference
-        ships over OTLP gRPC — grpc is gated in this environment)."""
-        if not hasattr(self, "otel_exports"):
-            self.otel_exports = []
+        """OTel egress. Default: collect in-memory (``otel_exports``,
+        initialized in ``__init__``; list.append is atomic under
+        concurrent queries); deployments override/replace with an OTLP
+        pusher (the reference ships over OTLP gRPC — grpc is gated in
+        this environment)."""
         self.otel_exports.append({"endpoint": endpoint, "payload": payload})
 
     def _run_udtf(self, op: UDTFSourceOp) -> HostBatch:
@@ -1044,21 +1158,35 @@ class Engine:
 
     def _note_pipeline(self, pipe: WindowPipeline) -> None:
         """Fold a finished pipeline's counters into the per-query snapshot
-        (``last_pipeline``, which the query's trace snapshots at end)
-        and the engine-lifetime totals."""
+        (``scratch.pipeline``, which the query's trace snapshots at end;
+        falls back to the engine-level snapshot for callers outside an
+        execute_plan scope — the streaming cursor, DeviceResult
+        rebuckets) and the engine-lifetime totals (state-locked: the
+        totals are read-modify-write shared across concurrent queries).
+        """
         c = pipe.counters()
-        lp = self.last_pipeline
-        if lp is None:
-            lp = self.last_pipeline = {
-                "depth": c["depth"], "windows": 0,
-                "stage_secs": 0.0, "stall_secs": 0.0,
-            }
-        lp["depth"] = c["depth"]
-        tot = self.pipeline_totals
-        for d in (lp, tot):
-            d["windows"] += c["windows"]
-            d["stage_secs"] += c["stage_secs"]
-            d["stall_secs"] += c["stall_secs"]
+        s = self._scratch
+        with self._state_lock:
+            if s is not None:
+                lp = s.pipeline
+                if lp is None:
+                    lp = s.pipeline = {
+                        "depth": c["depth"], "windows": 0,
+                        "stage_secs": 0.0, "stall_secs": 0.0,
+                    }
+            else:
+                lp = self._last_pipeline
+                if lp is None:
+                    lp = self._last_pipeline = {
+                        "depth": c["depth"], "windows": 0,
+                        "stage_secs": 0.0, "stall_secs": 0.0,
+                    }
+            lp["depth"] = c["depth"]
+            tot = self.pipeline_totals
+            for d in (lp, tot):
+                d["windows"] += c["windows"]
+                d["stage_secs"] += c["stage_secs"]
+                d["stall_secs"] += c["stall_secs"]
 
     def _put_side(self, v):
         """Stage one fused-join side table (DistributedEngine replicates

@@ -19,6 +19,7 @@ multi-device partial-agg merge uses.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -107,6 +108,11 @@ class CompiledFragment:
 
 _FRAGMENT_CACHE: dict = {}
 _FRAGMENT_CACHE_MAX = 128
+# Guards insert/evict (concurrent queries compile concurrently; two
+# threads evicting the same oldest key would KeyError, and the loser of
+# a duplicate-miss race must adopt the winner's fragment so id()-keyed
+# downstream caches — the distributed step cache — stay canonical).
+_FRAGMENT_CACHE_LOCK = threading.Lock()
 
 
 def _struct_key(x):
@@ -191,19 +197,29 @@ def compile_fragment_cached(ops, input_relation, input_dicts, registry,
         )
     hit = _FRAGMENT_CACHE.get(key)
     if hit is None:
+        # Compile OUTSIDE the cache lock (compiles are slow and must
+        # not serialize concurrent queries' unrelated misses); a
+        # duplicate-miss race costs one redundant compile and the
+        # loser adopts the winner's fragment below.
         frag = compile_fragment(
             ops, input_relation, input_dicts, registry, allow_dense,
             col_stats=col_stats,
         )
         _track_fragment_programs(frag, ops, key, input_dicts, registry)
-        if len(_FRAGMENT_CACHE) >= _FRAGMENT_CACHE_MAX:
-            _FRAGMENT_CACHE.pop(next(iter(_FRAGMENT_CACHE)))
-        # The entry pins the registry (still id()-keyed: a freed
-        # registry's address could be recycled into a false hit) and
-        # the compile-time dictionaries (the fragment's out_meta
-        # resolves ids through them; content-equal callers may outlive
-        # their own copies).
-        _FRAGMENT_CACHE[key] = (frag, tuple(input_dicts.values()), registry)
+        with _FRAGMENT_CACHE_LOCK:
+            raced = _FRAGMENT_CACHE.get(key)
+            if raced is not None:
+                return raced[0]
+            while len(_FRAGMENT_CACHE) >= _FRAGMENT_CACHE_MAX:
+                _FRAGMENT_CACHE.pop(next(iter(_FRAGMENT_CACHE)))
+            # The entry pins the registry (still id()-keyed: a freed
+            # registry's address could be recycled into a false hit) and
+            # the compile-time dictionaries (the fragment's out_meta
+            # resolves ids through them; content-equal callers may
+            # outlive their own copies).
+            _FRAGMENT_CACHE[key] = (
+                frag, tuple(input_dicts.values()), registry
+            )
     else:
         frag = hit[0]
     return frag

@@ -5,6 +5,18 @@ Reference parity: the vizier query load tester
 clients, M queries each, latency percentiles and error counts. Works
 against an in-process ``QueryBroker`` or a remote broker over the
 netbus (``RemoteBus`` + the ``broker.execute`` topic).
+
+CLI (the ROADMAP's concurrency bench seam — the measurement for the
+``Engine._exec_guard`` narrowing, see docs/ANALYSIS.md "pxlock"):
+
+    python -m pixie_tpu.services.load_tester --concurrency 1,2,4 \\
+        [--broker HOST:PORT | --local] [--script q.pxl] [--per-worker N]
+
+runs the same offered load at each client-thread count N and reports
+qps + p50/p95/p99 per N — client-side latencies plus the per-run
+quantiles from the serving process's own ``pixie_query_duration_seconds``
+histogram deltas. Flat qps from 1 -> N client threads means the serving
+path serializes; scaling qps is the concurrency unlock, measured.
 """
 
 from __future__ import annotations
@@ -248,6 +260,35 @@ def run_mixed_load(execute, streams) -> dict:
     return reports
 
 
+def run_concurrency_sweep(
+    execute,
+    query: str,
+    concurrencies=(1, 2, 4),
+    per_worker: int = 10,
+    timeout_s: float = 30.0,
+    warmup: int = 1,
+    **tenancy_kw,
+) -> dict:
+    """The ``--concurrency`` axis: the same per-worker offered load at
+    each client-thread count N, sequentially, against one engine/broker.
+    Returns {N: LoadReport}. ``warmup`` queries run first (uncounted) so
+    sweep point 1 doesn't pay the XLA compile that later points then
+    amortize — the N=1 row is the serial baseline the scaling rows are
+    read against."""
+    for _ in range(max(0, int(warmup))):
+        try:
+            execute(query, timeout_s)
+        except Exception:
+            break  # the measured runs will report the failure mode
+    out = {}
+    for n in concurrencies:
+        out[int(n)] = run_load(
+            execute, query, workers=int(n), per_worker=per_worker,
+            timeout_s=timeout_s, **tenancy_kw,
+        )
+    return out
+
+
 def broker_executor(broker):
     """Adapter for an in-process QueryBroker."""
 
@@ -276,3 +317,117 @@ def remote_executor(host: str, port: int):
 
     execute.close = bus.close  # type: ignore[attr-defined]
     return execute
+
+
+# -- CLI ----------------------------------------------------------------------
+
+_LOCAL_QUERY = (
+    "import px\n"
+    "df = px.DataFrame(table='http_events')\n"
+    "df = df.groupby('service').agg(\n"
+    "    n=('latency_ns', px.count), mean=('latency_ns', px.mean))\n"
+    "px.display(df, 'out')\n"
+)
+
+
+def local_executor(rows: int = 200_000, window_rows: int = 1 << 15,
+                   seed: int = 7):
+    """In-process single-engine executor over a seeded synthetic table
+    (the ``--local`` mode: measures the ENGINE's concurrency, no
+    broker/bus in the path)."""
+    import numpy as np
+
+    from ..exec.engine import Engine
+
+    rng = np.random.default_rng(seed)
+    engine = Engine(window_rows=window_rows)
+    engine.append_data("http_events", {
+        "time_": np.arange(rows, dtype=np.int64),
+        "latency_ns": rng.integers(1_000, 1_000_000, rows),
+        "service": [f"svc-{i % 8}" for i in range(rows)],
+    })
+
+    def execute(query, timeout_s, **kw):
+        return engine.execute_query(query)
+
+    execute.engine = engine  # type: ignore[attr-defined]
+    return execute
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pixie_tpu.services.load_tester",
+        description=(
+            "Concurrency load sweep: N client threads against one "
+            "engine/broker, qps + p50/p95/p99 per N (client-side and "
+            "serving-histogram deltas)."
+        ),
+    )
+    ap.add_argument("--broker", metavar="HOST:PORT",
+                    help="remote broker over the netbus")
+    ap.add_argument("--local", action="store_true",
+                    help="in-process engine over a synthetic table")
+    ap.add_argument("--script", help=".pxl file (default: a groupby "
+                                     "over the local synthetic table)")
+    ap.add_argument("--concurrency", default="1,2,4",
+                    help="comma-separated client-thread counts")
+    ap.add_argument("--per-worker", type=int, default=10)
+    ap.add_argument("--timeout-s", type=float, default=30.0)
+    ap.add_argument("--rows", type=int, default=200_000,
+                    help="--local synthetic table size")
+    ap.add_argument("--tenant", default=None)
+    ap.add_argument("--priority", type=int, default=0)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    if bool(args.broker) == bool(args.local):
+        ap.error("exactly one of --broker or --local is required")
+    if args.local and (
+        args.tenant is not None or args.priority
+        or args.deadline_ms is not None
+    ):
+        # The local executor calls the engine directly — no broker, no
+        # admission path. Silently dropping these would print
+        # tenancy-shaped numbers that never exercised tenancy.
+        ap.error("--tenant/--priority/--deadline-ms require --broker "
+                 "(the local engine has no admission path)")
+    if args.script:
+        with open(args.script) as f:
+            query = f.read()
+    else:
+        if not args.local:
+            ap.error("--script is required with --broker")
+        query = _LOCAL_QUERY
+    try:
+        concurrencies = [
+            int(c) for c in str(args.concurrency).split(",") if c.strip()
+        ]
+    except ValueError:
+        ap.error(f"bad --concurrency {args.concurrency!r}")
+    if args.local:
+        execute = local_executor(rows=args.rows)
+    else:
+        host, _, port = args.broker.rpartition(":")
+        execute = remote_executor(host or "127.0.0.1", int(port))
+    try:
+        reports = run_concurrency_sweep(
+            execute, query, concurrencies=concurrencies,
+            per_worker=args.per_worker, timeout_s=args.timeout_s,
+            tenant=args.tenant, priority=args.priority,
+            deadline_ms=args.deadline_ms,
+        )
+        print(json.dumps(
+            {str(n): r.to_dict() for n, r in reports.items()}, indent=2
+        ))
+        return 0 if all(r.errors == 0 for r in reports.values()) else 1
+    finally:
+        close = getattr(execute, "close", None)
+        if close is not None:
+            close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

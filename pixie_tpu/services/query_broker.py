@@ -82,9 +82,10 @@ class _Admission:
     weighted-fair until an operator assigns priorities; a starved
     low-priority query still resolves via its queue timeout or
     deadline.) ``admission_priority_holddown_ms`` extends the strict
-    rule across a released query's inter-arrival gap: engines run one
-    query at a time (``Engine._exec_guard``) and an admitted query
-    cannot be preempted, so a lower-priority query admitted in the
+    rule across a released query's inter-arrival gap: an admitted
+    query's compute cannot be preempted (queries overlap on an engine
+    since the pxlock unlock, but still contend for its cores/devices),
+    so a lower-priority query admitted in the
     ~ms gap between two high-priority queries head-of-line blocks the
     next one at the agent — the hold-down keeps lower classes queued
     for a grace window after each higher-priority release, trading

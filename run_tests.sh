@@ -74,6 +74,22 @@
 #                                      the --tier1 sweep; the isolation
 #                                      gate runs via the explicit
 #                                      "$0" --tenancy step there.
+#   ./run_tests.sh --locks             pxlock concurrency gate (see
+#                                      docs/ANALYSIS.md "pxlock"):
+#                                      static half = the lock-order /
+#                                      request-from-handler /
+#                                      blocking-call-under-lock pxlint
+#                                      rules repo-green; dynamic half =
+#                                      the concurrency-heavy suites
+#                                      (lockdep unit tests, the
+#                                      concurrent-serving certification
+#                                      in tests/test_concurrency.py,
+#                                      fault/tenancy/telemetry) under
+#                                      PIXIE_TPU_LOCKDEP=1 — runtime
+#                                      lock-order validation that fails
+#                                      on the first acquisition that
+#                                      would close a cycle. Runs inside
+#                                      --analyze (and so --tier1).
 #   ./run_tests.sh --bench-join        quick join gate: a small
 #                                      selectivity/skew sweep (uniform
 #                                      vs zipf keys, low/high match
@@ -100,6 +116,25 @@ case "$1" in
     exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python -m pytest -q tests/test_tenancy.py "$@"
     ;;
+  --locks)
+    shift
+    rc=0
+    # Static half: the pxlock rules must be repo-green (zero
+    # unbaselined findings — suppressions/baseline entries carry their
+    # written justification in-line / in baseline.json).
+    python tools/pxlint.py \
+      --rules lock-order,request-from-handler,blocking-call-under-lock \
+      || rc=$?
+    # Dynamic half: lockdep-instrumented concurrency suites. The
+    # conftest enables lockdep at session start (PIXIE_TPU_LOCKDEP=1)
+    # and fails any test whose run recorded a violation, even one a
+    # handler swallowed.
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu PIXIE_TPU_LOCKDEP=1 \
+      python -m pytest -q -m 'not slow' tests/test_lockdep.py \
+      tests/test_concurrency.py tests/test_fault_injection.py \
+      tests/test_tenancy.py tests/test_telemetry.py "$@" || rc=$?
+    exit $rc
+    ;;
   --bench-join)
     shift
     exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
@@ -122,6 +157,9 @@ case "$1" in
       python -m pixie_tpu.analysis.bench_check || rc=$?
     env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python -m pixie_tpu.analysis.bound_check || rc=$?
+    # pxlock gate: static lock rules + lockdep-instrumented
+    # concurrency suites (also reaches --tier1 through this step).
+    "$0" --locks || rc=$?
     exit $rc
     ;;
   --faults)

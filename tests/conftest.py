@@ -40,6 +40,34 @@ if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
 
 import pytest  # noqa: E402
 
+# Runtime lock-order validation (pxlock's dynamic half): with
+# PIXIE_TPU_LOCKDEP=1 (./run_tests.sh --locks), every lock created from
+# here on is order-tracked and the first acquisition that would close a
+# cycle raises with both stack pairs. Enabled at conftest import — i.e.
+# before any test module (and the engines/brokers/agents they build)
+# creates its locks. The autouse guard below also FAILS the owning test
+# on violations product code swallowed (bus handlers catch Exception).
+_LOCKDEP = None
+if os.environ.get("PIXIE_TPU_LOCKDEP"):
+    from pixie_tpu.analysis import lockdep as _lockdep_mod  # noqa: E402
+
+    _LOCKDEP = _lockdep_mod.enable()
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_guard():
+    if _LOCKDEP is None:
+        yield
+        return
+    before = len(_LOCKDEP.violations)
+    yield
+    fresh = _LOCKDEP.violations[before:]
+    assert not fresh, (
+        "lockdep recorded lock-order violation(s) during this test "
+        "(possibly swallowed by a handler):\n"
+        + "\n---\n".join(str(v) for v in fresh)
+    )
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -1,0 +1,330 @@
+"""lockdep (analysis/lockdep.py): runtime lock-order validation.
+
+The dynamic half of pxlock (see docs/ANALYSIS.md "pxlock"): per-thread
+held-stacks, a process-wide observed acquisition-order graph, and a
+raise-with-both-stack-pairs at the first acquisition that would close a
+cycle. Unit tests run against a PRIVATE LockDep state (no threading
+patch), so they work identically inside a PIXIE_TPU_LOCKDEP=1 run —
+where the global tracker is watching this very test process.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from pixie_tpu.analysis import lockdep
+from pixie_tpu.analysis.lockdep import LockDep, LockOrderError
+
+
+@pytest.fixture
+def dep():
+    return LockDep()
+
+
+class TestCycleDetection:
+    def test_abba_raises_with_both_stack_pairs(self, dep):
+        a = dep.make_lock()
+        b = dep.make_lock()
+        # Thread 1 establishes the order A -> B.
+        def fwd():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=fwd)
+        t.start()
+        t.join()
+        # Thread 2 (here: this thread) attempts B -> A: the acquire of
+        # A while holding B would close the cycle — it must raise
+        # BEFORE blocking, with all four stacks in the message.
+        with pytest.raises(LockOrderError) as ei:
+            with b:
+                with a:
+                    pass
+        msg = str(ei.value)
+        assert "lock-order cycle closed" in msg
+        # Both pairs: this thread's held+acquire stacks and the prior
+        # observation's held+acquire stacks, all pointing at this file.
+        assert msg.count("test_lockdep.py") >= 4, msg
+        assert "fwd" in msg  # the prior edge's acquisition chain
+        assert len(dep.violations) == 1
+
+    def test_transitive_cycle_through_third_lock(self, dep):
+        a, b, c = dep.make_lock(), dep.make_lock(), dep.make_lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        # A -> B -> C observed; C -> A closes a 3-cycle.
+        with pytest.raises(LockOrderError) as ei:
+            with c:
+                with a:
+                    pass
+        assert "prior observation" in str(ei.value)
+        assert len(dep.violations) == 1
+
+    def test_consistent_order_is_clean(self, dep):
+        a, b = dep.make_lock(), dep.make_lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert dep.violations == []
+        assert (
+            min(k for k in dep.edges), max(k for k in dep.edges)
+        ) == ((1, 2), (1, 2))  # one edge, observed once
+
+    def test_trylock_never_adds_edges(self, dep):
+        a, b = dep.make_lock(), dep.make_lock()
+        with a:
+            assert b.acquire(blocking=False)
+            b.release()
+        # Reverse order as a trylock too: no edges, no violation.
+        with b:
+            assert a.acquire(blocking=False)
+            a.release()
+        assert dep.edges == {} and dep.violations == []
+
+    def test_self_deadlock_on_nonreentrant_lock(self, dep):
+        a = dep.make_lock()
+        with pytest.raises(LockOrderError) as ei:
+            with a:
+                a.acquire()
+        assert "self-deadlock" in str(ei.value)
+
+    def test_trylock_of_held_lock_is_a_legal_probe(self, dep):
+        """acquire(blocking=False) of a lock this thread holds returns
+        False on a raw Lock — a legal can-I-take-it probe, never a
+        deadlock. No raise, no recorded violation."""
+        a = dep.make_lock()
+        with a:
+            assert a.acquire(blocking=False) is False
+        assert dep.violations == []
+        # And the lock is still cleanly re-acquirable afterwards.
+        with a:
+            pass
+        assert dep.held() == []
+
+    def test_cross_thread_release_clears_the_holder_entry(self, dep):
+        """Lock-as-signal handoff: thread A acquires, thread B
+        releases. A's held entry must not stay behind — a stale entry
+        would poison A's later acquisitions with false edges and a
+        false self-deadlock on its next legitimate acquire."""
+        sig = dep.make_lock()
+        other = dep.make_lock()
+        idents = {}
+        phase2 = threading.Event()
+        done = {}
+
+        def owner():
+            idents["a"] = threading.get_ident()
+            sig.acquire()  # handed off; released by the main thread
+            phase2.wait(5.0)
+            try:
+                # Post-handoff: acquiring other then sig again must be
+                # clean (no stale held entry, no false self-deadlock).
+                with other:
+                    with sig:
+                        pass
+                done["ok"] = True
+            except LockOrderError as e:
+                done["err"] = e
+
+        t = threading.Thread(target=owner)
+        t.start()
+        deadline = time.time() + 5.0
+        while "a" not in idents or not dep.held(idents.get("a", -1)):
+            assert time.time() < deadline
+            time.sleep(0.01)
+        sig.release()  # main thread releases A's lock (the handoff)
+        assert dep.held(idents["a"]) == [], \
+            "handoff release left the acquirer's held entry behind"
+        phase2.set()
+        t.join(5.0)
+        assert done.get("ok"), done.get("err")
+        assert dep.violations == []
+
+
+class TestRLockAndCondition:
+    def test_rlock_reentrancy_is_clean(self, dep):
+        r = dep.make_rlock()
+        with r:
+            with r:
+                with r:
+                    assert dep.held() == [(r._dep_name, 3)]
+        assert dep.held() == []
+        assert dep.violations == [] and dep.edges == {}
+
+    def test_condition_wait_releases_its_edge(self, dep):
+        """While a thread waits on a Condition, the condition's lock is
+        NOT in its held set (Condition.wait released it through
+        ``_release_save``) — and the wake-up re-acquire restores it,
+        recursion count included, with no spurious violation."""
+        cond = dep.make_condition()
+        in_wait = threading.Event()
+        woke = threading.Event()
+        idents = {}
+
+        def consumer():
+            idents["t"] = threading.get_ident()
+            with cond:
+                in_wait.set()
+                cond.wait(timeout=10.0)
+                # Re-acquired at wake: held again inside the with.
+                idents["held_after_wake"] = dep.held()
+            woke.set()
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        assert in_wait.wait(5.0)
+        # Give the consumer time to actually enter wait() (in_wait is
+        # set just before), then observe its held set from outside.
+        deadline = time.time() + 5.0
+        while dep.held(idents["t"]) and time.time() < deadline:
+            time.sleep(0.01)
+        assert dep.held(idents["t"]) == [], \
+            "cond lock still in the waiter's held set during wait()"
+        with cond:
+            cond.notify()
+        assert woke.wait(5.0)
+        t.join(5.0)
+        assert idents["held_after_wake"], "wake-up re-acquire untracked"
+        assert dep.held(idents["t"]) == []
+        assert dep.violations == []
+
+    def test_wait_window_reacquire_still_orders(self, dep):
+        """The wake-up re-acquire runs FULL edge/cycle bookkeeping: a
+        lock acquired after the condition's lock and held across
+        ``wait()`` orders before the re-acquire. The shape is itself a
+        real inversion — another thread at ``with cond:`` (holding the
+        cond lock, trying C) deadlocks against the waker holding C and
+        re-acquiring the cond lock — so lockdep flags it AT the
+        wake-up, and lock state stays consistent (the restore completes
+        before the raise; the with-blocks unwind cleanly)."""
+        lk = dep.make_lock()
+        cond = dep.make_condition(lk)
+        c = dep.make_lock()
+        done = {}
+
+        def waiter():
+            done["ident"] = threading.get_ident()
+            try:
+                with cond:
+                    with c:  # edge lk -> c
+                        # wait releases lk while c stays held; the
+                        # wake-up re-acquires lk UNDER c — closing the
+                        # cycle lk -> c -> lk.
+                        cond.wait(timeout=0.2)
+            except LockOrderError as e:
+                done["err"] = e
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        t.join(5.0)
+        assert not t.is_alive()
+        assert "err" in done, "wait-window inversion not caught"
+        assert "lock-order cycle closed" in str(done["err"])
+        assert dep.violations == [done["err"]]
+        # Clean unwind: both with-blocks released; nothing stays held.
+        assert dep.held(done["ident"]) == []
+        assert not lk._inner.locked() and not c._inner.locked()
+
+
+class TestEnableDisable:
+    def test_enable_patches_and_scoped_active_restores(self):
+        was = lockdep.enabled()
+        with lockdep.active() as dep:
+            lk = threading.Lock()
+            rl = threading.RLock()
+            assert type(lk).__name__ == "_DepLock"
+            assert type(rl).__name__ == "_DepRLock"
+            with lk:
+                pass
+            with rl:
+                pass
+            assert dep.tracked_locks >= 2
+        if not was:
+            assert threading.Lock is lockdep._REAL_LOCK
+            assert threading.RLock is lockdep._REAL_RLOCK
+            assert threading.Condition is lockdep._REAL_CONDITION
+
+    def test_patched_condition_default_lock_is_tracked(self):
+        was = lockdep.enabled()
+        with lockdep.active() as dep:
+            before = dep.tracked_locks
+            cond = threading.Condition()
+            with cond:
+                cond.notify_all()
+            assert dep.tracked_locks == before + 1
+        if not was:
+            assert threading.Condition is lockdep._REAL_CONDITION
+
+    @pytest.mark.skipif(
+        bool(os.environ.get("PIXIE_TPU_LOCKDEP")),
+        reason="global lockdep run: threading is intentionally patched",
+    )
+    def test_no_overhead_when_disabled(self):
+        # Off = the raw C lock types, byte-for-byte: no wrapper, no
+        # bookkeeping, nothing to pay on ordinary runs.
+        assert threading.Lock is lockdep._REAL_LOCK
+        lk = threading.Lock()
+        assert type(lk) is type(lockdep._REAL_LOCK())
+        assert not hasattr(lk, "_dep_serial")
+
+
+class TestRealLocksUnderLockdep:
+    def test_queue_and_event_survive_wrapping(self):
+        """queue.Queue builds Conditions over a patched Lock; its
+        get/put (incl. the timeout path through Condition.wait) must
+        behave normally under lockdep."""
+        import queue
+
+        was = lockdep.enabled()
+        with lockdep.active() as dep:
+            q = queue.Queue(maxsize=2)
+            q.put(1)
+            q.put(2, timeout=1.0)
+            assert q.get() == 1
+            assert q.get(timeout=1.0) == 2
+            with pytest.raises(queue.Empty):
+                q.get(timeout=0.05)
+            ev = threading.Event()
+            assert not ev.wait(0.01)
+            ev.set()
+            assert ev.wait(0.01)
+            assert dep.violations == []
+        if not was:
+            assert threading.Lock is lockdep._REAL_LOCK
+
+    def test_engine_query_runs_clean_under_lockdep(self):
+        """An end-to-end engine query under a scoped lockdep: every
+        engine/table-store/tracer lock created inside is tracked, and
+        the query path is cycle-free."""
+        import numpy as np
+
+        was = lockdep.enabled()
+        with lockdep.active() as dep:
+            from pixie_tpu.exec.engine import Engine
+
+            eng = Engine(window_rows=1 << 10)
+            eng.append_data("t", {
+                "time_": np.arange(4096, dtype=np.int64),
+                "v": np.arange(4096, dtype=np.int64) % 7,
+            })
+            out = eng.execute_query(
+                "import px\n"
+                "df = px.DataFrame(table='t')\n"
+                "df = df.groupby('v').agg(n=('v', px.count))\n"
+                "px.display(df, 'o')\n"
+            )
+            assert out["o"].length == 7
+            assert dep.tracked_locks > 0
+            assert dep.violations == []
+        if not was:
+            assert threading.Lock is lockdep._REAL_LOCK

@@ -391,6 +391,461 @@ def test_lock_assigned_in_later_method_still_counts(tmp_path):
         "\n".join(f.render() for f in report.findings)
 
 
+# -- lock-order ---------------------------------------------------------------
+
+_ABBA = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._la = threading.Lock()
+            self._lb = threading.Lock()
+
+        def fwd(self):
+            with self._la:
+                with self._lb:
+                    pass
+
+        def rev(self):
+            with self._lb:
+                with self._la:
+                    pass
+"""
+
+
+def test_lock_order_detects_abba_cycle(tmp_path):
+    report = _lint_src(tmp_path, "svc.py", _ABBA, rules={"lock-order"})
+    assert len(report.findings) == 1
+    msg = report.findings[0].message
+    assert "lock-order cycle" in msg
+    # Both acquisition chains are in the diagnostic.
+    assert "Svc.fwd" in msg and "Svc.rev" in msg
+    assert "Svc._la" in msg and "Svc._lb" in msg
+
+
+def test_lock_order_transitive_same_class_calls(tmp_path):
+    report = _lint_src(
+        tmp_path, "tr.py",
+        """
+        import threading
+
+        class Tr:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def fwd(self):
+                with self._la:
+                    self._takes_b()
+
+            def _takes_b(self):
+                with self._lb:
+                    pass
+
+            def rev(self):
+                with self._lb:
+                    self._takes_a()
+
+            def _takes_a(self):
+                with self._la:
+                    pass
+        """,
+        rules={"lock-order"},
+    )
+    assert len(report.findings) == 1
+    msg = report.findings[0].message
+    assert "Tr.fwd -> Tr._takes_b" in msg
+    assert "Tr.rev -> Tr._takes_a" in msg
+
+
+def test_lock_order_cross_module_chain(tmp_path):
+    """The graph is interprocedural ACROSS modules: Holder holds its
+    lock and calls into Other (attr type from the annotated ctor
+    param); Other holds its lock and calls back. Neither file alone has
+    a cycle."""
+    report = _lint_src(
+        tmp_path, "x1.py",
+        """
+        import threading
+        from .x2 import Other
+
+        class Holder:
+            def __init__(self):
+                self._hlock = threading.Lock()
+                self.other = Other(self)
+
+            def go(self):
+                with self._hlock:
+                    self.other.poke()
+
+            def back(self):
+                with self._hlock:
+                    pass
+        """,
+        rules={"lock-order"},
+        extra_files=[(
+            "x2.py",
+            """
+            import threading
+
+            class Other:
+                def __init__(self, holder: "Holder"):
+                    self._olock = threading.Lock()
+                    self.holder = holder
+
+                def poke(self):
+                    with self._olock:
+                        pass
+
+                def reverse(self):
+                    with self._olock:
+                        self.holder.back()
+            """,
+        )],
+    )
+    assert len(report.findings) == 1
+    msg = report.findings[0].message
+    assert "Holder._hlock" in msg and "Other._olock" in msg
+    assert "Holder.go -> Other.poke" in msg
+    assert "Other.reverse -> Holder.back" in msg
+
+
+def test_lock_order_self_deadlock_nonreentrant(tmp_path):
+    report = _lint_src(
+        tmp_path, "sd.py",
+        """
+        import threading
+
+        class Dead:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+
+        class Fine:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+        """,
+        rules={"lock-order"},
+    )
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert f.symbol == "Dead.outer"
+    assert "certain self-deadlock" in f.message
+    assert "Dead.outer -> Dead._inner" in f.message
+
+
+def test_lock_order_consistent_order_is_clean(tmp_path):
+    """Nesting the same two locks in ONE consistent order everywhere
+    (incl. via subclass inheritance of the lock attr) is fine."""
+    report = _lint_src(
+        tmp_path, "ok.py",
+        """
+        import threading
+
+        class Base:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._lb = threading.Lock()
+
+            def one(self):
+                with self._la:
+                    with self._lb:
+                        pass
+
+        class Sub(Base):
+            def two(self):
+                with self._la:
+                    with self._lb:
+                        pass
+        """,
+        rules={"lock-order"},
+    )
+    assert report.findings == [], \
+        "\n".join(f.render() for f in report.findings)
+
+
+def test_lock_order_condition_aliases_its_wrapped_lock(tmp_path):
+    """``Condition(self._lock)`` shares _lock's underlying lock: the
+    two attrs are ONE node, so nesting them is a self-deadlock, not a
+    two-node cycle — and a bare Condition() (RLock inside) nested under
+    itself through a helper stays clean."""
+    report = _lint_src(
+        tmp_path, "cond.py",
+        """
+        import threading
+
+        class Shares:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._changed = threading.Condition(self._lock)
+
+            def bad(self):
+                with self._lock:
+                    with self._changed:
+                        pass
+
+        class BareCond:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def outer(self):
+                with self._cond:
+                    self._inner()
+
+            def _inner(self):
+                with self._cond:
+                    pass
+        """,
+        rules={"lock-order"},
+    )
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert f.symbol == "Shares.bad"
+    assert "self-deadlock" in f.message
+
+
+def test_lock_order_condition_alias_across_inheritance(tmp_path):
+    """A subclass Condition wrapping a BASE-class Lock collapses onto
+    the base lock's node with the base lock's (non-)reentrancy — the
+    self-nest is a self-deadlock, not a clean two-node nesting."""
+    report = _lint_src(
+        tmp_path, "inh.py",
+        """
+        import threading
+
+        class Base:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        class Sub(Base):
+            def __init__(self):
+                super().__init__()
+                self._cv = threading.Condition(self._lock)
+
+            def bad(self):
+                with self._lock:
+                    with self._cv:
+                        pass
+        """,
+        rules={"lock-order"},
+    )
+    assert len(report.findings) == 1, \
+        "\n".join(f.render() for f in report.findings)
+    f = report.findings[0]
+    assert f.symbol == "Sub.bad" and "self-deadlock" in f.message
+
+
+def test_lock_order_suppression_and_baseline(tmp_path):
+    # Inline suppression silences the finding at its reported line.
+    sup = _ABBA.replace(
+        "with self._lb:\n                with self._la:",
+        "with self._lb:  # pxlint: disable=lock-order\n"
+        "                with self._la:",
+    )
+    # The finding anchors at the FIRST edge's acquisition site, so
+    # suppress there instead: cycle findings land on the smallest
+    # node's edge (Svc._la acquired in fwd).
+    sup2 = _ABBA.replace(
+        "def fwd(self):\n            with self._la:",
+        "def fwd(self):\n"
+        "            with self._la:  # pxlint: disable=lock-order",
+    )
+    r2 = _lint_src(tmp_path, "sup2.py", sup2, rules={"lock-order"})
+    assert r2.findings == [] and r2.suppressed == 1
+    # Baseline roundtrip: line drift keeps the key.
+    import textwrap
+    p = tmp_path / "legacy.py"
+    p.write_text(textwrap.dedent(_ABBA))
+    bl = tmp_path / "bl.json"
+    r3 = run_lint([str(p)], rules={"lock-order"}, baseline_path=str(bl),
+                  repo_root=str(tmp_path))
+    assert len(r3.findings) == 1
+    save_baseline(r3.findings, str(bl))
+    p.write_text("\n\n" + textwrap.dedent(_ABBA))
+    r4 = run_lint([str(p)], rules={"lock-order"}, baseline_path=str(bl),
+                  repo_root=str(tmp_path))
+    assert r4.ok and len(r4.baselined) == 1
+
+
+# -- request-from-handler -----------------------------------------------------
+
+def test_request_from_handler_direct_and_transitive(tmp_path):
+    report = _lint_src(
+        tmp_path, "handlers.py",
+        """
+        class Svc:
+            def __init__(self, bus):
+                self.bus = bus
+                bus.subscribe("a", self._on_a)
+                bus.subscribe("b", self._on_b)
+                bus.subscribe("c", self._on_c)
+
+            def _on_a(self, msg):
+                return self.bus.request("status", {})  # direct
+
+            def _on_b(self, msg):
+                self._helper(msg)
+
+            def _helper(self, msg):
+                self.bus.request("other", {})  # transitive
+
+            def _on_c(self, msg):
+                self.bus.publish("ok", msg)  # publish never blocks
+        """,
+        rules={"request-from-handler"},
+    )
+    syms = sorted(f.symbol for f in report.findings)
+    assert syms == ["Svc._helper", "Svc._on_a"], \
+        "\n".join(f.render() for f in report.findings)
+    assert all("dispatcher thread" in f.message for f in report.findings)
+
+
+def test_request_from_handler_nested_def_and_wrapped(tmp_path):
+    """serve()-style registration: a nested def subscribed through a
+    wrapper call still runs on the dispatcher thread; sibling nested
+    defs it calls are followed."""
+    report = _lint_src(
+        tmp_path, "served.py",
+        """
+        class Broker:
+            def serve(self, bus):
+                def _lookup(msg):
+                    return bus.request("mds.lookup", msg)
+
+                def _on_execute(msg):
+                    _lookup(msg)
+
+                bus.subscribe("broker.execute", _guarded(_on_execute))
+
+            def off_thread(self, bus):
+                # Not subscribed: requesting from a caller thread is
+                # fine (the client API does exactly this).
+                return bus.request("broker.execute", {})
+        """,
+        rules={"request-from-handler"},
+    )
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert f.symbol == "Broker.serve._lookup"
+    assert "_on_execute" in f.message
+
+
+def test_request_from_handler_uncalled_nested_def_is_clean(tmp_path):
+    """A nested def containing a request that the handler merely
+    DEFINES (handed to a worker thread, never invoked on the
+    dispatcher) is not a dispatcher-thread site; calling it is."""
+    report = _lint_src(
+        tmp_path, "defer.py",
+        """
+        import threading
+
+        class Defer:
+            def __init__(self, bus):
+                self.bus = bus
+                bus.subscribe("a", self._on_a)
+                bus.subscribe("b", self._on_b)
+
+            def _on_a(self, msg):
+                def lookup():
+                    return self.bus.request("mds.x", msg)
+
+                threading.Thread(target=lookup).start()  # off-thread
+
+            def _on_b(self, msg):
+                def lookup():
+                    return self.bus.request("mds.y", msg)
+
+                return lookup()  # ON the dispatcher thread
+        """,
+        rules={"request-from-handler"},
+    )
+    syms = [f.symbol for f in report.findings]
+    assert syms == ["Defer._on_b.lookup"], \
+        "\n".join(f.render() for f in report.findings)
+
+
+# -- blocking-call-under-lock: sleep + queue extension ------------------------
+
+def test_blocking_rule_flags_sleep_and_bare_queue_ops(tmp_path):
+    report = _lint_src(
+        tmp_path, "blk.py",
+        """
+        import threading
+        import time
+
+        class Blk:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = make_queue()
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def bad_get(self):
+                with self._lock:
+                    return self._q.get()
+
+            def bad_put(self, item):
+                with self._lock:
+                    self._q.put(item)
+        """,
+        rules={"blocking-call-under-lock"},
+    )
+    msgs = sorted(f.message for f in report.findings)
+    assert len(msgs) == 3, "\n".join(f.render() for f in report.findings)
+    assert any("time.sleep" in m for m in msgs)
+    assert any("_q.get() without a timeout" in m for m in msgs)
+    assert any("_q.put() without a timeout" in m for m in msgs)
+
+
+def test_blocking_rule_queue_timeout_forms_are_clean(tmp_path):
+    report = _lint_src(
+        tmp_path, "blkok.py",
+        """
+        import threading
+        import time
+
+        class BlkOk:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = make_queue()
+
+            def ok(self, item, d):
+                with self._lock:
+                    a = self._q.get(timeout=1.0)   # bounded wait
+                    b = self._q.get_nowait()       # non-blocking
+                    c = self._q.put(item, block=False)
+                    f = self._q.put(item, False)   # positional block
+                    g = self._q.put(item, True, 5) # positional timeout
+                    e = d.get("key")               # dict.get: not a queue
+                    return a, b, c, e, f, g
+
+            def unlocked(self):
+                time.sleep(0.1)        # no lock held
+                return self._q.get()   # no lock held
+        """,
+        rules={"blocking-call-under-lock"},
+    )
+    assert report.findings == [], \
+        "\n".join(f.render() for f in report.findings)
+
+
 # -- suppression + baseline machinery ----------------------------------------
 
 def test_inline_suppression(tmp_path):
