@@ -25,6 +25,9 @@ OBS_SCRIPTS = (
     # Storage tier: cluster-merged table health + per-agent watermark
     # lag over the __tables__ snapshots (TableStatsCollector fold).
     "px/table_health", "px/ingest_lag",
+    # Result cache: hit/miss/stale/bypass/view rollup per script hash
+    # over the __queries__ cache column (exec/result_cache.py).
+    "px/cache_stats",
 )
 
 

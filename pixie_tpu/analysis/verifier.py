@@ -675,10 +675,33 @@ def verify_distributed_plan(dplan, schemas=None,
 
     # The data fragment runs shard-local: no blocking operators (full/
     # finalize aggs, joins, unions, result sinks — splitter.h:75).
+    # Exception (pushdown_union_agg): a UnionOp whose sole consumer
+    # chain through row-wise ops ends at a PARTIAL AggOp — it unions
+    # shard-LOCAL rows only, and the partial agg's carry merge makes
+    # the per-agent interleaving unobservable downstream.
+    before_consumers: dict[int, list] = {}
+    for n in before.nodes.values():
+        for i in n.inputs:
+            before_consumers.setdefault(i, []).append(n.id)
+
+    def _feeds_partial_agg(union_nid: int) -> bool:
+        cur = union_nid
+        while True:
+            outs = before_consumers.get(cur, [])
+            if len(outs) != 1:
+                return False
+            nxt = before.nodes[outs[0]].op
+            if isinstance(nxt, AggOp):
+                return nxt.mode == "partial"
+            if not isinstance(nxt, (MapOp, FilterOp)):
+                return False
+            cur = outs[0]
+
     for nid, n in before.nodes.items():
         op = n.op
         blocking = (
-            isinstance(op, (JoinOp, UnionOp, ResultSinkOp))
+            isinstance(op, (JoinOp, ResultSinkOp))
+            or (isinstance(op, UnionOp) and not _feeds_partial_agg(nid))
             or (isinstance(op, AggOp) and op.mode != "partial")
         )
         if blocking:

@@ -304,7 +304,8 @@ def cmd_debug(args) -> int:
     if not rows and not res["in_flight"]:
         print("no recent queries")
         return 0
-    hdr = (f"{'qid':12s} {'tenant':8s} {'status':8s} {'ms':>9s} "
+    hdr = (f"{'qid':12s} {'tenant':8s} {'status':8s} {'cache':6s} "
+           f"{'ms':>9s} "
            f"{'rows':>9s} {'staged':>9s} {'pred':>9s} {'pred/obs':>8s} "
            f"{'device':>9s} {'wire':>9s} {'fresh':>9s} agents")
     print(hdr)
@@ -334,6 +335,8 @@ def cmd_debug(args) -> int:
             f"{row.get('qid') or row['id'][:12]:12s} "
             f"{row.get('tenant', '-') or '-':8s} "
             f"{row['status']:8s} "
+            # Result-cache disposition ("-" = cache not in play).
+            f"{row.get('cache') or '-':6s} "
             f"{row['duration_ms']:>9.1f} "
             f"{row.get('rows_out', u.get('rows_out', 0)):>9d} "
             f"{_fmt_bytes(u.get('bytes_staged', 0)):>9s} "

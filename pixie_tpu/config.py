@@ -417,6 +417,44 @@ define_flag(
     "it. Only matters while admission_bytes_budget_mb > 0.",
 )
 
+# -- result cache + materialized views (exec/result_cache.py, exec/views.py) -
+define_flag(
+    "result_cache_mb", 0,
+    "Byte budget (MB) for the watermark-validated merged-result cache "
+    "(broker execute_script + local engine.execute_query). A repeat of "
+    "a script whose scanned tables' cluster watermarks have not "
+    "advanced past the per-script staleness budget is served from the "
+    "cache with zero compile/admission/dispatch cost. 0 disables "
+    "(every query executes; the pre-cache behavior). Validity is "
+    "purely watermark comparison — never wall-clock TTL.",
+)
+define_flag(
+    "result_cache_staleness_ms", 0.0,
+    "Default per-script staleness budget (ms) for result-cache hits "
+    "when the script manifest carries no staleness_budget_ms field: a "
+    "cached result whose stored watermarks trail the current ones by "
+    "at most this much still serves (freshness_lag_ms re-stamped "
+    "against the CURRENT watermark). 0 = exact-watermark hits only.",
+)
+define_flag(
+    "view_auto_min_runs", 0,
+    "Observed-frequency heuristic for incremental materialized views: "
+    "a script executed at least this many times (ObservedCostIndex "
+    "runs + live counts) is auto-registered as a continuously "
+    "maintained view, answered as finalize-over-state instead of a "
+    "full rescan. 0 disables auto-registration (manifest "
+    "'materialize: true' opt-in still registers).",
+)
+define_flag(
+    "pushdown_union_agg", True,
+    "Distributed planner: place PEM-safe UnionOps (all inputs "
+    "PEM-resident and non-blocking, sole consumer chain ending at a "
+    "full AggOp) on the data agents so the downstream aggregate splits "
+    "into partial-on-PEM + AGG_STATE_MERGE, shipping sketch-sized "
+    "merge state (HLL registers, t-digest centroids) instead of "
+    "pre-agg rows over the union's ROW_GATHER bridges.",
+)
+
 # -- self-observability (services/telemetry.py) ------------------------------
 define_flag(
     "self_telemetry", True,

@@ -82,6 +82,9 @@ def run_broker() -> int:
             "scope": "cluster",
             "tables": tracker.table_freshness(),
         },
+        # Result cache: merged distributed results keyed by script +
+        # cluster watermarks (exec/result_cache.py).
+        cachez_fn=broker.result_cache.cachez,
     )
     obs_port = obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "6101")))
     print(
@@ -209,6 +212,11 @@ def _agent_obs(agent, extra=None) -> int:
             "scope": "agent",
             "agent_id": agent.agent_id,
             "tables": agent.engine.table_store.freshness(),
+        },
+        # Local-engine result cache + registered materialized views.
+        cachez_fn=lambda: {
+            **agent.engine.result_cache.cachez(),
+            "views": agent.engine.views.viewz(),
         },
     )
     return obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "0")))

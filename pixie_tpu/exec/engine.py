@@ -271,6 +271,16 @@ class Engine:
 
         self.device_memory = default_device_monitor()
         self.device_memory.start()  # no-op unless device_memory_poll_s
+        # Local result cache (exec/result_cache.py; result_cache_mb
+        # flag, 0 = off): broker-less deployments cache merged results
+        # at execute_query exactly like the broker's execute path.
+        from .result_cache import ResultCache
+
+        self.result_cache = ResultCache()
+        # Incremental materialized views (exec/views.py): lazily
+        # constructed on first use — ViewRegistry imports streaming,
+        # which imports this module.
+        self._views = None
 
     # -- per-query scratch plumbing ------------------------------------------
     # The underscore accessors keep the long-standing call sites in
@@ -368,10 +378,36 @@ class Engine:
         ``materialize=False`` leaves aggregate outputs device-resident
         (returns DeviceResult — call ``.to_host()`` for bytes)."""
         from ..planner import CompilerState, compile_pxl
+        from . import result_cache as rc
 
         # The query's lifecycle trace starts HERE so the parse/compile/
         # plan phase gets its own span; execute_plan ends the trace.
         trace = self.tracer.begin_query(script=query, analyze=analyze)
+        # Local result cache / materialized views (the broker-less
+        # repeat fast path): only for fully-materialized, non-analyze
+        # runs — an analyze run's point is the execution stats, and a
+        # DeviceResult must not be shared between callers.
+        servable = materialize and not analyze and "pxtrace" not in query
+        cache_status = ""
+        if servable and self.result_cache.enabled():
+            status, entry, lag_ms = self.result_cache.lookup(
+                query, now_ns, max_output_rows, self._table_watermark_ns
+            )
+            if status == rc.HIT:
+                trace.cache = rc.HIT
+                trace.usage.freshness_lag_ms = lag_ms
+                self.tracer.end_query(trace, status="ok")
+                return dict(entry.result)
+            cache_status = status
+        if servable:
+            view_res = self._try_view_answer(
+                query, now_ns, max_output_rows, trace
+            )
+            if view_res is not None:
+                trace.cache = rc.VIEW
+                self.tracer.end_query(trace, status="ok")
+                return view_res
+        trace.cache = cache_status
         try:
             with trace.span("compile"):
                 state = CompilerState(
@@ -387,8 +423,21 @@ class Engine:
                 trace, status="error", error=f"{type(e).__name__}: {e}"
             )
             raise
+        # Watermark snapshot BEFORE execution (conservative: ingest
+        # landing mid-scan makes the stored watermark older than
+        # reality, so the next lookup re-validates rather than
+        # over-trusting), and the cache disposition resolved before the
+        # trace ends so __queries__ rows carry it.
+        store_wms: dict | None = None
+        if servable and self.result_cache.enabled():
+            tables, _ = rc.scan_info(compiled.plan)
+            wms = {t: self._table_watermark_ns(t) for t in tables}
+            if tables and all(w is not None for w in wms.values()):
+                store_wms = wms
+            else:
+                trace.cache = rc.BYPASS
         try:
-            return self.execute_plan(
+            result = self.execute_plan(
                 compiled.plan, analyze=analyze, materialize=materialize,
                 trace=trace,
             )
@@ -405,6 +454,57 @@ class Engine:
                 error=f"{type(e).__name__}: {e}",
             )
             raise
+        if store_wms is not None and isinstance(result, dict):
+            self.result_cache.store(
+                query, state.now_ns, max_output_rows, compiled.plan,
+                result, store_wms.get,
+            )
+        return result
+
+    def _table_watermark_ns(self, table: str):
+        """Current max event-time watermark across ``table``'s tablets
+        (None = unknown table / no time index) — the local engine's
+        half of the result cache's validity predicate."""
+        from ..table_store import table as _table_mod
+
+        tablets = self.table_store.tablets(table)
+        if not tablets:
+            return None
+        return _table_mod.max_watermark_ns(tablets)
+
+    @property
+    def views(self):
+        """Lazily built ViewRegistry (exec/views.py) — deferred because
+        views ride StreamingQuery, whose module imports this one."""
+        if self._views is None:
+            from .views import ViewRegistry
+
+            self._views = ViewRegistry(self)
+        return self._views
+
+    def _try_view_answer(self, query: str, now_ns: int,
+                         max_output_rows: int, trace):
+        """Materialized-view fast path: count the run, auto/manifest-
+        register when warranted, and answer finalize-over-state when a
+        registered view covers this query. None = execute normally.
+        Never raises — a view failure falls back to full execution."""
+        from .views import view_candidates_enabled
+
+        if not view_candidates_enabled(query):
+            return None
+        try:
+            return self.views.serve(
+                query, now_ns=now_ns, max_output_rows=max_output_rows,
+                trace=trace,
+            )
+        except Exception:
+            import logging
+
+            logging.getLogger("pixie_tpu.views").warning(
+                "materialized-view answer failed; executing normally",
+                exc_info=True,
+            )
+            return None
 
     def _compile_table_stats(self) -> dict:
         """Ingest-sketch stats snapshot for the optimizer
@@ -769,12 +869,10 @@ class Engine:
         trace = getattr(qstats, "trace", None) if qstats is not None else None
         if trace is None:
             return
-        wm = -1
-        for t in tablets:
-            w = t.watermark_ns
-            if w is not None and w > wm:
-                wm = w
-        if wm < 0:
+        from ..table_store import table as _table_mod
+
+        wm = _table_mod.max_watermark_ns(tablets)
+        if wm is None:
             return  # no time index / nothing appended: no signal
         ref = op.stop_time if op.stop_time is not None else time.time_ns()
         trace.note_freshness_lag(op.table, (int(ref) - wm) / 1e6)

@@ -1,0 +1,356 @@
+"""Watermark-validated merged-result cache (the repeat-query fast path).
+
+The dominant workload is the same ~75 bundled ``px/`` scripts
+re-executed over moving time windows; every run today rescans O(data)
+rows to recompute an answer that — between ingest watermark advances —
+cannot have changed. PR 14's never-regressing per-table event-time
+watermarks (``Table.watermark_ns``, cluster-merged by
+``AgentTracker.table_stats()``) are exactly the validity predicate a
+result cache needs, so this module caches a query's *merged result*
+keyed on the script and validates it purely by watermark comparison —
+never wall-clock TTL.
+
+Key and validity
+----------------
+
+An entry is keyed on ``(sha256(script text), max_output_rows)`` —
+deliberately NOT on ``now_ns``: a dashboard replaying the same script
+over an advancing window must still hit. Instead each entry stores, at
+execute time,
+
+- the scanned-table set (from the compiled plan's MemorySourceOps) and
+  each table's watermark,
+- the resolved ``now_ns`` the time predicates were compiled against,
+- whether the plan is time-dependent at all (any start/stop bound).
+
+A lookup re-reads the CURRENT watermarks for the stored table set (no
+compile needed — that is what makes a hit zero-cost) and classifies:
+
+- ``miss``   — no entry, or a stored watermark EXCEEDS the current one
+  (watermark regression: table expiry churn or an agent lost from the
+  cluster view — the cached answer may cover rows that no longer
+  exist, so the entry is dropped, and the re-execution degrades
+  through the normal partial-results machinery exactly like a live
+  query would);
+- ``hit``    — every scanned table's watermark is unchanged, or
+  advanced by at most the script's staleness budget, AND (for
+  time-dependent plans) the requested ``now`` drifted from the stored
+  one by at most that same budget. The served result re-stamps
+  ``freshness_lag_ms`` against the CURRENT clock/watermarks: a hit is
+  honest about its age.
+- ``stale``  — entry exists but a watermark advanced (or ``now``
+  drifted) beyond the budget: the caller re-executes and the fresh
+  result replaces the entry.
+
+The staleness budget comes from the script's manifest
+(``staleness_budget_ms`` in ``manifest.yaml``) when the executed text
+IS a bundled script, else the ``result_cache_staleness_ms`` flag.
+Results that are partial, mutation-bearing (pxtrace), or scan a table
+with no watermark are never stored (``bypass``).
+
+Capacity is a byte-budgeted LRU ring (``result_cache_mb``; 0 disables
+the cache entirely). Metrics: ``pixie_result_cache_{hits,misses,
+stale}_total`` counters + the ``pixie_result_cache_bytes`` gauge
+(inc/dec so broker- and engine-side instances sum). ``cachez()`` is
+the ``/debug/cachez`` payload.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ..config import get_flag
+
+#: Statuses a query trace's ``cache`` field can carry.
+HIT, MISS, STALE, BYPASS, VIEW = "hit", "miss", "stale", "bypass", "view"
+
+
+def script_sha(script: str) -> str:
+    return hashlib.sha256((script or "").encode()).hexdigest()
+
+
+def scan_info(plan) -> tuple[tuple, bool]:
+    """(scanned tables, time_dependent) from a compiled logical plan:
+    the stored half of the validity predicate. ``time_dependent`` is
+    True when any source carries a start/stop bound — only then can a
+    repeat at a later ``now`` select different rows from UNCHANGED
+    data (the window slid), so only then does the ``now``-drift check
+    apply."""
+    from .plan import MemorySourceOp
+
+    tables: list = []
+    time_dep = False
+    for nid in plan.topo_order():
+        op = plan.nodes[nid].op
+        if isinstance(op, MemorySourceOp):
+            if op.table not in tables:
+                tables.append(op.table)
+            if op.start_time is not None or op.stop_time is not None:
+                time_dep = True
+    return tuple(tables), time_dep
+
+
+def result_nbytes(obj) -> int:
+    """Recursive payload size estimate: HostBatch/ndarray ``.nbytes``
+    where available, container sums otherwise. Feeds the LRU budget —
+    an estimate, so it only needs to be proportional, not exact."""
+    nb = getattr(obj, "nbytes", None)
+    if nb is not None:
+        try:
+            return int(nb)
+        except (TypeError, ValueError):
+            pass
+    if isinstance(obj, (bytes, bytearray)):
+        return len(obj)
+    if isinstance(obj, str):
+        return len(obj.encode("utf-8", "ignore"))
+    if isinstance(obj, dict):
+        return sum(
+            result_nbytes(k) + result_nbytes(v) for k, v in obj.items()
+        )
+    if isinstance(obj, (list, tuple)):
+        return sum(result_nbytes(v) for v in obj)
+    return 64  # scalars / small objects
+
+
+_BUDGET_CACHE: dict | None = None
+_BUDGET_LOCK = threading.Lock()
+
+
+def manifest_budgets() -> dict:
+    """{sha256(pxl text): staleness_budget_ms} over the shipped script
+    library — how a manifest's ``staleness_budget_ms`` reaches the
+    cache when the executed text is a bundled script (the broker sees
+    raw PxL, not script names). Loaded once per process."""
+    global _BUDGET_CACHE
+    with _BUDGET_LOCK:
+        if _BUDGET_CACHE is None:
+            budgets: dict = {}
+            try:
+                from ..scripts import load_all
+
+                for sd in load_all():
+                    ms = sd.manifest.get("staleness_budget_ms")
+                    if ms is not None:
+                        budgets[script_sha(sd.pxl)] = float(ms)
+            except Exception:
+                pass  # no script library (stripped deploys) — flag only
+            _BUDGET_CACHE = budgets
+        return _BUDGET_CACHE
+
+
+@dataclass
+class CacheEntry:
+    key: tuple
+    script_hash: str  # short hash (trace/script_hash parity, 12 hex)
+    sha: str  # full key hash (manifest budget lookup)
+    result: dict
+    tables: tuple
+    watermarks: dict  # table -> watermark_ns at store time
+    stored_now_ns: int  # resolved compile-time now (time predicates)
+    time_dependent: bool
+    nbytes: int
+    stored_unix_ns: int = field(default_factory=time.time_ns)
+    hits: int = 0
+
+
+class ResultCache:
+    """Byte-budgeted LRU of merged query results, watermark-validated.
+
+    Thread-safe; shared by the broker's execute path and (a separate
+    instance) the local engine. All methods are cheap: a lookup is a
+    dict probe + one watermark read per scanned table.
+    """
+
+    def __init__(self, registry=None):
+        self._entries: "OrderedDict[tuple, CacheEntry]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._registry = registry
+        self._metrics: dict | None = None
+
+    # -- config --------------------------------------------------------------
+    @staticmethod
+    def budget_bytes() -> int:
+        return int(get_flag("result_cache_mb")) << 20
+
+    def enabled(self) -> bool:
+        return self.budget_bytes() > 0
+
+    @staticmethod
+    def staleness_budget_ms(sha: str) -> float:
+        ms = manifest_budgets().get(sha)
+        if ms is None:
+            ms = float(get_flag("result_cache_staleness_ms"))
+        return max(0.0, ms)
+
+    # -- metrics -------------------------------------------------------------
+    def _m(self) -> dict:
+        if self._metrics is None:
+            reg = self._registry
+            if reg is None:
+                from ..services.observability import default_registry
+
+                reg = self._registry = default_registry
+            self._metrics = {
+                HIT: reg.counter(
+                    "pixie_result_cache_hits_total",
+                    "Queries served from the watermark-validated result "
+                    "cache (zero compile/admission/dispatch cost)",
+                ),
+                MISS: reg.counter(
+                    "pixie_result_cache_misses_total",
+                    "Cacheable queries with no valid entry (absent or "
+                    "watermark-regressed)",
+                ),
+                STALE: reg.counter(
+                    "pixie_result_cache_stale_total",
+                    "Cache entries found but past the script's "
+                    "staleness budget (re-executed and replaced)",
+                ),
+                "bytes": reg.gauge(
+                    "pixie_result_cache_bytes",
+                    "Bytes held by result-cache entries (LRU budget "
+                    "result_cache_mb; summed across broker + engine "
+                    "instances)",
+                ),
+            }
+        return self._metrics
+
+    # -- core ----------------------------------------------------------------
+    def lookup(self, script: str, now_ns: int, max_output_rows: int,
+               wm_of) -> tuple[str, CacheEntry | None, float]:
+        """Classify a repeat: ``(status, entry, freshness_lag_ms)``.
+
+        ``wm_of(table) -> int | None`` reads the CURRENT watermark
+        (cluster-merged at the broker, local max at an engine).
+        ``entry`` is non-None only for ``hit``; ``freshness_lag_ms`` is
+        the re-stamped staleness the served result should carry (worst
+        scanned table, measured against the current clock).
+        """
+        sha = script_sha(script)
+        key = (sha, int(max_output_rows))
+        with self._lock:
+            e = self._entries.get(key)
+        if e is None:
+            self._m()[MISS].inc()
+            return MISS, None, 0.0
+        budget_ms = self.staleness_budget_ms(sha)
+        req_now = int(now_ns) or time.time_ns()
+        stale = False
+        lag_ms = 0.0
+        for t in e.tables:
+            cur = wm_of(t)
+            stored = e.watermarks[t]
+            if cur is None or cur < stored:
+                # Watermark regression: expiry churn or an agent fell
+                # out of the cluster view — rows the cached answer
+                # covers may be gone. Drop the entry; the re-execution
+                # degrades like any live query (partial results).
+                self._drop(key)
+                self._m()[MISS].inc()
+                return MISS, None, 0.0
+            if (cur - stored) / 1e6 > budget_ms:
+                stale = True
+            lag_ms = max(lag_ms, (req_now - stored) / 1e6)
+        if e.time_dependent and (req_now - e.stored_now_ns) / 1e6 > budget_ms:
+            stale = True
+        if stale:
+            self._m()[STALE].inc()
+            return STALE, None, 0.0
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+            e.hits += 1
+        self._m()[HIT].inc()
+        return HIT, e, max(0.0, round(lag_ms, 3))
+
+    def store(self, script: str, resolved_now_ns: int,
+              max_output_rows: int, plan, result: dict, wm_of) -> str:
+        """Insert a freshly computed result; returns the disposition
+        for the trace (``miss`` = stored, ``bypass`` = not cacheable:
+        no scanned table, or a scanned table with no watermark yet —
+        without a watermark there is no validity predicate)."""
+        tables, time_dep = scan_info(plan)
+        if not tables:
+            return BYPASS
+        wms: dict = {}
+        for t in tables:
+            wm = wm_of(t)
+            if wm is None:
+                return BYPASS
+            wms[t] = int(wm)
+        nbytes = result_nbytes(result)
+        budget = self.budget_bytes()
+        if nbytes > budget:
+            return MISS  # counted at lookup; too big to ever serve
+        sha = script_sha(script)
+        key = (sha, int(max_output_rows))
+        e = CacheEntry(
+            key=key, script_hash=sha[:12], sha=sha, result=result,
+            tables=tables, watermarks=wms,
+            stored_now_ns=int(resolved_now_ns) or time.time_ns(),
+            time_dependent=time_dep, nbytes=nbytes,
+        )
+        evicted = []
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = e
+            self._bytes += nbytes
+            while self._bytes > budget and len(self._entries) > 1:
+                k, victim = self._entries.popitem(last=False)
+                self._bytes -= victim.nbytes
+                evicted.append(k)
+            total = self._bytes
+        self._m()["bytes"].set(total)
+        return MISS
+
+    def _drop(self, key: tuple) -> None:
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self._bytes -= e.nbytes
+            total = self._bytes
+        self._m()["bytes"].set(total)
+
+    def clear(self) -> None:
+        """Drop everything — the agent-churn hammer: a register or
+        expiry changes which shards a merged result covers, and the
+        cluster watermark alone cannot always see that (a restarted
+        agent may re-report the same max). Cheap to be conservative:
+        the next repeat re-executes and re-primes."""
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+        self._m()["bytes"].set(0)
+
+    # -- introspection (/debug/cachez) ---------------------------------------
+    def cachez(self) -> dict:
+        with self._lock:
+            entries = [
+                {
+                    "script_hash": e.script_hash,
+                    "tables": list(e.tables),
+                    "watermarks": dict(e.watermarks),
+                    "time_dependent": e.time_dependent,
+                    "nbytes": e.nbytes,
+                    "hits": e.hits,
+                    "stored_unix_ns": e.stored_unix_ns,
+                    "max_output_rows": e.key[1],
+                    "staleness_budget_ms": self.staleness_budget_ms(e.sha),
+                }
+                for e in self._entries.values()
+            ]
+            total = self._bytes
+        return {
+            "enabled": self.enabled(),
+            "budget_bytes": self.budget_bytes(),
+            "bytes": total,
+            "entries": entries,
+        }

@@ -178,6 +178,12 @@ class StreamingQuery:
                 self._wm[id(t)] = be.row_id_for_time(int(start), False)
             else:
                 self._wm[id(t)] = be.first_row_id()
+        # Where the CURRENT agg state's fold started, per tablet: ring
+        # expiry crossing this mark means folded rows are gone and the
+        # persistent state must refold from the live rows (otherwise a
+        # replace-mode aggregate keeps counting expired rows a one-shot
+        # rescan would not see).
+        self._fold_lo: dict = dict(self._wm)
         self._state = None
         self._frag = None
         # One lifecycle trace per stream (exec/trace.py): the stream
@@ -289,6 +295,19 @@ class StreamingQuery:
         """Shared agg half: fold newly appended windows into the
         persistent group state. Returns (rows, folded)."""
         rows = 0
+        if self._state is not None:
+            for t in self.tablets:
+                be = getattr(t, "_backend", None)
+                if be is not None and (
+                    be.first_row_id() > self._fold_lo.get(id(t), 0)
+                ):
+                    # Ring expiry dropped rows ALREADY folded into the
+                    # persistent state — refold from the live rows so
+                    # the replace-mode aggregate matches what a
+                    # one-shot rescan would compute (materialized-view
+                    # bit-identity across expiry churn).
+                    self._state = None
+                    break
         if self._state is None:
             self._state = frag.init_state()
             # Restart folds everything from the source's start.
@@ -296,11 +315,15 @@ class StreamingQuery:
                 be = getattr(t, "_backend", None)
                 if be is not None:
                     start = self.chain.source.start_time
-                    self._wm[id(t)] = (
+                    pos = (
                         be.row_id_for_time(int(start), False)
                         if start is not None
                         else be.first_row_id()
                     )
+                    self._wm[id(t)] = pos
+                    # The effective fold start: expiry may already sit
+                    # past a time-derived position.
+                    self._fold_lo[id(t)] = max(pos, be.first_row_id())
         folded = False
         st = self._tstats
         pipe = self._pipelined_windows()
@@ -335,24 +358,29 @@ class StreamingQuery:
         """Stamp this poll's staleness (now minus the source table's max
         event-time watermark) on the stream's trace: the usage field
         keeps the worst round — a live view that fell behind its ingest
-        shows its backlog in __queries__ like any one-shot query."""
+        shows its backlog in __queries__ like any one-shot query.
+        Exactly ONE watermark sweep per poll round: the overflow-
+        rebucket retry re-enters ``_poll_inner``, not ``poll``, so it
+        cannot re-sweep (shared helper + call structure; regression
+        test in tests/test_result_cache.py)."""
         if self.trace is None:
             return
-        wm = -1
-        for t in self.tablets:
-            w = getattr(t, "watermark_ns", None)
-            if w is not None and w > wm:
-                wm = w
-        if wm >= 0:
+        from ..table_store import table as _table_mod
+
+        wm = _table_mod.max_watermark_ns(self.tablets)
+        if wm is not None:
             self.trace.note_freshness_lag(
                 self.chain.source.table, (time.time_ns() - wm) / 1e6
             )
 
     def poll(self) -> int:
         """Fold new rows; emit updates. Returns rows consumed."""
+        self._note_freshness()
+        return self._poll_inner()
+
+    def _poll_inner(self) -> int:
         frag = self._frag
         rows = 0
-        self._note_freshness()
         if self.chain.bridge_id is not None:
             return self._poll_bridge(frag)
         if self.chain.is_agg:
@@ -362,7 +390,7 @@ class StreamingQuery:
             cols, valid, overflow = frag.finalize(self._state)
             if bool(np.asarray(overflow)):
                 self._rebucket()
-                return self.poll()
+                return self._poll_inner()
             hb = _to_host_batch(frag.out_meta, cols, np.asarray(valid))
             if frag.limit is not None and hb.length > frag.limit:
                 hb = _head(hb, frag.limit)

@@ -318,6 +318,11 @@ class QueryTrace:
         # same attribution. "" = not a tenant-scoped query (bare local
         # engines).
         self.tenant = ""
+        # Result-cache disposition (exec/result_cache.py): "hit" /
+        # "miss" / "stale" / "bypass" / "view"; "" = cache not in play
+        # (disabled, or a path the cache never sees). Flows to
+        # __queries__ and `px debug queries`.
+        self.cache = ""
         self.status = "running"
         self.error = ""
         self.start_unix_nano = time.time_ns()
@@ -489,6 +494,8 @@ class QueryTrace:
             d["agent_id"] = self.agent_id
         if self.tenant:
             d["tenant"] = self.tenant
+        if self.cache:
+            d["cache"] = self.cache
         if self.agent_usage:
             d["agent_usage"] = dict(self.agent_usage)
         if self.predicted:

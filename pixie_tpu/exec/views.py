@@ -1,0 +1,270 @@
+"""Incremental materialized views: hot scripts answered as
+finalize-over-state instead of a full rescan.
+
+The streaming cursor (``exec/streaming.py``) already IS incremental
+view maintenance — a blocking aggregate's group state persists across
+polls and each poll folds only the windows appended since the last
+one. This module wraps one :class:`StreamingQuery` per registered hot
+script as a :class:`MaterializedView`: a dashboard repeat triggers one
+``poll()`` (folding only the NEW ingest windows — O(new data), not
+O(data)) and is answered from the captured ``mode="replace"`` batch.
+
+Registration is manifest opt-in (``materialize: true`` in a bundled
+script's ``manifest.yaml``) plus an observed-frequency heuristic: a
+script executed at least ``view_auto_min_runs`` times (live run counts
+seeded from the ``ObservedCostIndex``/telemetry ``runs`` history — the
+arXiv:2102.02440 feedback loop steering what to materialize)
+auto-registers. 0 disables auto-registration.
+
+Correctness properties, tested in ``tests/test_result_cache.py``:
+
+- **bit-identity** — a view answer equals the full one-shot rescan at
+  the same ``now``: same fragment update path, same window order, same
+  finalize.
+- **rebucket survival** — group overflow recompiles at doubled
+  capacity and refolds from the source start (StreamingQuery's
+  ``_rebucket``); the next answer is still exact.
+- **expiry churn** — ring expiry crossing the state's fold-start mark
+  refolds from the live rows (``StreamingQuery._fold_new``), so the
+  view never keeps counting rows a rescan would no longer see.
+
+A view registered at time T serves time-windowed scripts (relative
+``start_time``) only while the requested ``now`` stays within the
+script's staleness budget of T (same budget source as the result
+cache); past it the view re-registers at the new ``now`` — one full
+refold, then incremental again. Views expose their own freshness
+(source-table watermark lag at answer time) and show up in
+``/debug/cachez``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..config import get_flag
+from .engine import QueryError
+from .result_cache import manifest_budgets, script_sha
+
+_MAT_LOCK = threading.Lock()
+_MAT_CACHE: set | None = None
+
+
+def manifest_materialized() -> set:
+    """sha256(pxl) of every bundled script opting in via
+    ``materialize: true`` — loaded once per process."""
+    global _MAT_CACHE
+    with _MAT_LOCK:
+        if _MAT_CACHE is None:
+            shas: set = set()
+            try:
+                from ..scripts import load_all
+
+                for sd in load_all():
+                    if sd.manifest.get("materialize"):
+                        shas.add(script_sha(sd.pxl))
+            except Exception:
+                pass  # no script library: heuristic-only registration
+            _MAT_CACHE = shas
+        return _MAT_CACHE
+
+
+def view_candidates_enabled(query: str) -> bool:
+    """Cheap pre-gate for the engine's execute path: views are in play
+    only when auto-registration is on, or when the repeat-serving tier
+    (``result_cache_mb``) is enabled AND this script text opted in via
+    its manifest — a manifest ``materialize: true`` is a hint that only
+    activates with the tier, so the all-flags-default path stays
+    byte-for-byte the plain execute path. Costs one/two flag reads +
+    (at most) one sha per query."""
+    if "pxtrace" in query:
+        return False
+    if int(get_flag("view_auto_min_runs")) > 0:
+        return True
+    if int(get_flag("result_cache_mb")) <= 0:
+        return False
+    mats = manifest_materialized()
+    return bool(mats) and script_sha(query) in mats
+
+
+class MaterializedView:
+    """One continuously maintained view: a StreamingQuery over an
+    aggregate chain + the latest captured finalize batch."""
+
+    def __init__(self, engine, script: str, now_ns: int = 0,
+                 max_output_rows: int = 10_000):
+        from .streaming import stream_query
+
+        self.script = script
+        self.sha = script_sha(script)
+        self.now_ns = int(now_ns) or time.time_ns()
+        self.max_output_rows = int(max_output_rows)
+        self.registered_unix_ns = time.time_ns()
+        self._last: dict = {}
+        self._lock = threading.Lock()
+        self.answers = 0
+        self.sq = stream_query(
+            engine, script, emit=self._capture,
+            now_ns=self.now_ns, max_output_rows=self.max_output_rows,
+        )
+        if not self.sq.chain.is_agg:
+            self.sq.close()
+            raise QueryError(
+                "only aggregate chains materialize: an append stream "
+                "has no finalize-over-state to answer from"
+            )
+
+    def _capture(self, update) -> None:
+        if update.mode == "replace":
+            self._last[update.table] = update.batch
+
+    @property
+    def time_dependent(self) -> bool:
+        return self.sq.chain.source.start_time is not None
+
+    def freshness_lag_ms(self) -> float:
+        """How far the view's source table trails the clock right now
+        (its own freshness surface; 0 = fresh / no time index)."""
+        from ..table_store import table as _table_mod
+
+        wm = _table_mod.max_watermark_ns(self.sq.tablets)
+        if wm is None:
+            return 0.0
+        return max(0.0, round((time.time_ns() - wm) / 1e6, 3))
+
+    def answer(self) -> dict:
+        """Fold the windows appended since the last answer (O(new
+        data)) and return {sink: HostBatch} — the finalize-over-state
+        result a full rescan would have recomputed."""
+        with self._lock:
+            self.sq.poll()
+            self.answers += 1
+            return dict(self._last)
+
+    def close(self) -> None:
+        self.sq.close()
+
+    def to_dict(self) -> dict:
+        return {
+            "script_hash": self.sha[:12],
+            "table": self.sq.chain.source.table,
+            "sink": self.sq.chain.sink_name,
+            "time_dependent": self.time_dependent,
+            "max_output_rows": self.max_output_rows,
+            "answers": self.answers,
+            "polls": self.sq.seq,
+            "registered_unix_ns": self.registered_unix_ns,
+            "freshness_lag_ms": self.freshness_lag_ms(),
+        }
+
+
+class ViewRegistry:
+    """Per-engine registry: run counting, manifest/heuristic
+    registration, drift re-registration, and the answer path."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._lock = threading.RLock()
+        self._views: dict = {}   # (sha, max_output_rows) -> view
+        self._runs: dict = {}    # sha -> live run count (this process)
+        self._failed: set = set()  # shas that cannot stream — don't retry
+
+    # -- registration --------------------------------------------------------
+    def _observed_runs(self, sha: str) -> int:
+        """Telemetry-seeded run history (ObservedCostIndex ``runs`` per
+        short script hash): the observed-frequency heuristic counts
+        past sessions' repeats, not just this process's."""
+        tel = getattr(self.engine, "telemetry", None)
+        if tel is None:
+            return 0
+        try:
+            return int((tel.observed().get(sha[:12]) or {}).get("runs", 0))
+        except Exception:
+            return 0
+
+    def _should_register(self, sha: str) -> bool:
+        if sha in manifest_materialized():
+            return True
+        min_runs = int(get_flag("view_auto_min_runs"))
+        if min_runs <= 0:
+            return False
+        return (
+            self._runs.get(sha, 0) + self._observed_runs(sha) >= min_runs
+        )
+
+    def register(self, query: str, now_ns: int = 0,
+                 max_output_rows: int = 10_000) -> MaterializedView:
+        """Explicit registration (tests / ops); raises QueryError when
+        the script is not streamable (joins, unions, bounded sources)."""
+        sha = script_sha(query)
+        v = MaterializedView(
+            self.engine, query, now_ns=now_ns,
+            max_output_rows=max_output_rows,
+        )
+        with self._lock:
+            old = self._views.pop((sha, int(max_output_rows)), None)
+            self._views[(sha, int(max_output_rows))] = v
+        if old is not None:
+            old.close()
+        return v
+
+    # -- the execute-path hook -----------------------------------------------
+    def serve(self, query: str, now_ns: int = 0,
+              max_output_rows: int = 10_000, trace=None):
+        """Answer ``query`` from a registered view, registering one
+        first when the manifest/heuristic says so. None = no view
+        covers this query; execute normally."""
+        from .result_cache import ResultCache
+
+        sha = script_sha(query)
+        key = (sha, int(max_output_rows))
+        req_now = int(now_ns) or time.time_ns()
+        with self._lock:
+            self._runs[sha] = self._runs.get(sha, 0) + 1
+            v = self._views.get(key)
+            if v is None:
+                if sha in self._failed or not self._should_register(sha):
+                    return None
+                try:
+                    v = self.register(
+                        query, now_ns=now_ns,
+                        max_output_rows=max_output_rows,
+                    )
+                except QueryError:
+                    # Not streamable (joins/unions/bounded sources):
+                    # remember, so every later repeat skips the compile.
+                    self._failed.add(sha)
+                    return None
+            elif v.time_dependent:
+                budget_ms = ResultCache.staleness_budget_ms(sha)
+                if (req_now - v.now_ns) / 1e6 > budget_ms:
+                    # The requested window drifted past the budget:
+                    # re-register at the new now — one full refold,
+                    # then incremental again.
+                    try:
+                        v = self.register(
+                            query, now_ns=req_now,
+                            max_output_rows=max_output_rows,
+                        )
+                    except QueryError:
+                        self._failed.add(sha)
+                        return None
+        result = v.answer()
+        if trace is not None:
+            trace.note_freshness_lag(
+                v.sq.chain.source.table, v.freshness_lag_ms()
+            )
+        return result
+
+    # -- introspection (/debug/cachez "views" section) -----------------------
+    def viewz(self) -> list:
+        with self._lock:
+            views = list(self._views.values())
+        return [v.to_dict() for v in views]
+
+    def close(self) -> None:
+        with self._lock:
+            views = list(self._views.values())
+            self._views.clear()
+        for v in views:
+            v.close()

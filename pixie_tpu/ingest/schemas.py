@@ -289,6 +289,10 @@ QUERIES_RELATION = Relation(
         # fresh OR no time-indexed scan — the exact validity predicate
         # a result cache keyed on (script hash, table watermark) checks.
         ("freshness_lag_ms", DataType.FLOAT64),
+        # Result-cache disposition: hit|miss|stale|bypass|view ("" =
+        # cache not in play — disabled, or a fragment/merge trace).
+        # px/cache_stats rolls hit rates per script hash over this.
+        ("cache", DataType.STRING),
     ]
 )
 

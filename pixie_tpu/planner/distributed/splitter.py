@@ -75,6 +75,64 @@ def _is_blocking(op: Op) -> bool:
     )
 
 
+def _pushdown_unions(plan: Plan) -> set:
+    """UnionOp node ids safe to keep on the PEM side (pushdown_union_agg).
+
+    A union is blocking in general — its output interleaves rows from
+    every agent. But when (1) every transitive input is shard-local and
+    row-wise (MemorySource/EmptySource leaves through Map/Filter only)
+    and (2) its sole consumer chain through row-wise ops ends at a full
+    AggOp, the union can run per-agent: the downstream agg then splits
+    into a partial half on the PEM side and its AGG_STATE_MERGE bridge
+    ships sketch-sized mergeable carries (HLL registers, t-digest
+    centroids) instead of the union's pre-agg rows over one ROW_GATHER
+    bridge per branch. Order-insensitivity of the agg's update/merge is
+    what makes the per-agent interleaving unobservable.
+    """
+    from ...config import get_flag
+
+    if not get_flag("pushdown_union_agg"):
+        return set()
+    consumers: dict[int, list[int]] = {}
+    for nid, node in plan.nodes.items():
+        for i in node.inputs:
+            consumers.setdefault(i, []).append(nid)
+    safe: set = set()
+    for nid, node in plan.nodes.items():
+        if not isinstance(node.op, UnionOp):
+            continue
+        # (1) every transitive input is PEM-resident and non-blocking.
+        stack, ok = list(node.inputs), True
+        while stack and ok:
+            i = stack.pop()
+            iop = plan.nodes[i].op
+            if isinstance(iop, (MemorySourceOp, EmptySourceOp)):
+                continue
+            if _is_blocking(iop) or isinstance(iop, UDTFSourceOp):
+                ok = False
+            else:  # Map/Filter: row-wise, keep walking up
+                stack.extend(plan.nodes[i].inputs)
+        if not ok:
+            continue
+        # (2) sole consumer chain through row-wise ops ends at a full agg.
+        cur = nid
+        while ok:
+            outs = consumers.get(cur, [])
+            if len(outs) != 1:
+                ok = False
+                break
+            cop = plan.nodes[outs[0]].op
+            if isinstance(cop, AggOp):
+                break  # the splitter walk will make this a partial agg
+            if _is_blocking(cop) or isinstance(cop, UDTFSourceOp):
+                ok = False
+                break
+            cur = outs[0]
+        if ok:
+            safe.add(nid)
+    return safe
+
+
 class Splitter:
     """Splits one logical plan; ``registry`` resolves UDTF executor
     classes (udtf.h UDTFSourceExecutor -> which tier runs the source)."""
@@ -93,6 +151,7 @@ class Splitter:
         before, after = Plan(), Plan()
         bridges: list[BridgeSpec] = []
         data_tier = "pem"
+        pushdown = _pushdown_unions(plan)
         # logical node id -> ('pem', new_id) | ('kelvin', new_id)
         placed: dict[int, tuple[str, int]] = {}
 
@@ -142,6 +201,14 @@ class Splitter:
                 ])
                 placed[nid] = ("pem", new_id)
                 to_kelvin(nid)  # aggs always bridge (their output is global)
+            elif (isinstance(op, UnionOp) and nid in pushdown
+                  and not inputs_kelvin):
+                # Push-down: a PEM-safe union stays on the data tier so
+                # the downstream agg takes the partial-split branch and
+                # its bridge ships merge state, not the union's rows.
+                placed[nid] = ("pem", before.add(
+                    op, [placed[i][1] for i in node.inputs]
+                ))
             elif isinstance(op, LimitOp) and not inputs_kelvin:
                 # LimitOperatorMgr: local cap on each agent, global cap
                 # after the gather.

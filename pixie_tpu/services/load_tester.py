@@ -53,10 +53,25 @@ class LoadReport:
     # not in this process (remote broker) or saw no observations.
     hist_quantiles_s: dict | None = None
     hist_count: int = 0
+    # Repeat-mode only: result-cache disposition counts over the run
+    # ({"hit": 37, "miss": 1, ...} from the broker reply's ``cache``
+    # key / the engine trace). Empty outside --repeat-script runs.
+    cache_counts: dict = field(default_factory=dict)
 
     @property
     def failure_rate(self) -> float:
         return self.errors / self.queries if self.queries else 0.0
+
+    @property
+    def cache_hit_rate(self) -> float:
+        """hit + view over all queries that reported a disposition
+        (a "view" answer IS a repeat served from sketch state)."""
+        total = sum(self.cache_counts.values())
+        if not total:
+            return 0.0
+        served = (self.cache_counts.get("hit", 0)
+                  + self.cache_counts.get("view", 0))
+        return served / total
 
     def percentile(self, p: float) -> float:
         """Nearest-rank percentile: ceil(p/100 * N)-th smallest."""
@@ -89,6 +104,9 @@ class LoadReport:
             out["hist_count"] = self.hist_count
             for q, v in sorted(self.hist_quantiles_s.items()):
                 out[f"hist_p{int(q * 100)}_ms"] = round(v * 1e3, 2)
+        if self.cache_counts:
+            out["cache_counts"] = dict(self.cache_counts)
+            out["cache_hit_rate"] = round(self.cache_hit_rate, 4)
         return out
 
 
@@ -289,6 +307,112 @@ def run_concurrency_sweep(
     return out
 
 
+def run_repeat_load(
+    execute,
+    query: str,
+    qps: float = 10.0,
+    count: int = 50,
+    timeout_s: float = 30.0,
+    status_fn=None,
+    **tenancy_kw,
+) -> LoadReport:
+    """The ``--repeat-script`` axis: ONE client firing the SAME script
+    ``count`` times at a fixed ``qps`` — the dashboard-refresh shape the
+    result cache exists for. Each reply's cache disposition (broker
+    reply ``cache`` key, or ``status_fn(res)`` for executors that don't
+    carry one) is tallied into ``report.cache_counts``; latencies and
+    the serving-histogram delta are recorded exactly like ``run_load``,
+    so two runs of this under cache-on/cache-off flags are directly
+    comparable (``run_repeat_ab``)."""
+    report = LoadReport()
+    kw = {k: v for k, v in tenancy_kw.items() if v is not None}
+    interval = 1.0 / qps if qps > 0 else 0.0
+    hist_before = _hist_snapshot()
+    t_start = time.perf_counter()
+    next_t = t_start
+    for _ in range(max(1, int(count))):
+        # Fixed-rate pacing on the SCHEDULE, not the completion: a slow
+        # query eats into the next slot instead of silently lowering
+        # the offered rate (open-loop load, the dashboard's behavior).
+        if interval:
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(next_t - now)
+            next_t += interval
+        t0 = time.perf_counter()
+        err = None
+        status = ""
+        fresh_ms = 0.0
+        partial = False
+        try:
+            res = execute(query, timeout_s, **kw)
+            partial = bool(isinstance(res, dict) and res.get("partial"))
+            if status_fn is not None:
+                status = status_fn(res) or ""
+            elif isinstance(res, dict):
+                status = res.get("cache", "") or ""
+            v = res.get("freshness_lag_ms") if isinstance(res, dict) else None
+            if v is None:
+                v = getattr(res, "freshness_lag_ms", None)
+            fresh_ms = float(v or 0.0)
+        except Exception as e:
+            err = type(e).__name__
+        dt = time.perf_counter() - t0
+        report.queries += 1
+        if err is None:
+            report.latencies_s.append(dt)
+            report.max_freshness_lag_ms = max(
+                report.max_freshness_lag_ms, fresh_ms
+            )
+            report.cache_counts[status] = (
+                report.cache_counts.get(status, 0) + 1
+            )
+            if partial:
+                report.partials += 1
+        else:
+            report.errors += 1
+            report.errors_by_type[err] = (
+                report.errors_by_type.get(err, 0) + 1
+            )
+    report.wall_s = time.perf_counter() - t_start
+    _attach_hist_delta(report, hist_before, _hist_snapshot())
+    return report
+
+
+def run_repeat_ab(
+    execute,
+    query: str,
+    qps: float = 10.0,
+    count: int = 50,
+    timeout_s: float = 30.0,
+    cache_mb: int = 64,
+    status_fn=None,
+    **tenancy_kw,
+) -> dict:
+    """Cache-off vs cache-on A/B of the same repeated script against
+    one IN-PROCESS engine/broker (the flag overrides only reach this
+    process — a remote broker keeps its own configuration; use a plain
+    ``run_repeat_load`` there and read the hit rate). Returns
+    ``{"cache_off": LoadReport, "cache_on": LoadReport}`` — each phase
+    carries its own serving-histogram delta, so cache_on's p50/p99
+    against cache_off's IS the repeat-serving speedup, measured where
+    the queries were served."""
+    from ..config import override_flag
+
+    with override_flag("result_cache_mb", 0), \
+            override_flag("view_auto_min_runs", 0):
+        off = run_repeat_load(
+            execute, query, qps=qps, count=count, timeout_s=timeout_s,
+            status_fn=status_fn, **tenancy_kw,
+        )
+    with override_flag("result_cache_mb", int(cache_mb)):
+        on = run_repeat_load(
+            execute, query, qps=qps, count=count, timeout_s=timeout_s,
+            status_fn=status_fn, **tenancy_kw,
+        )
+    return {"cache_off": off, "cache_on": on}
+
+
 def broker_executor(broker):
     """Adapter for an in-process QueryBroker."""
 
@@ -374,6 +498,17 @@ def main(argv=None) -> int:
                                      "over the local synthetic table)")
     ap.add_argument("--concurrency", default="1,2,4",
                     help="comma-separated client-thread counts")
+    ap.add_argument("--repeat-script", action="store_true",
+                    help="repeat mode: fire --script (or the local "
+                         "default) at a fixed --qps from one client and "
+                         "report the cache hit rate plus a cache-on/off "
+                         "p50/p99 A/B (in-process modes only)")
+    ap.add_argument("--qps", type=float, default=10.0,
+                    help="repeat-mode offered rate")
+    ap.add_argument("--count", type=int, default=50,
+                    help="repeat-mode queries per phase")
+    ap.add_argument("--cache-mb", type=int, default=64,
+                    help="repeat-mode cache budget for the ON phase")
     ap.add_argument("--per-worker", type=int, default=10)
     ap.add_argument("--timeout-s", type=float, default=30.0)
     ap.add_argument("--rows", type=int, default=200_000,
@@ -413,6 +548,38 @@ def main(argv=None) -> int:
         host, _, port = args.broker.rpartition(":")
         execute = remote_executor(host or "127.0.0.1", int(port))
     try:
+        if args.repeat_script:
+            status_fn = None
+            if args.local:
+                # The engine returns bare result tables; the trace
+                # carries the disposition of the query just served.
+                eng = execute.engine  # type: ignore[attr-defined]
+                status_fn = lambda res: (  # noqa: E731
+                    getattr(eng.tracer.last(), "cache", "")
+                )
+            if args.broker:
+                # Flag overrides don't cross the bus: measure the
+                # remote broker AS CONFIGURED, hit rate included.
+                rep = run_repeat_load(
+                    execute, query, qps=args.qps, count=args.count,
+                    timeout_s=args.timeout_s, tenant=args.tenant,
+                    priority=args.priority or None,
+                    deadline_ms=args.deadline_ms,
+                )
+                print(json.dumps({"configured": rep.to_dict()}, indent=2))
+                return 0 if rep.errors == 0 else 1
+            ab = run_repeat_ab(
+                execute, query, qps=args.qps, count=args.count,
+                timeout_s=args.timeout_s, cache_mb=args.cache_mb,
+                status_fn=status_fn,
+            )
+            out = {k: r.to_dict() for k, r in ab.items()}
+            off_p50 = ab["cache_off"].percentile(50)
+            on_p50 = ab["cache_on"].percentile(50)
+            if on_p50 and off_p50 == off_p50 and on_p50 == on_p50:
+                out["p50_speedup"] = round(off_p50 / on_p50, 2)
+            print(json.dumps(out, indent=2))
+            return 0 if all(r.errors == 0 for r in ab.values()) else 1
         reports = run_concurrency_sweep(
             execute, query, concurrencies=concurrencies,
             per_worker=args.per_worker, timeout_s=args.timeout_s,

@@ -355,7 +355,8 @@ class ObservabilityServer:
 
     def __init__(self, registry: MetricsRegistry | None = None,
                  statusz_fn=None, health_fn=None, tracer=None,
-                 trace_view=None, programs=None, tablez_fn=None):
+                 trace_view=None, programs=None, tablez_fn=None,
+                 cachez_fn=None):
         self.registry = registry or default_registry
         self.statusz_fn = statusz_fn  # () -> dict
         self.health_fn = health_fn  # () -> (bool, str)
@@ -372,6 +373,11 @@ class ObservabilityServer:
         # TableStore.freshness(); a broker serves the tracker's
         # cluster merge — watermark max, counters summed, lag spread).
         self.tablez_fn = tablez_fn
+        # () -> dict | None: wire one to serve /debug/cachez — the
+        # watermark-validated result-cache snapshot (entries with their
+        # per-table stored watermarks, byte budget, hit counts) plus any
+        # registered materialized views (exec/views.py).
+        self.cachez_fn = cachez_fn
         self._httpd = None
 
     def handle(self, path: str) -> tuple[int, str, str]:
@@ -412,6 +418,11 @@ class ObservabilityServer:
             if self.tablez_fn is None:
                 return (404, "text/plain", "no table stats wired\n")
             body = json.dumps(self.tablez_fn(), indent=1, default=str)
+            return (200, "application/json", body)
+        if path == "/debug/cachez":
+            if self.cachez_fn is None:
+                return (404, "text/plain", "no result cache wired\n")
+            body = json.dumps(self.cachez_fn(), indent=1, default=str)
             return (200, "application/json", body)
         if path == "/debug/programz":
             if self.programs is None:

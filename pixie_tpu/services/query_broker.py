@@ -825,6 +825,12 @@ class QueryBroker:
         # floor sketch predictions at observed reality
         # (admission_observed_floor).
         self.observed_costs = ObservedCostIndex(tracer=self.tracer)
+        # Watermark-validated merged-result cache (exec/result_cache.py;
+        # result_cache_mb flag, 0 = off): repeats of an unchanged-
+        # watermark script are served BEFORE admission/compile/dispatch.
+        from ..exec.result_cache import ResultCache
+
+        self.result_cache = ResultCache()
         # Dynamic-tracing support (the MutationExecutor dependency,
         # mutation_executor.go:84); wire a TracepointRegistry to enable.
         self.tracepoints = None
@@ -857,12 +863,21 @@ class QueryBroker:
         # resync case only follows an expiry, which already aborted
         # merge-dead streams and degraded data-dead ones visibly.
         self._register_sub = self.bus.subscribe(
-            TOPIC_REGISTER,
-            lambda msg: self._abort_streams_of(
-                msg.get("agent_id"), "restarted (re-registered)",
-                include_data_agents=True,
-            ),
+            TOPIC_REGISTER, self._on_agent_registered
         )
+
+    def _on_agent_registered(self, msg: dict) -> None:
+        self._abort_streams_of(
+            msg.get("agent_id"), "restarted (re-registered)",
+            include_data_agents=True,
+        )
+        # Agent-set change: a merged cached result no longer covers the
+        # same shards (and the cluster watermark alone can't always see
+        # that), so a repeat must re-execute — and degrade through the
+        # partial-results machinery exactly like a live query.
+        # ResultCache serializes internally (its own Lock), so the
+        # cross-dispatcher clear() is safe without a broker-side lock.
+        self.result_cache.clear()  # pxlint: disable=thread-shared-state
 
     def _abort_streams_of(self, agent_id, why: str,
                           include_data_agents: bool = False) -> None:
@@ -898,6 +913,10 @@ class QueryBroker:
         aid = msg.get("agent_id")
         self._abort_streams_of(aid, "expired")
         self._degrade_streams_of(aid, msg.get("reason", "expired"))
+        # A lost agent's shard is gone from the merged view: cached
+        # results that covered it must not serve as-if-complete.
+        # ResultCache serializes internally (see _on_agent_registered).
+        self.result_cache.clear()  # pxlint: disable=thread-shared-state
 
     def _degrade_streams_of(self, agent_id, why: str) -> None:
         with self._degrade_lock:
@@ -1222,6 +1241,39 @@ class QueryBroker:
         deadline_mono: float | None,
         deadline_unix: float | None,
     ) -> dict:
+        from ..exec import result_cache as rc
+
+        # Result cache (exec/result_cache.py): the lookup sits BEFORE
+        # admission, compile and dispatch — a hit pays none of them
+        # (the entry carries its scanned-table set, so validity is one
+        # watermark read per table, no compile). Mutation scripts
+        # bypass: their execution has side effects a cache must not
+        # swallow.
+        cache_status = ""
+        if self.result_cache.enabled():
+            if "pxtrace" in query:
+                cache_status = rc.BYPASS
+            else:
+                cluster_stats = self.tracker.table_stats()
+
+                def _cluster_wm(t, _stats=cluster_stats):
+                    fresh = _stats.get(t, {}).get("freshness") or {}
+                    wm = fresh.get("watermark")
+                    return None if wm is None or int(wm) < 0 else int(wm)
+
+                status, entry, lag_ms = self.result_cache.lookup(
+                    query, now_ns, max_output_rows, _cluster_wm
+                )
+                if status == rc.HIT:
+                    trace.cache = rc.HIT
+                    trace.qid = entry.result.get("qid") or ""
+                    trace.usage.freshness_lag_ms = lag_ms
+                    result = dict(entry.result)
+                    result["cache"] = rc.HIT
+                    result["freshness_lag_ms"] = lag_ms
+                    return result
+                cache_status = status
+        trace.cache = cache_status
         compiler_state = CompilerState(
             schemas=self.tracker.schemas(),
             registry=self.registry,
@@ -1432,6 +1484,34 @@ class QueryBroker:
         result["freshness_lag_ms"] = round(
             trace.usage.freshness_lag_ms, 3
         )
+        # Prime the result cache. Never a partial/interrupted result (a
+        # degraded answer must not masquerade as a complete one on the
+        # next repeat) and never a mutation script. The watermark
+        # snapshot is the PRE-dispatch compiler_state one —
+        # conservative: ingest that landed mid-execution makes the
+        # stored watermark older than reality, so the next lookup sees
+        # the advance and re-validates instead of over-trusting.
+        if (
+            self.result_cache.enabled()
+            and cache_status != rc.BYPASS
+            and not result.get("partial")
+            and not result.get("interrupted")
+        ):
+            def _snap_wm(t, _stats=compiler_state.table_stats):
+                fresh = (_stats or {}).get(t, {}).get("freshness") or {}
+                wm = fresh.get("watermark")
+                return None if wm is None or int(wm) < 0 else int(wm)
+
+            cached = {
+                k: v for k, v in result.items() if k != "distributed_plan"
+            }
+            cache_status = self.result_cache.store(
+                query, compiler_state.now_ns, max_output_rows,
+                compiled.plan, cached, _snap_wm,
+            )
+            trace.cache = cache_status
+        if cache_status:
+            result["cache"] = cache_status
         if mutation_states is not None:
             result["mutations"] = mutation_states
         return result
@@ -1672,6 +1752,7 @@ class QueryBroker:
                     "predicted_cost": res.get("predicted_cost"),
                     "tenant": res.get("tenant"),
                     "freshness_lag_ms": res.get("freshness_lag_ms"),
+                    "cache": res.get("cache", ""),
                 })
             except Exception as e:  # errors cross the wire as data
                 _reply(msg, {"ok": False, "error": f"{type(e).__name__}: {e}"})

@@ -275,6 +275,7 @@ class TelemetryCollector:
             "predicted_bytes": [int(pred_bytes or 0)],
             "predicted_rows": [int(pred_rows or 0)],
             "freshness_lag_ms": [float(u.freshness_lag_ms)],
+            "cache": [getattr(trace, "cache", "")],
         })
         self.engine.append_data("__spans__", _span_rows(trace, agent, end_ns))
         self._fold_programs(end_ns)

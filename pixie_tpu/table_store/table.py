@@ -815,3 +815,20 @@ class Table:
             "last_append": st.last_append_unix_ns,
             "ingest_rows_per_s": round(st.ingest_rows_per_s, 3),
         }
+
+
+def max_watermark_ns(tablets):
+    """Max event-time watermark across ``tablets`` (None = no time
+    index / nothing appended anywhere). THE freshness sweep: the
+    engine's per-scan staleness stamp, the streaming cursor's per-poll
+    note and the result cache's validity reads all go through this one
+    helper — one sweep per poll/scan round, never one per consumer
+    (the same dedup PR 14 applied to the heartbeat path). Callers
+    resolve it through the module (``table.max_watermark_ns``) so the
+    regression test can count sweeps."""
+    wm = -1
+    for t in tablets:
+        w = getattr(t, "watermark_ns", None)
+        if w is not None and w > wm:
+            wm = int(w)
+    return None if wm < 0 else wm

@@ -90,6 +90,12 @@
 #                                      on the first acquisition that
 #                                      would close a cycle. Runs inside
 #                                      --analyze (and so --tier1).
+#   ./run_tests.sh --cache             repeat-serving gate: the result
+#                                      cache / materialized view /
+#                                      push-down partial-agg suite
+#                                      (tests/test_result_cache.py; see
+#                                      docs/CACHING.md). The file also
+#                                      runs inside the --tier1 sweep.
 #   ./run_tests.sh --bench-join        quick join gate: a small
 #                                      selectivity/skew sweep (uniform
 #                                      vs zipf keys, low/high match
@@ -134,6 +140,11 @@ case "$1" in
       tests/test_concurrency.py tests/test_fault_injection.py \
       tests/test_tenancy.py tests/test_telemetry.py "$@" || rc=$?
     exit $rc
+    ;;
+  --cache)
+    shift
+    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest -q tests/test_result_cache.py "$@"
     ;;
   --bench-join)
     shift

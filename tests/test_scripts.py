@@ -398,6 +398,8 @@ def _seed_all_tables(eng, n=3000, seed=11):
             for i, r in enumerate(rng.integers(1, 10**6, m))
         ],
         "freshness_lag_ms": rng.uniform(0, 2000, m),
+        "cache": [("", "hit", "miss", "stale", "bypass", "view")[i % 6]
+                  for i in range(m)],
     })
     # Storage-tier snapshots (TableStatsCollector fold shape): a few
     # rows per (agent, table) with monotonic counters and advancing
