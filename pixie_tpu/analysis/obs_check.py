@@ -28,6 +28,10 @@ OBS_SCRIPTS = (
     # Result cache: hit/miss/stale/bypass/view rollup per script hash
     # over the __queries__ cache column (exec/result_cache.py).
     "px/cache_stats",
+    # Profiling tier: attributed CPU from the __stacks__ ring — per
+    # script/tenant burn, per-tenant phase split, and the diff-ready
+    # folded-stack feed (ingest/profiler.py + exec/threadmap.py).
+    "px/query_cpu", "px/tenant_cpu", "px/flame_diff",
 )
 
 

@@ -104,6 +104,25 @@ class Client:
         return {"in_flight": res.get("in_flight", []),
                 "queries": res.get("queries", [])}
 
+    def profile(
+        self,
+        agent: str | None = None,
+        tenant: str | None = None,
+        script: str | None = None,
+        limit: int = 64,
+    ) -> dict:
+        """Cluster-merged folded-stack CPU profile from the broker
+        (agents' heartbeat summaries + the broker's own sampler) —
+        the `px profile` surface. Returns {"agents": [...], "stacks":
+        [{stack, count, qid, script_hash, tenant, phase}, ...]} with
+        optional agent / tenant / script-hash filters."""
+        res = self._request("broker.profile", {
+            "agent": agent or "", "tenant": tenant or "",
+            "script": script or "", "limit": limit,
+        })
+        return {"agents": res.get("agents", []),
+                "stacks": res.get("stacks", [])}
+
     def cancel_query(self, qid: str) -> bool:
         """Cooperatively cancel a running one-shot query (`px cancel`):
         the broker stops its agents at their next window boundary and

@@ -361,6 +361,70 @@ def cmd_debug(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """`px profile`: cluster-merged CPU flames from the broker —
+    agents' heartbeat folded-stack summaries plus the broker's own
+    sampler, attributed with qid/script hash/tenant/phase. ``--diff A
+    B`` renders the per-frame differential profile between two script
+    hashes (services/telemetry.py profile_diff)."""
+    from .services.telemetry import profile_counts, profile_diff
+
+    with _client(args.broker) as client:
+        if args.diff:
+            base_hash, cmp_hash = args.diff
+            base = client.profile(
+                agent=args.agent, tenant=args.tenant,
+                script=base_hash, limit=4096,
+            )["stacks"]
+            cmp_ = client.profile(
+                agent=args.agent, tenant=args.tenant,
+                script=cmp_hash, limit=4096,
+            )["stacks"]
+            rows = profile_diff(
+                profile_counts(base), profile_counts(cmp_)
+            )[:args.limit]
+            if args.output == "json":
+                print(json.dumps(rows))
+                return 0
+            print(f"{'frame':48s} {'self Δ':>8s} {'self a':>7s} "
+                  f"{'self b':>7s} {'total Δ':>8s}")
+            for r in rows:
+                print(
+                    f"{r['frame'][:48]:48s} {r['self_delta']:>+8d} "
+                    f"{r['self_base']:>7d} {r['self_cmp']:>7d} "
+                    f"{r['total_delta']:>+8d}"
+                )
+            return 0
+        res = client.profile(
+            agent=args.agent, tenant=args.tenant,
+            script=args.script, limit=args.limit,
+        )
+    if args.output == "json":
+        print(json.dumps(res))
+        return 0
+    stacks = res["stacks"]
+    if not stacks:
+        print("no profile samples (is self_profiling on?)")
+        return 0
+    print(f"agents: {', '.join(res['agents']) or '-'}")
+    print(f"{'samples':>8s} {'tenant':8s} {'phase':12s} "
+          f"{'script':12s} stack (leaf last)")
+    for r in stacks:
+        stack = r["stack"]
+        if args.output == "collapsed":
+            print(f"{stack} {r['count']}")
+            continue
+        frames = stack.split(";")
+        tail = ";".join(frames[-3:]) if len(frames) > 3 else stack
+        print(
+            f"{r['count']:>8d} {r.get('tenant') or '-':8s} "
+            f"{r.get('phase') or '-':12s} "
+            f"{(r.get('script_hash') or '-')[:12]:12s} "
+            f"{'...' if len(frames) > 3 else ''}{tail}"
+        )
+    return 0
+
+
 def cmd_cancel(args) -> int:
     """`px cancel <qid>`: cooperative cancellation — the broker stops
     the query's agents at their next window boundary and the original
@@ -476,6 +540,24 @@ def main(argv=None) -> int:
     db.add_argument("-o", "--output", choices=("table", "json"),
                     default="table")
     db.set_defaults(fn=cmd_debug)
+
+    pf = sub.add_parser(
+        "profile",
+        help="cluster-merged CPU flames (top folded stacks, attributed)",
+    )
+    pf.add_argument("--broker", required=True)
+    pf.add_argument("--agent", default=None,
+                    help="only this agent's stacks (default: cluster merge)")
+    pf.add_argument("--tenant", default=None,
+                    help="only samples attributed to this tenant")
+    pf.add_argument("--script", default=None, metavar="HASH",
+                    help="only samples attributed to this script hash")
+    pf.add_argument("--diff", nargs=2, metavar=("BASE", "CMP"),
+                    help="differential profile between two script hashes")
+    pf.add_argument("-n", "--limit", type=int, default=20)
+    pf.add_argument("-o", "--output",
+                    choices=("table", "json", "collapsed"), default="table")
+    pf.set_defaults(fn=cmd_profile)
 
     dc = sub.add_parser("docs", help="dump the function reference (markdown)")
     dc.set_defaults(fn=cmd_docs)

@@ -471,8 +471,19 @@ define_flag(
 define_flag(
     "self_profiling", True,
     "Deploy roles run the self-sampling perf profiler "
-    "(ingest/profiler.py): PEM/Kelvin agents fold their own Python "
-    "stacks into stack_traces.beta (px/perf_flamegraph-queryable); "
-    "the broker samples into a process-local table store surfaced via "
-    "its statusz. Off = no sampling thread work at all.",
+    "(ingest/profiler.py): agents AND the broker fold their own "
+    "Python stacks — attributed with {qid, script_hash, tenant, "
+    "phase} from the thread attribution registry — into the "
+    "__stacks__ telemetry ring (px/query_cpu / px/tenant_cpu) plus "
+    "the anonymous stack_traces.beta aggregate "
+    "(px/perf_flamegraph), and serve merged flames via "
+    "/debug/pprof + /debug/flamez. Off = no sampling thread work "
+    "at all.",
+)
+define_flag(
+    "profile_summary_stacks", 512,
+    "Per-profiler cap on distinct (stack, attribution) keys kept in "
+    "the cumulative folded-stack summary that heartbeats ship for "
+    "cluster merge; over the cap the coldest stacks age out "
+    "(hottest-kept eviction, counts stay monotonic for survivors).",
 )

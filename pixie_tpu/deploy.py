@@ -51,9 +51,11 @@ def run_broker() -> int:
     netbus_port = int(os.environ.get("PIXIE_TPU_NETBUS_PORT", "6100"))
     server = BusServer(bus, host="0.0.0.0", port=netbus_port)
     # Broker self-profiling (self_profiling flag): the broker has no
-    # agent engine, so its stacks land in a process-local TableStore
-    # (not cluster-queryable — the PEM/Kelvin profilers cover the
-    # query path) surfaced through statusz below.
+    # agent engine, so its attributed stacks land in a process-local
+    # TableStore (__stacks__ + stack_traces.beta) — but they DO merge
+    # into /debug/pprof and /debug/flamez below: broker.profile_rows
+    # folds the broker profiler's cumulative summary (agent_id
+    # "broker") into the tracker's cluster merge.
     prof_store, prof_coll = _self_profiler("broker")
     statusz_extra = (
         (lambda: {"profiler": {
@@ -85,6 +87,10 @@ def run_broker() -> int:
         # Result cache: merged distributed results keyed by script +
         # cluster watermarks (exec/result_cache.py).
         cachez_fn=broker.result_cache.cachez,
+        # Profiling tier: cluster-merged CPU flames (agents' heartbeat
+        # summaries + the broker's own sampler) back /debug/pprof and
+        # /debug/flamez.
+        profilez_fn=broker.profile_rows,
     )
     obs_port = obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "6101")))
     print(
@@ -218,16 +224,39 @@ def _agent_obs(agent, extra=None) -> int:
             **agent.engine.result_cache.cachez(),
             "views": agent.engine.views.viewz(),
         },
+        # Local profiler summary (this agent only): the broker serves
+        # the cluster merge; an agent's /debug/pprof is its own flames.
+        profilez_fn=_local_profilez(agent.agent_id),
     )
     return obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "0")))
+
+
+def _local_profilez(own_agent_id: str):
+    """profilez_fn over this process's profiler roster, filtered to one
+    agent's samples (plus the handler's tenant/script filters)."""
+    def fn(agent_id=None, tenant=None, script_hash=None):
+        from .ingest.profiler import profile_summary
+
+        rows = profile_summary(agent_id=agent_id or own_agent_id, top=0)
+        return [
+            r for r in rows
+            if (tenant is None or r.get("tenant", "") == tenant)
+            and (script_hash is None
+                 or r.get("script_hash", "") == script_hash)
+        ]
+    return fn
 
 
 def _self_profiler(role: str):
     """Broker-role self-profiling (``self_profiling`` flag): a
     Collector + PerfProfilerConnector sampling this process into a
-    local TableStore. Returns (store, collector) or (None, None) when
-    the flag is off. Agent roles don't use this — their profiler rides
-    the agent's own collector into the queryable table store."""
+    local TableStore (attributed ``__stacks__`` rows + the anonymous
+    ``stack_traces.beta`` aggregate). Returns (store, collector) or
+    (None, None) when the flag is off. The connector registers itself
+    in the profiler's active roster, so its cumulative summary merges
+    into /debug/pprof via broker.profile_rows. Agent roles don't use
+    this — their profiler rides the agent's own collector into the
+    queryable table store."""
     from .config import get_flag
 
     if not get_flag("self_profiling"):

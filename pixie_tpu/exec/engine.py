@@ -43,6 +43,7 @@ from .bridge import (  # noqa: F401  (re-exported)
     bridge_payload,
     merge_agg_bridge,
 )
+from . import threadmap
 from .fragment import compile_fragment_cached as compile_fragment
 from .pipeline import WindowPipeline
 from .trace import Tracer, plan_script
@@ -641,9 +642,14 @@ class Engine:
             self._inflight += 1
             if self._inflight > self.max_inflight:
                 self.max_inflight = self._inflight
+        # Profiler attribution: CPU samples taken on this thread while
+        # the plan runs carry the query's qid/tenant/script hash
+        # (exec/threadmap.py; phase refined by pipeline/program hooks).
+        tm_token = threadmap.bind(trace=trace, phase="host")
         try:
             return self._execute_plan_inner(plan, bridge_inputs, materialize)
         finally:
+            threadmap.unbind(tm_token)
             self._tls.scratch = prev
             if analyze:
                 self.last_stats = trace.stats

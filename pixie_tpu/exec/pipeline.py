@@ -44,6 +44,8 @@ import queue
 import threading
 import time
 
+from . import threadmap
+
 #: Poll period for interruptible blocking waits (slot acquire / queue
 #: get). Bounds how long cancellation/teardown can lag, not throughput —
 #: steady-state hand-offs never hit the timeout.
@@ -90,6 +92,14 @@ PXLINT_HOT_REGIONS = (
     "exec/programs.py:TrackedProgram*",
     "exec/programs.py:ProgramRegistry*",
     "exec/programs.py:DeviceMemoryMonitor*",
+    # Profiling tier: the 100Hz sampler and the thread attribution
+    # registry it reads. A host sync (or any blocking call) inside the
+    # sample/fold path stalls EVERY thread's profile and turns the
+    # profiler into a periodic global pause; the attribution reads are
+    # GIL-atomic dict gets by design — keep them that way.
+    "ingest/profiler.py:PerfProfilerConnector*",
+    "ingest/profiler.py:_fold_stack",
+    "exec/threadmap.py:*",
 )
 
 
@@ -148,6 +158,11 @@ class WindowPipeline:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._iterated = False
+        # Profiler attribution: the prefetch thread does the creating
+        # query's staging work, so it inherits the creator's entry
+        # (rebound with phase "stage" in _produce) — otherwise its CPU
+        # samples would show up unattributed.
+        self._owner_entry = threadmap.current_entry()
 
     # -- consumer side -------------------------------------------------------
     def __iter__(self):
@@ -172,7 +187,14 @@ class WindowPipeline:
             while True:
                 self._check_cancel()
                 t0 = time.perf_counter()
-                kind, val = self._get()
+                # Samples landing while we block on the producer are
+                # wait-for-staging, not compute: flag them "stall" so
+                # the flame separates starvation from real host work.
+                tm = threadmap.set_phase("stall")
+                try:
+                    kind, val = self._get()
+                finally:
+                    threadmap.restore(tm)
                 dt = time.perf_counter() - t0
                 self.stall_secs += dt
                 if self._stats is not None:
@@ -245,6 +267,10 @@ class WindowPipeline:
 
     # -- producer side -------------------------------------------------------
     def _produce(self):
+        tm = (
+            threadmap.bind(base=self._owner_entry, phase="stage")
+            if self._owner_entry is not None else None
+        )
         try:
             while True:
                 if not self._acquire_slot():
@@ -260,6 +286,8 @@ class WindowPipeline:
                     return
         except BaseException as e:  # noqa: BLE001 — relayed, not swallowed
             self._put(("error", e))
+        finally:
+            threadmap.unbind(tm)
 
     def _acquire_slot(self) -> bool:
         while not self._stop.is_set():

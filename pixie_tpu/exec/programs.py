@@ -52,6 +52,7 @@ import threading
 import time
 
 from ..config import get_flag
+from . import threadmap
 
 #: ``pixie_compile_seconds`` buckets: a CPU fragment compiles in
 #: ~10-100ms, a big t-digest program in minutes over the TPU tunnel.
@@ -234,24 +235,33 @@ class TrackedProgram:
 
     def __call__(self, *args):
         reg = self._registry
+        # Profiler phase bracket: samples landing while the program
+        # dispatches/runs are device work (or the wait for it), not
+        # host execution — set_phase is a no-op on unattributed
+        # threads, so the per-window cost is one dict get.
+        tm = threadmap.set_phase("device_dispatch")
         try:
-            sig = shape_signature(args)
-            hash(sig)
-        except Exception:
-            return self.fn(*args)  # unhashable input: untracked call
-        rec = reg._lookup(self._key, sig, id(self.fn))
-        if rec is not None:
-            if rec.compiled is not None:
-                try:
-                    return rec.compiled(*args)
-                except Exception:
-                    # Executable/input mismatch the signature missed
-                    # (e.g. an exotic sharding): drop the executable for
-                    # this record and re-raise nothing — the jit path
-                    # below recomputes identically (programs are pure).
-                    reg._degrade(rec)
-            return self.fn(*args)  # timing-only record: plain jit path
-        return reg._compile_and_run(self, sig, args)
+            try:
+                sig = shape_signature(args)
+                hash(sig)
+            except Exception:
+                return self.fn(*args)  # unhashable input: untracked call
+            rec = reg._lookup(self._key, sig, id(self.fn))
+            if rec is not None:
+                if rec.compiled is not None:
+                    try:
+                        return rec.compiled(*args)
+                    except Exception:
+                        # Executable/input mismatch the signature missed
+                        # (e.g. an exotic sharding): drop the executable
+                        # for this record and re-raise nothing — the jit
+                        # path below recomputes identically (programs
+                        # are pure).
+                        reg._degrade(rec)
+                return self.fn(*args)  # timing-only record: plain jit path
+            return reg._compile_and_run(self, sig, args)
+        finally:
+            threadmap.restore(tm)
 
 
 class ProgramRegistry:

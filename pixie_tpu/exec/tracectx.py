@@ -69,11 +69,20 @@ def current() -> dict | None:
 def bound(ctx):
     """Bind ``ctx`` as the ambient context for the dynamic extent of the
     block (token-reset on exit, so dispatcher threads never leak a stale
-    context into the next message). ``None``/invalid binds nothing."""
-    token = _current.set(ctx if valid(ctx) else None)
+    context into the next message). ``None``/invalid binds nothing.
+
+    Also registers the context in the profiler's thread attribution map
+    (``exec/threadmap.py``) so CPU samples taken inside a bus handler
+    carry at least the distributed trace id."""
+    from . import threadmap
+
+    ctx = ctx if valid(ctx) else None
+    token = _current.set(ctx)
+    tm_token = threadmap.bind(ctx=ctx) if ctx is not None else None
     try:
         yield
     finally:
+        threadmap.unbind(tm_token)
         _current.reset(token)
 
 

@@ -363,6 +363,29 @@ TABLES_RELATION = Relation(
     ]
 )
 
+# Profiling tier (ingest/profiler.py): one row per (folded stack,
+# attribution) key drained each push period. ``stack_trace`` is the
+# flamegraph-folded ``outermost;...;innermost`` string; ``count`` is
+# samples at the profiler's period (100Hz default — CPU-seconds =
+# count * period). qid/script_hash/tenant come from the thread
+# attribution registry (exec/threadmap.py) at sample time ("" =
+# unattributed — idle daemons, bus plumbing); ``phase`` splits
+# host vs device_dispatch vs stall vs stage so flame roots show
+# where the wall time actually went.
+STACKS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("agent_id", DataType.STRING),
+        ("stack_trace_id", DataType.INT64),
+        ("stack_trace", DataType.STRING),
+        ("count", DataType.INT64),
+        ("qid", DataType.STRING),
+        ("script_hash", DataType.STRING),
+        ("tenant", DataType.STRING),
+        ("phase", DataType.STRING),
+    ]
+)
+
 # One row per finished trace: the folding agent's running totals (the
 # latest row per agent_id is its current health snapshot).
 AGENTS_RELATION = Relation(
@@ -385,6 +408,7 @@ TELEMETRY_SCHEMAS: dict[str, "Relation"] = {
     "__agents__": AGENTS_RELATION,
     "__programs__": PROGRAMS_RELATION,
     "__tables__": TABLES_RELATION,
+    "__stacks__": STACKS_RELATION,
 }
 
 # dns_table.h kDNSTable (subset).

@@ -189,14 +189,25 @@ class Agent:
                     tel.table_stats.fold(force=True, snapshot=fresh)
                 except Exception:
                     pass  # telemetry must never kill the heartbeat loop
-            self.bus.publish(
-                TOPIC_HEARTBEAT,
-                {
-                    "agent_id": self.agent_id,
-                    "schemas": self._schemas(),
-                    "table_stats": self._table_stats(freshness=fresh),
-                },
-            )
+            hb = {
+                "agent_id": self.agent_id,
+                "schemas": self._schemas(),
+                "table_stats": self._table_stats(freshness=fresh),
+            }
+            # Profiling tier: ship this agent's cumulative folded-stack
+            # summary (top-N, counts monotonic) for the tracker's
+            # cluster merge — /debug/pprof and `px profile` read the
+            # merged view. Filtered by agent_id so co-resident agents
+            # in one process don't double-ship each other's samples.
+            try:
+                from ..ingest.profiler import profile_summary
+
+                prof = profile_summary(agent_id=self.agent_id)
+                if prof:
+                    hb["profile"] = prof
+            except Exception:
+                pass  # profiling must never kill the heartbeat loop
+            self.bus.publish(TOPIC_HEARTBEAT, hb)
 
     def _schemas(self) -> dict:
         # Snapshot: heartbeat thread vs concurrent table creation

@@ -57,6 +57,13 @@ class LoadReport:
     # ({"hit": 37, "miss": 1, ...} from the broker reply's ``cache``
     # key / the engine trace). Empty outside --repeat-script runs.
     cache_counts: dict = field(default_factory=dict)
+    # Per-tenant CPU-seconds burned during the run, from the serving
+    # process's pixie_cpu_samples_total{tenant} counter deltas scaled
+    # by the profiler's sampling period (ingest/profiler.py) — the
+    # tenancy gate's "the noisy tenant's burn is VISIBLE" assertion
+    # next to qps/p99. Empty when self-profiling is off or the
+    # profiler runs in another process (remote broker).
+    cpu_seconds_by_tenant: dict = field(default_factory=dict)
 
     @property
     def failure_rate(self) -> float:
@@ -107,6 +114,8 @@ class LoadReport:
         if self.cache_counts:
             out["cache_counts"] = dict(self.cache_counts)
             out["cache_hit_rate"] = round(self.cache_hit_rate, 4)
+        if self.cpu_seconds_by_tenant:
+            out["cpu_seconds_by_tenant"] = dict(self.cpu_seconds_by_tenant)
         return out
 
 
@@ -175,6 +184,36 @@ def _hist_snapshot():
     return default_registry.histogram_state("pixie_query_duration_seconds")
 
 
+def _cpu_samples_snapshot(tenants) -> dict:
+    """{tenant: cumulative pixie_cpu_samples_total value} for the run's
+    tenants (resolved through the registered set, like every label)."""
+    from .observability import default_counter
+    from .tenancy import resolve_tenant
+
+    counter = default_counter(
+        "pixie_cpu_samples_total",
+        "Profiler stack samples attributed to each tenant "
+        "(samples * sampling period = CPU-seconds)",
+    )
+    out: dict = {}
+    for raw in tenants:
+        tenant = resolve_tenant(raw, count_unknown=False)
+        out[tenant] = counter.labels(tenant=tenant).value()
+    return out
+
+
+def _attach_cpu_delta(report: LoadReport, before: dict, after: dict) -> None:
+    """Per-tenant CPU-seconds for the run: counter delta scaled by the
+    profiler's sampling period (count * period = CPU-seconds)."""
+    from ..ingest.profiler import PerfProfilerConnector
+
+    period = PerfProfilerConnector.default_sampling_period_s
+    for tenant, v in after.items():
+        d = v - before.get(tenant, 0.0)
+        if d > 0:
+            report.cpu_seconds_by_tenant[tenant] = round(d * period, 3)
+
+
 def _attach_hist_delta(report: LoadReport, before, after) -> None:
     from .observability import delta_quantiles
 
@@ -219,8 +258,11 @@ def run_load(
 
     # Snapshot the server-side latency histogram around the run so the
     # report carries per-run quantiles from the SERVING process's own
-    # measurement (delta interpolation over cumulative buckets).
+    # measurement (delta interpolation over cumulative buckets). Same
+    # bracket for the profiler's per-tenant CPU counter: the delta is
+    # this run's attributed burn.
     hist_before = _hist_snapshot()
+    cpu_before = _cpu_samples_snapshot([tenant] if tenant else [])
     t_start = time.perf_counter()
     threads = [
         threading.Thread(target=_worker_loop, args=(
@@ -234,6 +276,10 @@ def run_load(
         t.join()
     report.wall_s = time.perf_counter() - t_start
     _attach_hist_delta(report, hist_before, _hist_snapshot())
+    _attach_cpu_delta(
+        report, cpu_before,
+        _cpu_samples_snapshot([tenant] if tenant else []),
+    )
     return report
 
 
@@ -267,14 +313,29 @@ def run_mixed_load(execute, streams) -> dict:
             ))
             for _ in range(s.workers)
         )
+    tenants = sorted({s.tenant for s in streams if s.tenant})
+    cpu_before = _cpu_samples_snapshot(tenants)
     t_start = time.perf_counter()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
-    for r in reports.values():
-        r.wall_s = wall
+    cpu_after = _cpu_samples_snapshot(tenants)
+    from .tenancy import resolve_tenant
+
+    for key, s in zip(keys, streams):
+        reports[key].wall_s = wall
+        # Per-TENANT burn, not per-stream: two streams sharing a tenant
+        # ("dash", "dash#1") each report the tenant's total — the CPU
+        # counter only carries the tenant label, and splitting it would
+        # fake a precision the sampler doesn't have.
+        own = resolve_tenant(s.tenant, count_unknown=False)
+        _attach_cpu_delta(
+            reports[key],
+            {own: cpu_before.get(own, 0.0)},
+            {own: cpu_after.get(own, 0.0)},
+        )
     return reports
 
 

@@ -21,6 +21,7 @@ import bisect
 import http.server
 import json
 import threading
+import time
 from dataclasses import dataclass, field
 
 #: Prometheus client default latency buckets (seconds).
@@ -356,7 +357,7 @@ class ObservabilityServer:
     def __init__(self, registry: MetricsRegistry | None = None,
                  statusz_fn=None, health_fn=None, tracer=None,
                  trace_view=None, programs=None, tablez_fn=None,
-                 cachez_fn=None):
+                 cachez_fn=None, profilez_fn=None):
         self.registry = registry or default_registry
         self.statusz_fn = statusz_fn  # () -> dict
         self.health_fn = health_fn  # () -> (bool, str)
@@ -378,10 +379,22 @@ class ObservabilityServer:
         # per-table stored watermarks, byte budget, hit counts) plus any
         # registered materialized views (exec/views.py).
         self.cachez_fn = cachez_fn
+        # (agent_id=None, tenant=None, script_hash=None) -> profile
+        # summary rows ({stack, count, qid, script_hash, tenant,
+        # phase}): wire one to serve /debug/pprof (collapsed format)
+        # and /debug/flamez (static HTML flamegraph). An agent serves
+        # its local profiler summary; a broker serves the tracker's
+        # cluster merge plus its own samples.
+        self.profilez_fn = profilez_fn
         self._httpd = None
 
     def handle(self, path: str) -> tuple[int, str, str]:
-        """(status, content_type, body) — transport-independent core."""
+        """(status, content_type, body) — transport-independent core.
+        ``path`` may carry a query string (``/debug/pprof?seconds=5``);
+        endpoints that take no parameters ignore it."""
+        path, _, query = path.partition("?")
+        if path in ("/debug/pprof", "/debug/flamez"):
+            return self._handle_profile(path, query)
         if path == "/healthz":
             ok, msg = (True, "ok") if self.health_fn is None else self.health_fn()
             return (200 if ok else 503, "text/plain", msg + "\n")
@@ -447,13 +460,67 @@ class ObservabilityServer:
             return (200, "application/json", body)
         return (404, "text/plain", "not found\n")
 
+    def _handle_profile(self, path: str, query: str) -> tuple[int, str, str]:
+        """/debug/pprof (flamegraph collapsed text) and /debug/flamez
+        (static HTML flamegraph) over the wired profile source.
+
+        Parameters: ``agent``/``tenant``/``script`` filter the merged
+        summary; ``seconds=N`` (pprof) windows it — two cumulative
+        snapshots N seconds apart, per-stack growth between them —
+        instead of the since-start totals."""
+        if self.profilez_fn is None:
+            return (404, "text/plain", "no profiler wired\n")
+        import urllib.parse
+
+        from .telemetry import (
+            collapsed_text, counts_delta, flame_html, profile_counts,
+        )
+
+        params = urllib.parse.parse_qs(query)
+
+        def one(name):
+            vals = params.get(name)
+            return vals[0] if vals else None
+
+        agent, tenant, script = one("agent"), one("tenant"), one("script")
+        counts = profile_counts(
+            self.profilez_fn(
+                agent_id=agent, tenant=tenant, script_hash=script
+            )
+        )
+        if path == "/debug/flamez":
+            label = " ".join(
+                f"{k}={v}" for k, v in
+                (("agent", agent), ("tenant", tenant), ("script", script))
+                if v
+            )
+            title = "pixie cpu flame" + (f" [{label}]" if label else "")
+            return (200, "text/html", flame_html(counts, title=title))
+        try:
+            seconds = float(one("seconds") or 0)
+        except ValueError:
+            seconds = 0.0
+        if seconds > 0:
+            # Windowed profile: cumulative counts are monotonic, so the
+            # delta between two snapshots is exactly the window's
+            # samples. Cap the in-handler wait (this blocks one server
+            # thread, nothing else).
+            time.sleep(min(seconds, 60.0))
+            after = profile_counts(
+                self.profilez_fn(
+                    agent_id=agent, tenant=tenant, script_hash=script
+                )
+            )
+            counts = counts_delta(counts, after)
+        return (200, "text/plain", collapsed_text(counts))
+
     def start(self, port: int = 0) -> int:
         """Serve on a background thread; returns the bound port."""
         obs = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib casing)
-                code, ctype, body = obs.handle(self.path.split("?")[0])
+                code, ctype, body = obs.handle(self.path)
                 data = body.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)

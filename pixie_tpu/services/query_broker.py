@@ -16,6 +16,7 @@ import threading
 import time
 import uuid
 
+from ..exec import threadmap
 from ..exec.engine import QueryError
 from ..planner import CompilerState, compile_mutations, compile_pxl
 from ..planner.distributed import DistributedPlanner
@@ -1138,6 +1139,63 @@ class QueryBroker:
                 self._exec_backlog.clear()
         self.trace_view.close()
 
+    # -- profiling tier ------------------------------------------------------
+    def profile_rows(
+        self,
+        agent_id: str | None = None,
+        tenant: str | None = None,
+        script_hash: str | None = None,
+    ) -> list[dict]:
+        """Cluster-merged folded-stack profile: the tracker's heartbeat
+        summaries across agents PLUS this broker process's own profiler
+        (deploy.py routes the broker's sampler through the same
+        ``__stacks__`` fold, agent_id "broker"), merged per (stack,
+        attribution) key, hottest first — what /debug/pprof,
+        /debug/flamez and the ``broker.profile`` topic serve."""
+        rows = self.tracker.profile(
+            agent_id=agent_id, tenant=tenant, script_hash=script_hash
+        )
+        from ..ingest.profiler import profile_summary
+
+        local = (
+            profile_summary(agent_id="broker", top=0)
+            if agent_id in (None, "broker") else []
+        )
+        if not local:
+            return rows
+        merged: dict[tuple, int] = {}
+        for r in rows + [
+            r for r in local
+            if (tenant is None or r.get("tenant", "") == tenant)
+            and (script_hash is None
+                 or r.get("script_hash", "") == script_hash)
+        ]:
+            key = (
+                r.get("stack", ""), r.get("qid", ""),
+                r.get("script_hash", ""), r.get("tenant", ""),
+                r.get("phase", ""),
+            )
+            merged[key] = merged.get(key, 0) + int(r.get("count", 0))
+        out = [
+            {
+                "stack": k[0], "count": n, "qid": k[1],
+                "script_hash": k[2], "tenant": k[3], "phase": k[4],
+            }
+            for k, n in merged.items()
+        ]
+        out.sort(key=lambda r: (-r["count"], r["stack"]))
+        return out
+
+    def profile_agents(self) -> list[str]:
+        """Agents contributing stacks to the merged profile (the
+        broker's own sampler counts when it has samples)."""
+        from ..ingest.profiler import profile_summary
+
+        agents = self.tracker.profile_agents()
+        if profile_summary(agent_id="broker", top=1):
+            agents = sorted(set(agents) | {"broker"})
+        return agents
+
     def cancel_query(self, qid: str) -> bool:
         """Cooperatively cancel a running query (`px cancel` /
         ``broker.cancel``): live streams tear down their cursors, a
@@ -1209,6 +1267,10 @@ class QueryBroker:
             deadline_unix = time.time() + float(deadline_ms) / 1e3
         trace = self.tracer.begin_query(script=query, kind="distributed")
         trace.tenant = tenant
+        # Profiler attribution (exec/threadmap.py): broker-side CPU on
+        # this thread — compile, planning, dispatch, merge coordination
+        # — samples under the query's qid/tenant/script hash.
+        tm_token = threadmap.bind(trace=trace, phase="host")
         try:
             result = self._execute_script_inner(
                 query, timeout_s, now_ns, max_output_rows,
@@ -1221,6 +1283,8 @@ class QueryBroker:
                 error=f"{type(e).__name__}: {e}"[:300],
             )
             raise
+        finally:
+            threadmap.unbind(tm_token)
         self.tracer.end_query(
             trace,
             status="partial" if result.get("partial") else "ok",
@@ -1911,6 +1975,25 @@ class QueryBroker:
 
             _reply(msg, {"ok": True, "scripts": list_scripts()})
 
+        def _on_profile(msg):
+            # `px profile` / api.Client.profile: the cluster-merged
+            # folded-stack CPU profile (tracker heartbeat summaries +
+            # the broker's own profiler), optionally filtered.
+            try:
+                n = max(1, min(int(msg.get("limit", 64)), 4096))
+            except (TypeError, ValueError):
+                n = 64
+            rows = self.profile_rows(
+                agent_id=msg.get("agent") or None,
+                tenant=msg.get("tenant") or None,
+                script_hash=msg.get("script") or None,
+            )
+            _reply(msg, {
+                "ok": True,
+                "agents": self.profile_agents(),
+                "stacks": rows[:n],
+            })
+
         def _on_debug_queries(msg):
             # `px debug queries`: the broker's recent distributed-query
             # traces — status, duration, resource usage with per-agent
@@ -1947,4 +2030,5 @@ class QueryBroker:
             self.bus.subscribe(
                 "broker.debug_queries", _guarded(_on_debug_queries)
             ),
+            self.bus.subscribe("broker.profile", _guarded(_on_profile)),
         ]

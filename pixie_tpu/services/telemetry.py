@@ -553,3 +553,180 @@ def enable_self_telemetry(engine, agent_id: str = "engine",
     if getattr(engine, "telemetry", None) is not None:
         return engine.telemetry
     return TelemetryCollector(engine, agent_id, kind, bus=bus).install()
+
+
+# -- profiling tier: folded-stack math + export formats ----------------------
+#
+# Pure host arithmetic over {folded_stack: count} maps and the
+# profile-summary row shape agents ship in heartbeats
+# ({stack, count, qid, script_hash, tenant, phase} — see
+# ingest/profiler.py profile_summary). The broker's /debug/pprof,
+# /debug/flamez and `px profile --diff` are thin wrappers over these.
+
+def profile_counts(
+    rows,
+    tenant: str | None = None,
+    script_hash: str | None = None,
+    phase: str | None = None,
+) -> dict[str, int]:
+    """Collapse profile-summary rows to ``{folded_stack: count}``,
+    optionally filtered by attribution."""
+    out: dict[str, int] = {}
+    for r in rows or ():
+        if tenant is not None and r.get("tenant", "") != tenant:
+            continue
+        if script_hash is not None and r.get("script_hash", "") != script_hash:
+            continue
+        if phase is not None and r.get("phase", "") != phase:
+            continue
+        stack = r.get("stack", "")
+        if not stack:
+            continue
+        out[stack] = out.get(stack, 0) + int(r.get("count", 0))
+    return out
+
+
+def counts_delta(before: dict, after: dict) -> dict[str, int]:
+    """Per-stack growth between two cumulative snapshots (the
+    ``/debug/pprof?seconds=N`` windowing primitive). Counts are
+    monotonic per surviving stack; stacks evicted from a bounded
+    summary between snapshots clamp to 0 rather than going negative."""
+    return {
+        s: n - before.get(s, 0)
+        for s, n in after.items()
+        if n - before.get(s, 0) > 0
+    }
+
+
+def collapsed_text(counts: dict[str, int]) -> str:
+    """Flamegraph collapsed format: one ``stack count`` line per folded
+    stack, hottest first — feedable to flamegraph.pl / speedscope / any
+    pprof-collapsed importer."""
+    lines = [
+        f"{stack} {n}"
+        for stack, n in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    ]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def profile_diff(base: dict, cmp: dict) -> list[dict]:
+    """Differential profile between two ``{folded_stack: count}`` maps
+    (two time windows, two script hashes, before/after a change...).
+
+    Per-frame rows — ``frame`` is one ``file:func`` element — with
+    **self** counts (samples where the frame is the leaf) and **total**
+    counts (samples where it appears anywhere on the stack, counted
+    once per stack), sorted by largest absolute self delta. This is the
+    regression-hunting primitive: a frame whose self_delta jumped owns
+    the new CPU; one whose total_delta jumped but self_delta did not is
+    just calling someone who does."""
+    def per_frame(counts: dict) -> tuple[dict, dict]:
+        self_c: dict[str, int] = {}
+        total_c: dict[str, int] = {}
+        for stack, n in counts.items():
+            frames = stack.split(";")
+            leaf = frames[-1]
+            self_c[leaf] = self_c.get(leaf, 0) + n
+            for f in set(frames):
+                total_c[f] = total_c.get(f, 0) + n
+        return self_c, total_c
+
+    self_b, total_b = per_frame(base)
+    self_c, total_c = per_frame(cmp)
+    rows = []
+    for frame in set(total_b) | set(total_c):
+        sb, sc = self_b.get(frame, 0), self_c.get(frame, 0)
+        tb, tc = total_b.get(frame, 0), total_c.get(frame, 0)
+        rows.append({
+            "frame": frame,
+            "self_base": sb, "self_cmp": sc, "self_delta": sc - sb,
+            "total_base": tb, "total_cmp": tc, "total_delta": tc - tb,
+        })
+    rows.sort(
+        key=lambda r: (
+            -abs(r["self_delta"]), -abs(r["total_delta"]), r["frame"]
+        )
+    )
+    return rows
+
+
+def _flame_tree(counts: dict[str, int]) -> dict:
+    """Folded stacks -> nested {name, value, children: [...]} tree."""
+    root: dict = {"name": "all", "value": 0, "children": {}}
+    for stack, n in counts.items():
+        root["value"] += n
+        node = root
+        for frame in stack.split(";"):
+            child = node["children"].setdefault(
+                frame, {"name": frame, "value": 0, "children": {}}
+            )
+            child["value"] += n
+            node = child
+
+    def finish(node: dict) -> dict:
+        kids = sorted(
+            (finish(c) for c in node["children"].values()),
+            key=lambda c: -c["value"],
+        )
+        return {"name": node["name"], "value": node["value"], "children": kids}
+
+    return finish(root)
+
+
+def flame_html(counts: dict[str, int], title: str = "pixie flame") -> str:
+    """Self-contained static HTML flamegraph (no external assets): the
+    folded-stack tree is embedded as JSON and rendered by ~30 lines of
+    vanilla JS as nested width-proportional boxes with hover detail and
+    click-to-zoom."""
+    import html as _html
+    import json as _json
+
+    tree = _flame_tree(counts)
+    return f"""<!doctype html>
+<html><head><meta charset="utf-8"><title>{_html.escape(title)}</title>
+<style>
+body {{ font: 12px monospace; margin: 8px; background: #fff; }}
+#flame {{ position: relative; }}
+#flame div.f {{ position: absolute; box-sizing: border-box;
+  overflow: hidden; white-space: nowrap; height: 17px;
+  border: 1px solid #fff; cursor: pointer; }}
+#meta {{ margin-bottom: 8px; color: #444; }}
+</style></head><body>
+<div id="meta">{_html.escape(title)} — total samples: {tree["value"]}
+ (click a frame to zoom; click the root frame to reset)</div>
+<div id="flame"></div>
+<script>
+const TREE = {_json.dumps(tree)};
+const el = document.getElementById('flame');
+function render(root) {{
+  el.innerHTML = '';
+  let maxDepth = 0;
+  function place(node, x, frac, depth) {{
+    maxDepth = Math.max(maxDepth, depth);
+    const d = document.createElement('div'); d.className = 'f';
+    d.style.left = (x * 100).toFixed(4) + '%';
+    d.style.width = (frac * 100).toFixed(4) + '%';
+    d.style.top = (depth * 18) + 'px';
+    const pct = root.value ? (100 * node.value / root.value) : 0;
+    d.textContent = node.name;
+    d.title = node.name + ' — ' + node.value + ' samples (' +
+      pct.toFixed(2) + '%)';
+    d.style.background = depth === 0 ? '#d9d9d9' :
+      'hsl(' + (38 - 18 * Math.min(pct, 100) / 100) + ',90%,' +
+      (62 + (node.name.length % 5) * 2) + '%)';
+    d.onclick = () => render(depth === 0 ? TREE : node);
+    el.appendChild(d);
+    let cx = x;
+    for (const c of node.children) {{
+      const cf = node.value ? frac * c.value / node.value : 0;
+      if (root.value && c.value / root.value > 0.0005)
+        place(c, cx, cf, depth + 1);
+      cx += cf;
+    }}
+  }}
+  place(root, 0, 1.0, 0);
+  el.style.height = ((maxDepth + 1) * 18 + 4) + 'px';
+}}
+render(TREE);
+</script></body></html>
+"""

@@ -41,6 +41,11 @@ class _Record:
         # agent ships with registration/heartbeats — the broker-side
         # seed for pxbound's predicted costs (admission control).
         self.table_stats = dict(table_stats or {})
+        # Cumulative folded-stack profile summary rows the agent ships
+        # in heartbeats ({stack, count, qid, script_hash, tenant,
+        # phase}; see ingest/profiler.py profile_summary) — replace-on-
+        # heartbeat, merged cluster-wide by AgentTracker.profile().
+        self.profile: list[dict] = []
         self.last_heartbeat = time.monotonic()
 
 
@@ -122,6 +127,8 @@ class AgentTracker:
             rec.last_heartbeat = time.monotonic()
             if "table_stats" in msg:
                 rec.table_stats = dict(msg["table_stats"] or {})
+            if "profile" in msg:
+                rec.profile = list(msg["profile"] or [])
             if "schemas" in msg:
                 rec.schemas = dict(msg["schemas"])
                 rec.info = AgentInfo(
@@ -365,6 +372,56 @@ class AgentTracker:
             for table, st in self.table_stats().items()
             if "freshness" in st
         }
+
+    def profile(
+        self,
+        agent_id: str | None = None,
+        tenant: str | None = None,
+        script_hash: str | None = None,
+    ) -> list[dict]:
+        """Cluster-merged folded-stack profile: each agent's latest
+        heartbeat summary, counts summed across agents per (stack,
+        attribution) key — the /debug/pprof and `px profile` source.
+        Filters narrow to one agent / tenant / script hash; merged rows
+        come back hottest first."""
+        with self._lock:
+            summaries = [
+                (aid, list(rec.profile))
+                for aid, rec in self._agents.items()
+                if rec.profile and (agent_id is None or aid == agent_id)
+            ]
+        merged: dict[tuple, int] = {}
+        for _aid, rows in summaries:
+            for r in rows:
+                if tenant is not None and r.get("tenant", "") != tenant:
+                    continue
+                if (script_hash is not None
+                        and r.get("script_hash", "") != script_hash):
+                    continue
+                key = (
+                    r.get("stack", ""), r.get("qid", ""),
+                    r.get("script_hash", ""), r.get("tenant", ""),
+                    r.get("phase", ""),
+                )
+                if not key[0]:
+                    continue
+                merged[key] = merged.get(key, 0) + int(r.get("count", 0))
+        rows = [
+            {
+                "stack": k[0], "count": n, "qid": k[1],
+                "script_hash": k[2], "tenant": k[3], "phase": k[4],
+            }
+            for k, n in merged.items()
+        ]
+        rows.sort(key=lambda r: (-r["count"], r["stack"]))
+        return rows
+
+    def profile_agents(self) -> list[str]:
+        """Agents whose latest heartbeat carried a profile summary."""
+        with self._lock:
+            return sorted(
+                aid for aid, rec in self._agents.items() if rec.profile
+            )
 
     def agent_ids(self) -> list[str]:
         with self._lock:

@@ -60,6 +60,19 @@
 #                                      px/table_health, px/ingest_lag).
 #                                      The script-compile half also runs
 #                                      inside --tier1.
+#   ./run_tests.sh --profile           continuous-profiling gate: the
+#                                      attributed-profiler suite
+#                                      (tests/test_profiling.py —
+#                                      thread attribution, cluster
+#                                      merge, pprof/flamez endpoints,
+#                                      differential profiles, sampler
+#                                      overhead A/B; see
+#                                      docs/OBSERVABILITY.md "Profiling
+#                                      tier") plus the obs_check script
+#                                      compile of px/query_cpu,
+#                                      px/tenant_cpu and px/flame_diff.
+#                                      Both halves also run inside
+#                                      --obs and --tier1.
 #   ./run_tests.sh --tenancy           multi-tenant overload gate: the
 #                                      full tests/test_tenancy.py suite
 #                                      INCLUDING the slow-marked p99
@@ -114,7 +127,16 @@ case "$1" in
     env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python -m pytest -q tests/test_telemetry.py \
       tests/test_trace_stitching.py tests/test_programs.py \
-      tests/test_table_obs.py "$@" || rc=$?
+      tests/test_table_obs.py tests/test_profiling.py "$@" || rc=$?
+    exit $rc
+    ;;
+  --profile)
+    shift
+    rc=0
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pixie_tpu.analysis.obs_check || rc=$?
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest -q tests/test_profiling.py "$@" || rc=$?
     exit $rc
     ;;
   --tenancy)

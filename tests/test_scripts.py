@@ -464,6 +464,22 @@ def _seed_all_tables(eng, n=3000, seed=11):
         "temp_bytes": rng.integers(0, 10**7, m),
         "peak_bytes": rng.integers(0, 10**8, m),
     })
+    # Attributed profiler samples (ingest/profiler.py fold shape).
+    # script_hash values overlap the __queries__ seed above so
+    # px/query_cpu's join has matches; empty-string rows exercise the
+    # unattributed filters in px/tenant_cpu and px/flame_diff.
+    eng.append_data("__stacks__", {
+        "time_": tm,
+        "agent_id": [f"pem-{i % 3}" for i in range(m)],
+        "stack_trace_id": np.arange(m, dtype=np.int64) % 9,
+        "stack_trace": [f"main;f{i % 5};g{i % 13}" for i in range(m)],
+        "count": rng.integers(1, 30, m),
+        "qid": [("", f"q{i % 5}")[i % 2] for i in range(m)],
+        "script_hash": [("", f"hash-{i % 4}")[i % 3 > 0] for i in range(m)],
+        "tenant": [("", "shared", "dash")[i % 3] for i in range(m)],
+        "phase": [("host", "device_dispatch", "stall", "stage")[i % 4]
+                  for i in range(m)],
+    })
 
 
 @pytest.fixture(scope="module")
