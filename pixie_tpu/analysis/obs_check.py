@@ -32,6 +32,10 @@ OBS_SCRIPTS = (
     # script/tenant burn, per-tenant phase split, and the diff-ready
     # folded-stack feed (ingest/profiler.py + exec/threadmap.py).
     "px/query_cpu", "px/tenant_cpu", "px/flame_diff",
+    # Transport tier: per-topic-class bus throughput/lag/queue
+    # high-water and request/reply RTT over the __bus__ snapshots
+    # (services/busstats.py + BusStatsCollector fold).
+    "px/bus_health", "px/rpc_latency",
 )
 
 

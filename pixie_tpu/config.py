@@ -481,6 +481,24 @@ define_flag(
     "at all.",
 )
 define_flag(
+    "bus_telemetry", True,
+    "Buses (MessageBus/RemoteBus) stamp the transport tier "
+    "(services/busstats.py): per-topic-class publish/deliver/byte "
+    "counters, dispatcher-lag + handler service-time histograms, "
+    "queue-depth high-water gauges, wire frame/byte/RTT accounting — "
+    "folded into the __bus__ telemetry ring on the heartbeat cadence "
+    "and served at /debug/busz. Off = buses carry no stats object "
+    "(the A/B overhead baseline).",
+)
+define_flag(
+    "slow_handler_threshold_ms", 0.0,
+    "Bus handlers slower than this (service time, ms) log topic, "
+    "class, service/lag times to the 'pixie_tpu.slow_handler' logger "
+    "and count in pixie_bus_slow_handlers_total; 0 disables the "
+    "slow-handler log. The transport-tier twin of "
+    "slow_query_threshold_ms.",
+)
+define_flag(
     "profile_summary_stacks", 512,
     "Per-profiler cap on distinct (stack, attribution) keys kept in "
     "the cumulative folded-stack summary that heartbeats ship for "

@@ -91,6 +91,13 @@ def run_broker() -> int:
         # summaries + the broker's own sampler) back /debug/pprof and
         # /debug/flamez.
         profilez_fn=broker.profile_rows,
+        # Transport tier: cluster-merged agent bus summaries + the
+        # broker's local bus + the BusServer's per-connection wire
+        # accounting.
+        busz_fn=lambda: {
+            **broker.busz(),
+            "connections": server.busz(),
+        },
     )
     obs_port = obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "6101")))
     print(
@@ -107,14 +114,21 @@ def _dial_broker(host: str, port: int):
     import time as _time
 
     from .services.netbus import RemoteBus
+    from .services.observability import default_counter
 
     deadline = _time.monotonic() + float(
         os.environ.get("PIXIE_TPU_DIAL_TIMEOUT_S", "60")
+    )
+    retries = default_counter(
+        "pixie_net_dial_retries_total",
+        "Failed broker-netbus dial attempts during role startup "
+        "(roles come up in any order; each retry counts here).",
     )
     while True:
         try:
             return RemoteBus(host, port)
         except (ConnectionError, OSError):
+            retries.inc()
             if _time.monotonic() >= deadline:
                 raise
             _time.sleep(0.5)
@@ -227,6 +241,14 @@ def _agent_obs(agent, extra=None) -> int:
         # Local profiler summary (this agent only): the broker serves
         # the cluster merge; an agent's /debug/pprof is its own flames.
         profilez_fn=_local_profilez(agent.agent_id),
+        # Transport tier: this agent's bus (a RemoteBus in deploy, the
+        # shared MessageBus in-process) — frames/RTT to the broker plus
+        # its subscription queue state.
+        busz_fn=lambda: {
+            "scope": "agent",
+            "agent_id": agent.agent_id,
+            **agent.bus.busz(),
+        },
     )
     return obs.start(int(os.environ.get("PIXIE_TPU_OBS_PORT", "0")))
 

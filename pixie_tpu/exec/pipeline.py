@@ -100,6 +100,17 @@ PXLINT_HOT_REGIONS = (
     "ingest/profiler.py:PerfProfilerConnector*",
     "ingest/profiler.py:_fold_stack",
     "exec/threadmap.py:*",
+    # Transport tier: publish/deliver stamping runs on EVERY bus
+    # message (dispatch, acks, partials, heartbeats) on the
+    # publisher's and dispatcher's threads, and the __bus__ fold runs
+    # per heartbeat — host-counter arithmetic only; a host sync here
+    # would serialize the whole message path.
+    "services/msgbus.py:Subscription._deliver",
+    "services/msgbus.py:Subscription._run",
+    "services/msgbus.py:MessageBus.publish",
+    "services/msgbus.py:MessageBus._fanout",
+    "services/busstats.py:BusStats*",
+    "services/telemetry.py:BusStatsCollector*",
 )
 
 

@@ -401,6 +401,31 @@ AGENTS_RELATION = Relation(
     ]
 )
 
+# Transport tier (services/busstats.py): one cumulative-counter row
+# per changed (kind, topic_class/peer, direction) key each heartbeat
+# fold. ``kind`` is bus (in-process fan-out; topic_class label),
+# net (wire frames; the key column carries the peer), or rpc
+# (request/reply; key = peer, lag quantiles = RTT). Counters are
+# monotonic — ``px.max`` per key recovers the latest fold (the
+# px/bus_health / px/rpc_latency idiom).
+BUS_RELATION = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("agent_id", DataType.STRING),
+        ("kind", DataType.STRING),  # bus|net|rpc
+        ("topic_class", DataType.STRING),  # peer for net/rpc rows
+        ("direction", DataType.STRING),  # pub|deliver|send|recv|conn|request
+        ("msgs", DataType.INT64),
+        ("bytes", DataType.INT64),
+        ("errors", DataType.INT64),
+        ("lag_p50_ms", DataType.FLOAT64),
+        ("lag_p99_ms", DataType.FLOAT64),
+        ("service_p50_ms", DataType.FLOAT64),
+        ("service_p99_ms", DataType.FLOAT64),
+        ("queue_high_water", DataType.INT64),
+    ]
+)
+
 #: {table: Relation} for the self-telemetry tables.
 TELEMETRY_SCHEMAS: dict[str, "Relation"] = {
     "__queries__": QUERIES_RELATION,
@@ -409,6 +434,7 @@ TELEMETRY_SCHEMAS: dict[str, "Relation"] = {
     "__programs__": PROGRAMS_RELATION,
     "__tables__": TABLES_RELATION,
     "__stacks__": STACKS_RELATION,
+    "__bus__": BUS_RELATION,
 }
 
 # dns_table.h kDNSTable (subset).

@@ -158,16 +158,29 @@ class Agent:
         self.collector.stop()
 
     def _register(self):
-        self.bus.publish(
-            TOPIC_REGISTER,
-            {
-                "agent_id": self.agent_id,
-                "processes_data": self.processes_data,
-                "accepts_remote_sources": self.accepts_remote_sources,
-                "schemas": self._schemas(),
-                "table_stats": self._table_stats(),
-            },
-        )
+        msg = {
+            "agent_id": self.agent_id,
+            "processes_data": self.processes_data,
+            "accepts_remote_sources": self.accepts_remote_sources,
+            "schemas": self._schemas(),
+            "table_stats": self._table_stats(),
+        }
+        bus_rows = self._bus_summary()
+        if bus_rows:
+            msg["bus"] = bus_rows
+        self.bus.publish(TOPIC_REGISTER, msg)
+
+    def _bus_summary(self) -> list:
+        """Compact transport-tier summary for register/heartbeats (the
+        tracker's cluster merge; same rows the ``__bus__`` fold
+        appends). Empty when bus_telemetry is off."""
+        stats = getattr(self.bus, "stats", None)
+        if stats is None:
+            return []
+        try:
+            return stats.snapshot()
+        except Exception:
+            return []  # telemetry must never kill register/heartbeat
 
     def _on_registered(self, msg):
         self.asid = msg["asid"]
@@ -207,6 +220,17 @@ class Agent:
                     hb["profile"] = prof
             except Exception:
                 pass  # profiling must never kill the heartbeat loop
+            # Transport tier: fold this agent's bus counters into
+            # __bus__ (heartbeat cadence ONLY — see BusStatsCollector)
+            # and ship the same summary for the tracker's cluster merge.
+            if tel is not None:
+                try:
+                    tel.bus_stats.fold(force=True)
+                except Exception:
+                    pass  # telemetry must never kill the heartbeat loop
+            bus_rows = self._bus_summary()
+            if bus_rows:
+                hb["bus"] = bus_rows
             self.bus.publish(TOPIC_HEARTBEAT, hb)
 
     def _schemas(self) -> dict:

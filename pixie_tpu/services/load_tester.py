@@ -64,6 +64,16 @@ class LoadReport:
     # next to qps/p99. Empty when self-profiling is off or the
     # profiler runs in another process (remote broker).
     cpu_seconds_by_tenant: dict = field(default_factory=dict)
+    # Transport-tier view of the run: p99 dispatcher lag through the
+    # serving process's pixie_bus_dispatch_lag_seconds histogram
+    # (delta-bracketed like hist_quantiles_s) and the worst
+    # pixie_bus_queue_high_water gauge across topic classes. Queueing
+    # INSIDE the bus — a subscriber falling behind the offered load —
+    # shows up here before it widens the end-to-end latency columns.
+    # None/0 when the bus runs in another process or bus_telemetry is
+    # off.
+    bus_lag_p99_ms: float | None = None
+    bus_queue_high_water: int = 0
 
     @property
     def failure_rate(self) -> float:
@@ -116,6 +126,10 @@ class LoadReport:
             out["cache_hit_rate"] = round(self.cache_hit_rate, 4)
         if self.cpu_seconds_by_tenant:
             out["cpu_seconds_by_tenant"] = dict(self.cpu_seconds_by_tenant)
+        if self.bus_lag_p99_ms is not None:
+            out["bus_lag_p99_ms"] = round(self.bus_lag_p99_ms, 3)
+        if self.bus_queue_high_water:
+            out["bus_queue_high_water"] = self.bus_queue_high_water
         return out
 
 
@@ -182,6 +196,35 @@ def _hist_snapshot():
     from .observability import default_registry
 
     return default_registry.histogram_state("pixie_query_duration_seconds")
+
+
+def _bus_hist_snapshot():
+    from .observability import default_registry
+
+    return default_registry.histogram_state(
+        "pixie_bus_dispatch_lag_seconds"
+    )
+
+
+def _attach_bus_delta(report: LoadReport, before) -> None:
+    """Transport-tier bracket: this run's dispatcher-lag p99 (delta
+    over the cumulative bus histogram, all topic classes) and the worst
+    queue high-water gauge. The gauge is monotonic per process, so no
+    before-snapshot — the end value IS the worst ever seen, which is
+    the number the capacity question ("did anything queue?") needs."""
+    from .observability import default_registry, delta_quantiles
+
+    after = _bus_hist_snapshot()
+    if after is not None:
+        if before is None:
+            bounds, counts, _total, _sum = after
+            before = (bounds, [0] * len(counts), 0, 0.0)
+        q = delta_quantiles(before, after)
+        if q:
+            report.bus_lag_p99_ms = q.get(0.99, 0.0) * 1e3
+    hw = default_registry.values("pixie_bus_queue_high_water")
+    if hw:
+        report.bus_queue_high_water = int(max(hw.values()))
 
 
 def _cpu_samples_snapshot(tenants) -> dict:
@@ -262,6 +305,7 @@ def run_load(
     # bracket for the profiler's per-tenant CPU counter: the delta is
     # this run's attributed burn.
     hist_before = _hist_snapshot()
+    bus_before = _bus_hist_snapshot()
     cpu_before = _cpu_samples_snapshot([tenant] if tenant else [])
     t_start = time.perf_counter()
     threads = [
@@ -276,6 +320,7 @@ def run_load(
         t.join()
     report.wall_s = time.perf_counter() - t_start
     _attach_hist_delta(report, hist_before, _hist_snapshot())
+    _attach_bus_delta(report, bus_before)
     _attach_cpu_delta(
         report, cpu_before,
         _cpu_samples_snapshot([tenant] if tenant else []),
@@ -315,6 +360,7 @@ def run_mixed_load(execute, streams) -> dict:
         )
     tenants = sorted({s.tenant for s in streams if s.tenant})
     cpu_before = _cpu_samples_snapshot(tenants)
+    bus_before = _bus_hist_snapshot()
     t_start = time.perf_counter()
     for t in threads:
         t.start()
@@ -336,6 +382,10 @@ def run_mixed_load(execute, streams) -> dict:
             {own: cpu_before.get(own, 0.0)},
             {own: cpu_after.get(own, 0.0)},
         )
+        # The bus is shared across streams: every report carries the
+        # run's WHOLE transport view (per-stream attribution would need
+        # a tenant label the bus histogram deliberately doesn't carry).
+        _attach_bus_delta(reports[key], bus_before)
     return reports
 
 

@@ -10,6 +10,12 @@ codec (``wire.py``) — no pickle crosses the socket.
 
 Frames: 4-byte little-endian length + wire-encoded dict
 {"op": "pub"|"sub"|"unsub", "topic": str, "msg": ...?, "sid": int?}.
+
+Wire telemetry (``bus_telemetry`` flag, services/busstats.py): both
+endpoints count frames/bytes per peer and direction off ``_send_frame``
+/ ``_recv_frame_sized`` returns, request RTTs, send-stall time under
+the send lock, and connect/drop/auth-failure events — the cluster's
+wire-byte ground truth, served via ``busz()`` / ``/debug/busz``.
 """
 
 from __future__ import annotations
@@ -17,8 +23,12 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
+from collections import deque
 
+from ..config import get_flag
 from ..exec import tracectx
+from .busstats import BusStats, HANDLER_ERROR_RING, topic_class
 from .msgbus import BusTimeout, MessageBus
 from .wire import WireError, decode, encode
 
@@ -61,7 +71,9 @@ def _harden_socket(sock: socket.socket, send_timeout_s: int = 10) -> None:
                 pass
 
 
-def _send_frame(sock: socket.socket, obj) -> None:
+def _send_frame(sock: socket.socket, obj) -> int:
+    """Encode + send one frame; returns the wire bytes written (length
+    prefix included) so callers can account without re-encoding."""
     payload = encode(obj)
     if len(payload) > MAX_FRAME:
         # Fail the PUBLISHER visibly; an oversize frame on the wire would
@@ -72,6 +84,7 @@ def _send_frame(sock: socket.socket, obj) -> None:
             "chunk the payload"
         )
     sock.sendall(_LEN.pack(len(payload)) + payload)
+    return _LEN.size + len(payload)
 
 
 #: Required keys per frame op (both directions share the codec).
@@ -84,15 +97,21 @@ _FRAME_KEYS = {
 
 
 def _recv_frame(sock: socket.socket):
+    return _recv_frame_sized(sock)[0]
+
+
+def _recv_frame_sized(sock: socket.socket):
+    """One frame off the wire as ``(frame | None, wire_bytes)`` — the
+    byte count feeds the per-peer recv accounting."""
     header = _recv_exact(sock, 4)
     if header is None:
-        return None
+        return None, 0
     (n,) = _LEN.unpack(header)
     if n > MAX_FRAME:
         raise ConnectionError(f"frame of {n} bytes exceeds limit")
     payload = _recv_exact(sock, n)
     if payload is None:
-        return None
+        return None, _LEN.size
     frame = decode(payload)
     # Schema gate at the frame boundary: a frame that decodes but has
     # the wrong SHAPE (non-dict, non-str op/topic, non-int sid) is just
@@ -113,7 +132,7 @@ def _recv_frame(sock: socket.socket):
         raise WireError("frame 'topic' is not a string")
     if "sid" in frame and not isinstance(frame["sid"], int):
         raise WireError("frame 'sid' is not an int")
-    return frame
+    return frame, _LEN.size + n
 
 
 def _recv_exact(sock: socket.socket, n: int):
@@ -138,8 +157,6 @@ class BusServer:
 
     def __init__(self, bus: MessageBus, host: str = "127.0.0.1", port: int = 0,
                  secret: str | None = None):
-        from ..config import get_flag
-
         self.bus = bus
         self.secret = get_flag("bus_secret") if secret is None else secret
         self._srv = socket.create_server((host, port))
@@ -182,6 +199,28 @@ class BusServer:
             if client in self._clients:
                 self._clients.remove(client)
 
+    def busz(self) -> list[dict]:
+        """Per-connection wire accounting for ``/debug/busz`` (the
+        metric-label peer is the auth subject; THIS is where individual
+        connections stay distinguishable)."""
+        with self._lock:
+            clients = list(self._clients)
+        out = []
+        for c in clients:
+            try:
+                addr = c.sock.getpeername()
+                remote = f"{addr[0]}:{addr[1]}"
+            except OSError:
+                remote = "?"
+            out.append({
+                "remote": remote,
+                "peer": c.peer,
+                "subscriptions": len(c._subs),
+                **c._sent_counts,
+                **c._recv_counts,
+            })
+        return out
+
     def close(self) -> None:
         self._stop.set()
         try:
@@ -200,6 +239,15 @@ class _ClientConn:
     def __init__(self, server: BusServer, sock: socket.socket):
         self.server = server
         self.sock = sock
+        # Per-peer wire accounting: the metric-label peer is the auth
+        # subject ("anon" without a secret — bounded cardinality); the
+        # per-connection detail below feeds BusServer.busz() only.
+        self.peer = "client"
+        # Split by writer thread: sent counters mutate under _send_lock
+        # (any dispatcher thread may _send), recv counters only on the
+        # read-loop thread — no cross-thread writes to either dict.
+        self._sent_counts = {"frames_sent": 0, "bytes_sent": 0}
+        self._recv_counts = {"frames_recv": 0, "bytes_recv": 0}
         self._send_lock = threading.Lock()
         # Guards _subs + _closed: close() can run from any subscription
         # dispatcher thread (via a _send failure) while the read loop
@@ -219,11 +267,14 @@ class _ClientConn:
     def _read_loop(self) -> None:
         from .auth import ANONYMOUS, AuthError, verify_token
 
+        st = self.server.bus.stats
         try:
             if self.server.secret:
                 # Authentication handshake gates EVERYTHING else.
-                frame = _recv_frame(self.sock)
+                frame = self._recv_counted(st)
                 if frame is None or frame.get("op") != "auth":
+                    if st is not None:
+                        st.on_conn_event(self.peer, "auth_failure")
                     self._send({"op": "auth_err", "error": "auth required"})
                     return
                 try:
@@ -231,13 +282,19 @@ class _ClientConn:
                         self.server.secret, frame.get("token")
                     )
                 except AuthError as e:
+                    if st is not None:
+                        st.on_conn_event(self.peer, "auth_failure")
                     self._send({"op": "auth_err", "error": str(e)})
                     return
+                self.peer = self.auth_ctx.subject or "anon"
                 self._send({"op": "auth_ok", "sub": self.auth_ctx.subject})
             else:
                 self.auth_ctx = ANONYMOUS
+                self.peer = "anon"
+            if st is not None:
+                st.on_conn_event(self.peer, "connect")
             while True:
-                frame = _recv_frame(self.sock)
+                frame = self._recv_counted(st)
                 if frame is None:
                     break
                 op = frame.get("op")
@@ -272,15 +329,37 @@ class _ClientConn:
             # WireError covers corrupted bytes AND wrong-schema frames
             # (validated in _recv_frame) — drop the connection; real
             # handler bugs still raise visibly.
-            pass
+            if st is not None:
+                st.on_conn_event(self.peer, "drop")
         finally:
             self.close()
 
+    def _recv_counted(self, st):
+        frame, nb = _recv_frame_sized(self.sock)
+        if nb:
+            # Single writer: only the read-loop thread touches the
+            # recv counters (the send pair lives under _send_lock).
+            self._recv_counts["frames_recv"] += 1
+            self._recv_counts["bytes_recv"] += nb
+            if st is not None:
+                st.on_frame(self.peer, "recv", nb)
+        return frame
+
     def _send(self, obj) -> None:
+        st = self.server.bus.stats
         try:
+            t0 = time.monotonic()
             with self._send_lock:
-                _send_frame(self.sock, obj)
+                stall_s = time.monotonic() - t0
+                n = _send_frame(self.sock, obj)
+                self._sent_counts["frames_sent"] += 1
+                self._sent_counts["bytes_sent"] += n
+            if st is not None:
+                st.on_send_stall(self.peer, stall_s)
+                st.on_frame(self.peer, "send", n)
         except (ConnectionError, OSError):
+            if st is not None:
+                st.on_conn_event(self.peer, "drop")
             self.close()
 
     def close(self) -> None:
@@ -304,12 +383,15 @@ class _RemoteSubscription:
 
     _SENTINEL = object()
 
-    def __init__(self, bus: "RemoteBus", sid: int, fn):
+    def __init__(self, bus: "RemoteBus", sid: int, fn, topic: str = ""):
         import queue as _queue
 
         self._bus = bus
         self._sid = sid
         self._fn = fn
+        self.topic = topic
+        self._cls = topic_class(topic) if topic else "?"
+        self._hw = 0
         self._q: "_queue.Queue" = _queue.Queue()
         self._thread = threading.Thread(
             target=self._run, name=f"remotebus-sub-{sid}", daemon=True
@@ -317,21 +399,43 @@ class _RemoteSubscription:
         self._thread.start()
 
     def _run(self) -> None:
+        st = self._bus.stats
         while True:
-            msg = self._q.get()
-            if msg is self._SENTINEL:
+            item = self._q.get()
+            if item is self._SENTINEL:
                 return
+            if st is not None:
+                msg, enq_t = item
+                lag_s = time.monotonic() - enq_t
+                t0 = time.monotonic()
+            else:
+                msg = item
+            err = False
             try:
                 # Same envelope binding as msgbus.Subscription: the
                 # distributed trace context survives the TCP hop (the
                 # wire codec carries the _trace_ctx dict unchanged).
                 with tracectx.bound(tracectx.extract(msg)):
                     self._fn(msg)
-            except Exception:  # handler errors never kill the dispatcher
-                pass
+            except Exception as e:  # handler errors never kill the dispatcher
+                err = True
+                self._bus._on_handler_error(self.topic, e)
+            if st is not None:
+                st.on_handled(
+                    self._cls, self.topic, lag_s,
+                    time.monotonic() - t0, error=err,
+                )
 
     def _deliver(self, msg) -> None:
-        self._q.put(msg)
+        st = self._bus.stats
+        if st is not None:
+            depth = self._q.qsize() + 1
+            if depth > self._hw:
+                self._hw = depth
+            st.on_deliver(self._cls, 0, depth)
+            self._q.put((msg, time.monotonic()))
+        else:
+            self._q.put(msg)
 
     def unsubscribe(self) -> None:
         self._bus._unsubscribe(self._sid)
@@ -344,8 +448,14 @@ class RemoteBus:
 
     def __init__(self, host: str, port: int, connect_timeout_s: float = 10.0,
                  token: str | None = None):
-        from ..config import get_flag
-
+        # Wire accounting peer label: the broker endpoint this client
+        # dialed (config-bounded cardinality — one broker per deploy).
+        self.peer = f"{host}:{port}"
+        self.stats: BusStats | None = (
+            BusStats() if get_flag("bus_telemetry") else None
+        )
+        self.handler_errors: deque = deque(maxlen=HANDLER_ERROR_RING)
+        self._handler_errors_total = 0
         self.sock = socket.create_connection((host, port), connect_timeout_s)
         # create_connection leaves its timeout ARMED on the socket; the
         # read loop would then treat any 10s-idle connection as dead
@@ -376,13 +486,21 @@ class RemoteBus:
             # answers auth_ok or auth_err+close, so a bad token fails
             # loudly at connect instead of silently dropping frames.
             self.sock.settimeout(connect_timeout_s)
-            _send_frame(self.sock, {"op": "auth", "token": token})
-            reply = _recv_frame(self.sock)
+            n = _send_frame(self.sock, {"op": "auth", "token": token})
+            if self.stats is not None:
+                self.stats.on_frame(self.peer, "send", n)
+            reply, nb = _recv_frame_sized(self.sock)
+            if self.stats is not None and nb:
+                self.stats.on_frame(self.peer, "recv", nb)
             if not (isinstance(reply, dict) and reply.get("op") == "auth_ok"):
                 err = (reply or {}).get("error", "connection closed")
+                if self.stats is not None:
+                    self.stats.on_conn_event(self.peer, "auth_failure")
                 self.sock.close()
                 raise ConnectionError(f"netbus auth failed: {err}")
             self.sock.settimeout(None)
+        if self.stats is not None:
+            self.stats.on_conn_event(self.peer, "connect")
         self._thread = threading.Thread(
             target=self._read_loop, name="remotebus", daemon=True
         )
@@ -392,12 +510,14 @@ class RemoteBus:
         with self._lock:
             sid = self._next_sid
             self._next_sid += 1
-            sub = _RemoteSubscription(self, sid, fn)
+            sub = _RemoteSubscription(self, sid, fn, topic=topic)
             self._handlers[sid] = sub
         self._send({"op": "sub", "topic": topic, "sid": sid})
         return sub
 
     def publish(self, topic: str, msg: dict) -> int:
+        if self.stats is not None:
+            self.stats.on_publish(topic, msg)
         msg = tracectx.attach(msg)  # envelope parity with MessageBus
         inj = self.fault_injector
         if inj is not None:
@@ -437,13 +557,21 @@ class RemoteBus:
         import queue as _queue
         import uuid as _uuid
 
+        st = self.stats
         inbox = f"_inbox.{_uuid.uuid4().hex}"
         q: _queue.Queue = _queue.Queue()
         sub = self.subscribe(inbox, q.put)
+        t0 = time.monotonic()
         try:
             self.publish(topic, {**msg, "_reply_to": inbox})
-            return q.get(timeout=timeout_s)
+            reply = q.get(timeout=timeout_s)
+            if st is not None:
+                st.on_request(self.peer, time.monotonic() - t0)
+            return reply
         except _queue.Empty:
+            if st is not None:
+                st.on_request(self.peer, time.monotonic() - t0,
+                              error=True)
             raise BusTimeout(
                 f"no reply from {topic!r} in {timeout_s}s"
             ) from None
@@ -461,20 +589,69 @@ class RemoteBus:
     def _send(self, obj) -> None:
         if self._closed.is_set():
             raise ConnectionError("remote bus closed")
+        st = self.stats
         try:
+            t0 = time.monotonic()
             with self._send_lock:
-                _send_frame(self.sock, obj)
+                stall_s = time.monotonic() - t0
+                n = _send_frame(self.sock, obj)
+            if st is not None:
+                st.on_send_stall(self.peer, stall_s)
+                st.on_frame(self.peer, "send", n)
         except (ConnectionError, OSError):
             # A failed/timed-out send may have written a PARTIAL frame:
             # the stream is desynced for good. Poison the bus so every
             # later caller fails fast instead of corrupting the wire.
+            if st is not None and not self._closed.is_set():
+                st.on_conn_event(self.peer, "drop")
             self.close()
             raise
 
+    def _on_handler_error(self, topic: str, e: Exception) -> None:
+        with self._lock:
+            self.handler_errors.append((topic, e, time.time_ns()))
+            self._handler_errors_total += 1
+
+    def busz(self) -> dict:
+        """The ``/debug/busz`` surface for this bus (MessageBus.busz
+        mirror): stat rows, live subscription queue state, recent
+        handler errors."""
+        st = self.stats
+        with self._lock:
+            subs = list(self._handlers.values())
+            recent = [
+                {"topic": t, "error": repr(e), "unix_ns": ns}
+                for t, e, ns in self.handler_errors
+            ]
+            errors_total = self._handler_errors_total
+        queues: dict[str, dict] = {}
+        for s in subs:
+            ent = queues.setdefault(
+                s._cls, {"subscriptions": 0, "depth": 0, "high_water": 0}
+            )
+            ent["subscriptions"] += 1
+            ent["depth"] = max(ent["depth"], s._q.qsize())
+            ent["high_water"] = max(ent["high_water"], s._hw)
+        if st is not None:
+            for cls, hw in st.queue_high_water().items():
+                ent = queues.setdefault(
+                    cls, {"subscriptions": 0, "depth": 0, "high_water": 0}
+                )
+                ent["high_water"] = max(ent["high_water"], hw)
+        return {
+            "rows": st.snapshot() if st is not None else [],
+            "queues": queues,
+            "handler_errors_total": errors_total,
+            "recent_errors": recent,
+        }
+
     def _read_loop(self) -> None:
+        st = self.stats
         try:
             while True:
-                frame = _recv_frame(self.sock)
+                frame, nb = _recv_frame_sized(self.sock)
+                if st is not None and nb:
+                    st.on_frame(self.peer, "recv", nb)
                 if frame is None:
                     break
                 if frame.get("op") == "msg":
@@ -488,6 +665,10 @@ class RemoteBus:
             # handler bugs still raise visibly.
             pass
         finally:
+            # An orderly close() sets _closed BEFORE the socket dies;
+            # anything else reaching here lost the connection.
+            if st is not None and not self._closed.is_set():
+                st.on_conn_event(self.peer, "drop")
             self._closed.set()
             self._reap_dispatchers()
 

@@ -93,6 +93,16 @@ class MetricsRegistry:
         (table stats, cache bytes) refresh here."""
         self._collectors.append(fn)
 
+    def values(self, name: str) -> dict:
+        """Snapshot of a counter/gauge's per-label-set values
+        ({labels tuple: value}; {} for unknown names or histograms —
+        those go through ``histogram_state``/``quantiles``)."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None or m.kind == "histogram":
+                return {}
+            return dict(m.values)
+
     def render(self) -> str:
         # A raising collector must not 500 the whole scrape: count it
         # and keep rendering the rest (prometheus-cpp Collect contract).
@@ -357,7 +367,7 @@ class ObservabilityServer:
     def __init__(self, registry: MetricsRegistry | None = None,
                  statusz_fn=None, health_fn=None, tracer=None,
                  trace_view=None, programs=None, tablez_fn=None,
-                 cachez_fn=None, profilez_fn=None):
+                 cachez_fn=None, profilez_fn=None, busz_fn=None):
         self.registry = registry or default_registry
         self.statusz_fn = statusz_fn  # () -> dict
         self.health_fn = health_fn  # () -> (bool, str)
@@ -386,6 +396,11 @@ class ObservabilityServer:
         # its local profiler summary; a broker serves the tracker's
         # cluster merge plus its own samples.
         self.profilez_fn = profilez_fn
+        # () -> dict | None: wire one to serve /debug/busz — the
+        # transport-tier snapshot (an agent serves its bus's busz();
+        # a broker serves the tracker's cluster merge + its local bus
+        # + per-connection BusServer accounting).
+        self.busz_fn = busz_fn
         self._httpd = None
 
     def handle(self, path: str) -> tuple[int, str, str]:
@@ -436,6 +451,11 @@ class ObservabilityServer:
             if self.cachez_fn is None:
                 return (404, "text/plain", "no result cache wired\n")
             body = json.dumps(self.cachez_fn(), indent=1, default=str)
+            return (200, "application/json", body)
+        if path == "/debug/busz":
+            if self.busz_fn is None:
+                return (404, "text/plain", "no bus stats wired\n")
+            body = json.dumps(self.busz_fn(), indent=1, default=str)
             return (200, "application/json", body)
         if path == "/debug/programz":
             if self.programs is None:

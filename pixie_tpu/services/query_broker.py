@@ -1186,6 +1186,23 @@ class QueryBroker:
         out.sort(key=lambda r: (-r["count"], r["stack"]))
         return out
 
+    def busz(self) -> dict:
+        """Cluster transport snapshot for ``/debug/busz``: the
+        tracker's per-agent + merged heartbeat bus summaries, plus this
+        broker process's own bus (its dispatch/ack/heartbeat traffic —
+        present whenever the bus carries stats; deploy adds the
+        BusServer's per-connection wire accounting on top)."""
+        t = self.tracker.bus_stats()
+        out = {
+            "scope": "cluster",
+            "agents": t["agents"],
+            "merged": t["merged"],
+        }
+        local = getattr(self.bus, "busz", None)
+        if local is not None:
+            out["local"] = local()
+        return out
+
     def profile_agents(self) -> list[str]:
         """Agents contributing stacks to the merged profile (the
         broker's own sampler counts when it has samples)."""

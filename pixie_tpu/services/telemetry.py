@@ -189,6 +189,82 @@ class TableStatsCollector:
             return n
 
 
+class BusStatsCollector:
+    """Folds bus transport snapshots into ``__bus__``.
+
+    One row per (kind, topic_class/peer, direction) key whose counters
+    CHANGED since this collector's previous fold (the ``__tables__``
+    change-cursor shape). Fired from the heartbeat cadence ONLY, never
+    per trace: every distributed trace moves its own ack/dispatch
+    counters, so a per-trace fold would be a self-perpetuating row per
+    query — the same reasoning that keeps dunder tables out of the
+    per-trace ``__tables__`` fold. Reads whatever ``bus.stats`` the
+    agent's transport carries (``MessageBus`` or ``RemoteBus``); a
+    stats-less bus (``bus_telemetry`` off, or no bus at all) folds
+    nothing.
+    """
+
+    def __init__(self, engine, agent_id: str = "engine", bus=None):
+        self.engine = engine
+        self.agent_id = agent_id
+        self.bus = bus
+        self._lock = threading.Lock()
+        self._last: dict = {}  # (kind, key, direction) -> signature
+
+    @staticmethod
+    def _signature(r: dict) -> tuple:
+        """Any counter movement is a change; the histogram quantiles
+        only move when a counter does."""
+        return (r["msgs"], r["bytes"], r["errors"], r["queue_high_water"])
+
+    def fold(self, end_ns: int | None = None, force: bool = False) -> int:
+        """Append a ``__bus__`` row per changed key (every key when
+        ``force`` — the heartbeat cadence). Returns the row count."""
+        stats = getattr(self.bus, "stats", None)
+        if stats is None:
+            return 0
+        end_ns = end_ns or time.time_ns()
+        snap = stats.snapshot()
+        with self._lock:
+            changed = [
+                r for r in snap
+                if force or self._last.get(
+                    (r["kind"], r["topic_class"], r["direction"])
+                ) != self._signature(r)
+            ]
+            if not changed:
+                return 0
+            n = len(changed)
+            self.engine.append_data("__bus__", {
+                "time_": [end_ns] * n,
+                "agent_id": [self.agent_id] * n,
+                "kind": [r["kind"] for r in changed],
+                "topic_class": [r["topic_class"] for r in changed],
+                "direction": [r["direction"] for r in changed],
+                "msgs": [int(r["msgs"]) for r in changed],
+                "bytes": [int(r["bytes"]) for r in changed],
+                "errors": [int(r["errors"]) for r in changed],
+                "lag_p50_ms": [float(r["lag_p50_ms"]) for r in changed],
+                "lag_p99_ms": [float(r["lag_p99_ms"]) for r in changed],
+                "service_p50_ms": [
+                    float(r["service_p50_ms"]) for r in changed
+                ],
+                "service_p99_ms": [
+                    float(r["service_p99_ms"]) for r in changed
+                ],
+                "queue_high_water": [
+                    int(r["queue_high_water"]) for r in changed
+                ],
+            })
+            # Commit the cursor only after a successful append (the
+            # __programs__ contract: a raising ring must not eat rows).
+            for r in changed:
+                self._last[
+                    (r["kind"], r["topic_class"], r["direction"])
+                ] = self._signature(r)
+            return n
+
+
 class TelemetryCollector:
     """Folds one engine's finished traces into its own table store."""
 
@@ -201,6 +277,9 @@ class TelemetryCollector:
         # Storage-tier fold (``__tables__``): shared with the agent
         # heartbeat loop, which calls table_stats.fold() on its cadence.
         self.table_stats = TableStatsCollector(engine, agent_id)
+        # Transport-tier fold (``__bus__``): heartbeat cadence only —
+        # see BusStatsCollector on why never per trace.
+        self.bus_stats = BusStatsCollector(engine, agent_id, bus=bus)
         self._lock = threading.Lock()
         self._totals = {
             "queries": 0, "errors": 0, "bytes_staged": 0,

@@ -46,6 +46,10 @@ class _Record:
         # phase}; see ingest/profiler.py profile_summary) — replace-on-
         # heartbeat, merged cluster-wide by AgentTracker.profile().
         self.profile: list[dict] = []
+        # Cumulative transport-tier summary rows (busstats snapshot
+        # shape) the agent ships in register/heartbeats — replace-on-
+        # heartbeat, merged cluster-wide by AgentTracker.bus_stats().
+        self.bus: list[dict] = []
         self.last_heartbeat = time.monotonic()
 
 
@@ -108,10 +112,12 @@ class AgentTracker:
                 tables=frozenset(msg.get("schemas", {})),
                 asid=asid,
             )
-            self._agents[agent_id] = _Record(
+            rec = _Record(
                 info, dict(msg.get("schemas", {})),
                 msg.get("table_stats"),
             )
+            rec.bus = list(msg.get("bus") or [])
+            self._agents[agent_id] = rec
         self.bus.publish(f"agent.{agent_id}.registered", {"asid": asid})
 
     def _on_heartbeat(self, msg: dict):
@@ -129,6 +135,8 @@ class AgentTracker:
                 rec.table_stats = dict(msg["table_stats"] or {})
             if "profile" in msg:
                 rec.profile = list(msg["profile"] or [])
+            if "bus" in msg:
+                rec.bus = list(msg["bus"] or [])
             if "schemas" in msg:
                 rec.schemas = dict(msg["schemas"])
                 rec.info = AgentInfo(
@@ -422,6 +430,45 @@ class AgentTracker:
             return sorted(
                 aid for aid, rec in self._agents.items() if rec.profile
             )
+
+    def bus_stats(self) -> dict:
+        """Cluster-merged transport tier: each agent's latest heartbeat
+        bus summary, merged per (kind, topic_class, direction) key —
+        counters summed, queue high-water maxed, and the lag/service
+        quantiles taken as the MAX across agents (a worst-participant
+        view: cross-agent histogram merge would need the buckets, which
+        heartbeats deliberately don't ship). The /debug/busz source."""
+        with self._lock:
+            agents = {
+                aid: [dict(r) for r in rec.bus]
+                for aid, rec in self._agents.items()
+                if rec.bus
+            }
+        merged: dict[tuple, dict] = {}
+        for rows in agents.values():
+            for r in rows:
+                key = (
+                    r.get("kind", ""), r.get("topic_class", ""),
+                    r.get("direction", ""),
+                )
+                m = merged.get(key)
+                if m is None:
+                    merged[key] = dict(r)
+                    continue
+                for f in ("msgs", "bytes", "errors"):
+                    m[f] = int(m.get(f, 0)) + int(r.get(f, 0))
+                for f in ("lag_p50_ms", "lag_p99_ms",
+                          "service_p50_ms", "service_p99_ms"):
+                    m[f] = max(float(m.get(f, 0.0)), float(r.get(f, 0.0)))
+                m["queue_high_water"] = max(
+                    int(m.get("queue_high_water", 0)),
+                    int(r.get("queue_high_water", 0)),
+                )
+        out = sorted(
+            merged.values(),
+            key=lambda r: (r["kind"], r["topic_class"], r["direction"]),
+        )
+        return {"agents": agents, "merged": out}
 
     def agent_ids(self) -> list[str]:
         with self._lock:

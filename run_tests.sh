@@ -45,11 +45,12 @@
 #   ./run_tests.sh --obs               self-observability gate: the
 #                                      self-telemetry + trace-stitching
 #                                      + device-tier program-registry
-#                                      + storage-tier suites
-#                                      (tests/test_telemetry.py,
+#                                      + storage-tier + transport-tier
+#                                      suites (tests/test_telemetry.py,
 #                                      tests/test_trace_stitching.py,
 #                                      tests/test_programs.py,
-#                                      tests/test_table_obs.py)
+#                                      tests/test_table_obs.py,
+#                                      tests/test_bus_obs.py)
 #                                      plus plan-verifier compilation of
 #                                      the bundled self-monitoring PxL
 #                                      scripts against the telemetry
@@ -57,7 +58,8 @@
 #                                      pixie_tpu/analysis/obs_check.py;
 #                                      incl. px/program_cost,
 #                                      px/bound_accuracy,
-#                                      px/table_health, px/ingest_lag).
+#                                      px/table_health, px/ingest_lag,
+#                                      px/bus_health, px/rpc_latency).
 #                                      The script-compile half also runs
 #                                      inside --tier1.
 #   ./run_tests.sh --profile           continuous-profiling gate: the
@@ -127,7 +129,8 @@ case "$1" in
     env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python -m pytest -q tests/test_telemetry.py \
       tests/test_trace_stitching.py tests/test_programs.py \
-      tests/test_table_obs.py tests/test_profiling.py "$@" || rc=$?
+      tests/test_table_obs.py tests/test_profiling.py \
+      tests/test_bus_obs.py "$@" || rc=$?
     exit $rc
     ;;
   --profile)

@@ -480,6 +480,29 @@ def _seed_all_tables(eng, n=3000, seed=11):
         "phase": [("host", "device_dispatch", "stall", "stage")[i % 4]
                   for i in range(m)],
     })
+    # Transport-tier fold rows (BusStatsCollector shape): bus rows so
+    # px/bus_health has topic classes to group, rpc rows for
+    # px/rpc_latency; counters grow across folds like the real
+    # heartbeat cadence (the scripts recover latest-fold via px.max).
+    kinds = [("bus", "agent.heartbeat", "deliver"),
+             ("bus", "query.ack", "pub"),
+             ("rpc", "local", "request"),
+             ("rpc", "127.0.0.1:6100", "request")]
+    eng.append_data("__bus__", {
+        "time_": tm,
+        "agent_id": [f"pem-{i % 3}" for i in range(m)],
+        "kind": [kinds[i % 4][0] for i in range(m)],
+        "topic_class": [kinds[i % 4][1] for i in range(m)],
+        "direction": [kinds[i % 4][2] for i in range(m)],
+        "msgs": np.arange(m, dtype=np.int64) + 10,
+        "bytes": (np.arange(m, dtype=np.int64) + 10) * 128,
+        "errors": rng.integers(0, 3, m),
+        "lag_p50_ms": rng.uniform(0.1, 2, m),
+        "lag_p99_ms": rng.uniform(2, 50, m),
+        "service_p50_ms": rng.uniform(0.1, 5, m),
+        "service_p99_ms": rng.uniform(5, 100, m),
+        "queue_high_water": rng.integers(0, 16, m),
+    })
 
 
 @pytest.fixture(scope="module")
