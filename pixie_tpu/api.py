@@ -22,6 +22,16 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+#: Control-plane requests that are safe to retry on a BusTimeout: pure
+#: reads with no side effects. broker.execute is deliberately NOT here
+#: — re-running a script blind could double-execute mutations; it
+#: re-resolves the leader and surfaces a structured error instead
+#: (docs/RESILIENCE.md "Broker HA").
+_IDEMPOTENT_TOPICS = frozenset({
+    "broker.scripts", "broker.schemas", "broker.agents",
+    "broker.debug_queries", "broker.profile", "broker.leader",
+})
+
 
 class ScriptExecutionError(RuntimeError):
     pass
@@ -138,6 +148,20 @@ class Client:
     def agents(self) -> list[dict]:
         return self._request("broker.agents", {})["agents"]
 
+    def agents_status(self) -> dict:
+        """Like :meth:`agents` but returns the full reply, including
+        ``broker`` — WHICH broker replica answered (broker HA; empty on
+        a plain single-broker deploy). The `px agents` surface."""
+        res = self._request("broker.agents", {})
+        return {"agents": res.get("agents", []),
+                "broker": res.get("broker", "")}
+
+    def resolve_leader(self, timeout_s: float = 2.0) -> dict:
+        """Current broker-HA leader as every replica last saw it:
+        ``{"broker": id, "epoch": n, "role": ..., "answered_by": id}``.
+        Raises on a non-HA deploy (nobody serves ``broker.leader``)."""
+        return self._request("broker.leader", {}, timeout_s=timeout_s)
+
     # -- execution -----------------------------------------------------------
     def execute_script(
         self,
@@ -177,9 +201,32 @@ class Client:
             req["priority"] = int(priority)
         if deadline_ms is not None:
             req["deadline_ms"] = float(deadline_ms)
-        res = self._request(
-            "broker.execute", req, timeout_s=timeout_s + 5,
-        )
+        from .services.msgbus import BusTimeout
+
+        try:
+            res = self._request(
+                "broker.execute", req, timeout_s=timeout_s + 5,
+            )
+        except BusTimeout as e:
+            # NEVER blind-retried: execute is non-idempotent (pxtrace
+            # mutations; duplicate compute). Re-resolve the leader so
+            # the structured error tells the caller where to resubmit.
+            leader = ""
+            try:
+                info = self.resolve_leader()
+                leader = str(info.get("broker", ""))
+            except Exception:
+                pass
+            hint = (
+                f" (current leader: {leader}; resubmit to it)"
+                if leader else
+                " (no broker leader answered; the cluster may be "
+                "mid-failover)"
+            )
+            raise ScriptExecutionError(
+                f"execute_script got no reply and was not retried "
+                f"(non-idempotent){hint}: {e}"
+            ) from e
         out = ScriptResults()
         out.partial = bool(res.get("partial"))
         out.missing_agents = list(res.get("missing_agents", []))
@@ -249,13 +296,41 @@ class Client:
         return StreamSubscription(self, res["qid"], sub)
 
     def _request(self, topic: str, msg: dict, timeout_s: float = 10.0) -> dict:
+        import random as _random
+        import time as _time
+
         from .config import get_flag
+        from .services.msgbus import BusTimeout
 
         if get_flag("bus_secret") and "token" not in msg:
             from .services.auth import sign_token
 
             msg = {**msg, "token": sign_token(get_flag("bus_secret"), "api")}
-        res = self._bus.request(topic, msg, timeout_s=timeout_s)
+        # Idempotent control-plane reads retry through a broker
+        # failover window (capped exponential backoff + jitter);
+        # anything else gets exactly one attempt.
+        retries = (
+            int(get_flag("client_request_retries"))
+            if topic in _IDEMPOTENT_TOPICS else 0
+        )
+        base_s = max(float(get_flag("client_retry_backoff_ms")), 1.0) / 1e3
+        attempt = 0
+        while True:
+            try:
+                res = self._bus.request(topic, msg, timeout_s=timeout_s)
+                break
+            except BusTimeout:
+                if attempt >= retries:
+                    raise
+                from .services.observability import default_counter
+
+                default_counter(
+                    "pixie_client_retries_total",
+                    "Idempotent api.Client requests retried on BusTimeout",
+                ).inc()
+                backoff = min(base_s * (2 ** attempt), 2.0)
+                _time.sleep(backoff * (1.0 + 0.25 * _random.random()))
+                attempt += 1
         if not res.get("ok"):
             raise ScriptExecutionError(res.get("error", "unknown error"))
         return res

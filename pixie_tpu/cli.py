@@ -261,7 +261,11 @@ def cmd_tables(args) -> int:
 
 def cmd_agents(args) -> int:
     with _client(args.broker) as client:
-        agents = client.agents()
+        status = client.agents_status()
+    agents = status["agents"]
+    if status.get("broker"):
+        # Broker HA: WHICH replica answered (the current leader).
+        print(f"broker: {status['broker']}")
     for a in agents:
         q = "  QUARANTINED" if a.get("quarantined") else ""
         print(

@@ -265,6 +265,42 @@ define_flag(
     "heartbeat meanwhile.",
 )
 
+# -- broker HA (services/broker_ha.py; docs/RESILIENCE.md "Broker HA") -------
+define_flag(
+    "broker_lease_interval_s", 0.5,
+    "Cadence of the leader's broker.lease heartbeat and of each "
+    "standby's expiry check / presence announcement.",
+)
+define_flag(
+    "broker_lease_expiry_s", 2.0,
+    "Lease age past which a standby declares the leader dead and the "
+    "lowest-id standby claims the next epoch (each higher-ranked "
+    "standby waits one extra lease interval before claiming).",
+)
+define_flag(
+    "broker_reconcile_wait_s", 0.5,
+    "How long a freshly elected leader collects agents' answers to the "
+    "broker.reconcile probe before resolving the deposed leader's "
+    "in-flight queries (re-attach vs partial/broker_failover).",
+)
+define_flag(
+    "broker_reattach_timeout_s", 15.0,
+    "Forwarder wait budget for a re-attached failover query on the new "
+    "leader; the inactivity watchdog inside the wait bounds a truly "
+    "dead query well before this.",
+)
+define_flag(
+    "client_request_retries", 3,
+    "api.Client retries of IDEMPOTENT control-plane requests (agents, "
+    "schemas, debug_queries, ...) on BusTimeout. execute_script is "
+    "never blind-retried (non-idempotent).",
+)
+define_flag(
+    "client_retry_backoff_ms", 50.0,
+    "Initial backoff for api.Client idempotent-request retries; "
+    "doubles per attempt (capped at 2s) with +0..25% jitter.",
+)
+
 # -- query-lifecycle tracing (exec/trace.py) ---------------------------------
 define_flag(
     "trace_ring_size", 128,

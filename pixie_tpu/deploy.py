@@ -42,11 +42,39 @@ def run_broker() -> int:
     from .services.tracepoints import TracepointRegistry
 
     bus = MessageBus()
-    tracker = AgentTracker(bus)
-    broker = QueryBroker(bus, tracker)
-    broker.tracepoints = TracepointRegistry(bus, tracker)
-    broker.serve()
+    # Broker HA (PIXIE_TPU_BROKER_HA=1): this process is one replica of
+    # an N-broker control plane — PIXIE_TPU_BROKER_ID names it,
+    # PIXIE_TPU_BROKER_ROLE=standby boots it as a lease-watching mirror
+    # (default: leader). Standbys fold the leader's broker.state log
+    # and take over in-flight queries when the lease lapses
+    # (docs/RESILIENCE.md "Broker HA").
+    replica = None
+    if os.environ.get("PIXIE_TPU_BROKER_HA"):
+        from .services.broker_ha import BrokerReplica
+
+        replica = BrokerReplica(
+            bus,
+            os.environ.get("PIXIE_TPU_BROKER_ID", "broker-0"),
+            leader=os.environ.get(
+                "PIXIE_TPU_BROKER_ROLE", "leader"
+            ) != "standby",
+        )
+        tracker, broker = replica.tracker, replica.broker
+        broker.tracepoints = TracepointRegistry(bus, tracker)
+    else:
+        tracker = AgentTracker(bus)
+        broker = QueryBroker(bus, tracker)
+        broker.tracepoints = TracepointRegistry(bus, tracker)
+        broker.serve()
     runner = ScriptRunner(broker)
+    if replica is not None:
+        # Cron scripts run on the LEADER only — a standby executing the
+        # same schedule would double-run every script cluster-wide. The
+        # gate follows failover: a promoted standby starts ticking.
+        _tick = runner.tick
+        runner.tick = (
+            lambda: _tick() if replica.role == "leader" else None
+        )
     runner.run_forever()
     netbus_port = int(os.environ.get("PIXIE_TPU_NETBUS_PORT", "6100"))
     server = BusServer(bus, host="0.0.0.0", port=netbus_port)
@@ -70,6 +98,9 @@ def run_broker() -> int:
             "agents": tracker.agents_info(),
             "tables": sorted(tracker.schemas()),
             "quarantined": tracker.quarantined(),
+            # HA role/epoch/lease-age/replay-lag on every replica's
+            # /debug/statusz (leader AND standbys serve obs).
+            **({"ha": replica.statusz()} if replica is not None else {}),
             **statusz_extra(),
         },
         # Broker-side distributed-query traces (dispatch/retry/failover

@@ -10,10 +10,12 @@ where the reference streams protobuf over gRPC.
 """
 
 from .agent import Agent, KelvinAgent, PEMAgent
+from .broker_ha import BrokerReplica
 from .faults import FaultInjector
 from .msgbus import BusTimeout, MessageBus
 from .query_broker import (
     AgentLost,
+    QueryAbandoned,
     QueryBroker,
     QueryResultForwarder,
     QueryTimeout,
@@ -29,12 +31,14 @@ __all__ = [
     "Agent",
     "AgentLost",
     "AgentTracker",
+    "BrokerReplica",
     "BusTimeout",
     "ClusterTraceView",
     "FaultInjector",
     "KelvinAgent",
     "MessageBus",
     "PEMAgent",
+    "QueryAbandoned",
     "QueryBroker",
     "QueryResultForwarder",
     "QueryTimeout",

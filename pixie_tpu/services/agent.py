@@ -90,6 +90,11 @@ class Agent:
         # Live queries (StreamResults analog): qid -> merge state for the
         # Kelvin half {plan, expect, latest {(bid, agent): payload}, seq}.
         self._streaming_merges: dict = {}
+        # Broker-HA epoch fence: the highest dispatch epoch seen. A
+        # dispatch stamped BELOW it comes from a deposed leader and is
+        # rejected (no ack, no execution); unstamped dispatches (epoch
+        # 0, plain single-broker deployments) always pass.
+        self._max_epoch = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Agent":
@@ -114,6 +119,10 @@ class Agent:
                 f"agent.{a}.merge_update", self._on_merge_update
             ),
             self.bus.subscribe("query.cancel", self._on_cancel),
+            # Broker-HA takeover probe: a freshly elected leader asks
+            # every agent which query fragments are still live here so
+            # it can rebuild forwarder expectations (broker_ha.py).
+            self.bus.subscribe("broker.reconcile", self._on_reconcile),
         ]
         # Dispatch acks ride a DEDICATED subscription per fragment kind:
         # each subscription has its own dispatcher thread, so receipt is
@@ -355,13 +364,74 @@ class Agent:
         if ev is not None:
             ev.set()
 
+    def _on_reconcile(self, msg: dict) -> None:
+        """Answer a new leader's takeover probe (broker HA): which query
+        fragments are still live HERE — running fragments/merges and,
+        for a pending merge, the data agents whose bridge payloads it
+        still expects. The successor rebuilds forwarder expectations
+        for the deposed leader's in-flight queries from these answers
+        (services/broker_ha.py)."""
+        self._epoch_ok(msg)  # the probe carries the new epoch: fence up
+        reply_to = msg.get("_reply_to") or msg.get("reply_to")
+        if not reply_to:
+            return
+        with self._lock:
+            running = sorted(self._running)
+            merges = {}
+            for qid, pm in self._pending_merges.items():
+                exp = pm.get("expect")
+                got = pm.get("got_keys") or set()
+                if exp is None:
+                    # Bridges backlogged before the merge install: the
+                    # query is live but its expectations unknown yet.
+                    merges[qid] = []
+                    continue
+                merges[qid] = sorted(
+                    {a for (_b, a) in exp if (_b, a) not in got}
+                )
+            streaming = sorted(self._streaming_merges)
+        self.bus.publish(reply_to, {
+            "agent": self.agent_id,
+            "running": running,
+            "pending_merges": merges,
+            "streaming": streaming,
+        })
+
+    def _epoch_ok(self, msg: dict) -> bool:
+        """Broker-HA epoch fence. A message stamped with an epoch BELOW
+        the highest this agent has seen comes from a deposed leader:
+        reject it (no ack — the sender's retry loop gives up — and no
+        execution). Higher stamps raise the fence; unstamped messages
+        (epoch 0) always pass, so plain single-broker deployments are
+        unaffected."""
+        epoch = int(msg.get("epoch", 0) or 0)
+        with self._lock:
+            if epoch > self._max_epoch:
+                self._max_epoch = epoch
+                return True
+            fenced = 0 < epoch < self._max_epoch
+        if fenced:
+            from .observability import default_counter
+
+            default_counter(
+                "pixie_epoch_fenced_total",
+                "Messages rejected as stamped by a deposed broker leader",
+            ).inc()
+            return False
+        return True
+
     def _ack_receipt(self, msg: dict, kind: str) -> None:
         """Ack a fragment dispatch on ``query.{qid}.ack`` — every
         receipt, including retried/duplicated copies (the first ack may
-        be the message that was lost)."""
+        be the message that was lost). Deposed-leader dispatches are
+        never acked: withholding the ack is what makes the old leader's
+        retry loop give up (epoch fencing, broker HA)."""
+        if not self._epoch_ok(msg):
+            return
         self.bus.publish(
             f"query.{msg['qid']}.ack",
-            {"ack": kind, "agent": self.agent_id},
+            {"ack": kind, "agent": self.agent_id,
+             "epoch": int(msg.get("epoch", 0) or 0)},
         )
 
     def _dedup_dispatch_locked(self, qid: str, kind: str) -> bool:
@@ -406,7 +476,7 @@ class Agent:
     def _on_execute(self, msg):
         """Run a data fragment; ship bridge payloads to the merge agent."""
         qid, plan = msg["qid"], msg["plan"]
-        if self._dedup_dispatch(qid, "execute"):
+        if not self._epoch_ok(msg) or self._dedup_dispatch(qid, "execute"):
             return
         import threading as _threading
 
@@ -494,6 +564,8 @@ class Agent:
     def _on_merge(self, msg):
         """Install a merge fragment; runs once all bridge payloads land."""
         qid = msg["qid"]
+        if not self._epoch_ok(msg):
+            return
         with self._lock:
             # Dedup marking and record install must be ONE critical
             # section: _on_bridge/_on_merge_update read "(qid, merge)
@@ -683,7 +755,9 @@ class Agent:
         from ..exec.streaming import StreamingQuery
 
         qid, plan = msg["qid"], msg["plan"]
-        if self._dedup_dispatch(qid, "stream_execute"):
+        if not self._epoch_ok(msg) or self._dedup_dispatch(
+            qid, "stream_execute"
+        ):
             return
         merge_agent = msg.get("merge_agent")
         interval = float(msg.get("poll_interval_s", 0.25))
@@ -766,7 +840,9 @@ class Agent:
         re-merge into an updated result (incremental view maintenance —
         the reference re-runs live views from scratch on every poll)."""
         qid = msg["qid"]
-        if self._dedup_dispatch(qid, "stream_merge"):
+        if not self._epoch_ok(msg) or self._dedup_dispatch(
+            qid, "stream_merge"
+        ):
             return
         with self._lock:
             if qid in self._cancelled:

@@ -22,6 +22,9 @@ Faults:
   the agent and force-expire it from the tracker
 - ``sever(pattern, remote_bus)``  convenience trigger: hard-cut a
   netbus connection (mid-flight partition)
+- ``partition(pattern_a, pattern_b)`` / ``heal()``  bidirectional drop
+  of traffic crossing two peer sets (agent-id patterns; ``"broker"``
+  names the control-plane side)
 
 All rules support ``prob`` (applied via the seeded RNG), ``count``
 (max applications), ``after`` (skip the first N matches) and ``where``
@@ -36,6 +39,27 @@ import fnmatch
 import random
 import threading
 from typing import Callable
+
+
+def _peer_of_topic(topic: str) -> str:
+    """Destination peer of a topic: ``agent.{id}.{kind}`` names the
+    agent; everything else (registration, query.*, leases, inboxes)
+    terminates at the control plane — ``"broker"``."""
+    parts = topic.split(".")
+    if parts[0] == "agent" and len(parts) >= 3:
+        return parts[1]
+    return "broker"
+
+
+def _peer_of_msg(msg: dict) -> str:
+    """Origin peer of a message, from its agent-id fields; messages
+    carrying none (dispatches, probes, client requests) originate at
+    the control plane — ``"broker"``."""
+    for k in ("from_agent", "agent", "agent_id"):
+        v = msg.get(k)
+        if v:
+            return str(v)
+    return "broker"
 
 
 class _Rule:
@@ -57,7 +81,7 @@ class _Rule:
         where: Callable | None = None,
     ):
         self.pattern = pattern
-        self.action = action  # "drop" | "delay" | "duplicate" | "call"
+        self.action = action  # "drop"|"delay"|"duplicate"|"call"|"partition"
         self.prob = prob
         self.count = count  # max applications; None = unlimited
         self.delay_s = delay_s
@@ -82,6 +106,7 @@ class FaultInjector:
         self.seed = seed
         self.rng = random.Random(seed)
         self._rules: list[_Rule] = []
+        self._partition_rules: list[_Rule] = []
         self._lock = threading.Lock()
         self.log: list[tuple[str, str]] = []
 
@@ -144,6 +169,51 @@ class FaultInjector:
             where=where,
         )
 
+    def partition(self, pattern_a: str, pattern_b: str, *,
+                  prob: float = 1.0,
+                  count: int | None = None) -> "FaultInjector":
+        """Bidirectional sever of two peer sets until :meth:`heal`.
+
+        Peers are named by fnmatch patterns over agent ids; the id
+        ``"broker"`` stands for the control-plane side (tracker, broker,
+        forwarder — any participant that is not an ``agent.{id}.*``
+        endpoint). A message is dropped when its origin peer matches one
+        side and its destination peer matches the other, in EITHER
+        direction; intra-set traffic flows. Granularity is the bus's:
+        origin comes from the message's agent-id fields
+        (``from_agent``/``agent``/``agent_id``), destination from an
+        ``agent.{id}.*`` topic — fan-out topics without a single
+        destination (``query.cancel``, leases) count as broker-side.
+        """
+
+        def _crosses(topic: str, msg: dict) -> bool:
+            src = _peer_of_msg(msg)
+            dst = _peer_of_topic(topic)
+            a_src = fnmatch.fnmatchcase(src, pattern_a)
+            b_src = fnmatch.fnmatchcase(src, pattern_b)
+            a_dst = fnmatch.fnmatchcase(dst, pattern_a)
+            b_dst = fnmatch.fnmatchcase(dst, pattern_b)
+            return (a_src and b_dst) or (b_src and a_dst)
+
+        rule = _Rule("*", "partition", prob=prob, count=count, fn=_crosses)
+        with self._lock:
+            self._rules.append(rule)
+            self._partition_rules.append(rule)
+        return self
+
+    def heal(self) -> int:
+        """Remove every :meth:`partition` rule (both directions of every
+        cut); all other rules stay. Returns how many cuts were healed."""
+        with self._lock:
+            for r in self._partition_rules:
+                try:
+                    self._rules.remove(r)
+                except ValueError:
+                    pass
+            healed = len(self._partition_rules)
+            self._partition_rules = []
+        return healed
+
     # -- the bus hook --------------------------------------------------------
     def intercept(self, topic: str, msg: dict) -> list:
         """Delivery plan for one publish: a list of per-copy delays in
@@ -158,6 +228,8 @@ class FaultInjector:
                     continue
                 if r.where is not None and not r.where(msg):
                     continue
+                if r.action == "partition" and not r.fn(topic, msg):
+                    continue  # not a cut-crossing message
                 r.matched += 1
                 if r.matched <= r.after:
                     continue
@@ -167,7 +239,7 @@ class FaultInjector:
                     continue
                 r.fired += 1
                 self.log.append((r.action, topic))
-                if r.action == "drop":
+                if r.action in ("drop", "partition"):
                     plan = []
                 elif r.action == "delay":
                     plan = [d + r.delay_s for d in plan]

@@ -450,6 +450,10 @@ class RemoteBus:
                  token: str | None = None):
         # Wire accounting peer label: the broker endpoint this client
         # dialed (config-bounded cardinality — one broker per deploy).
+        # host/port kept separately so HA clients can re-dial the same
+        # (or a failover) endpoint after a broker death (api.Client).
+        self.host = host
+        self.port = port
         self.peer = f"{host}:{port}"
         self.stats: BusStats | None = (
             BusStats() if get_flag("bus_telemetry") else None

@@ -62,10 +62,17 @@ class AgentTracker:
         flap_threshold: int | None = None,
         flap_window_s: float | None = None,
         quarantine_s: float | None = None,
+        passive: bool = False,
     ):
         from ..config import get_flag
 
         self.bus = bus
+        # Passive (standby-mirror) mode, broker HA: observe the
+        # register/heartbeat stream and keep the live-agent map warm,
+        # but publish NOTHING — the leader's tracker owns registration
+        # acks, re-register nudges, expiry/quarantine events, and the
+        # mds.agent_status reply. activate() flips this on takeover.
+        self.passive = bool(passive)
         self.expiry_s = expiry_s
         self.check_interval_s = check_interval_s
         # Flap detection: an agent expiring `flap_threshold` times within
@@ -118,7 +125,8 @@ class AgentTracker:
             )
             rec.bus = list(msg.get("bus") or [])
             self._agents[agent_id] = rec
-        self.bus.publish(f"agent.{agent_id}.registered", {"asid": asid})
+        if not self.passive:
+            self.bus.publish(f"agent.{agent_id}.registered", {"asid": asid})
 
     def _on_heartbeat(self, msg: dict):
         agent_id = msg["agent_id"]
@@ -128,7 +136,8 @@ class AgentTracker:
                 # Unknown agent (e.g. expired): tell it to re-register —
                 # the reference's heartbeat-NACK resync path
                 # (``manager.h:207`` re-register hook).
-                self.bus.publish(f"agent.{agent_id}.reregister", {})
+                if not self.passive:
+                    self.bus.publish(f"agent.{agent_id}.reregister", {})
                 return
             rec.last_heartbeat = time.monotonic()
             if "table_stats" in msg:
@@ -175,7 +184,15 @@ class AgentTracker:
     def _on_agent_status_request(self, msg: dict):
         """MDS stub service for the GetAgentStatus UDTF
         (``md_udtfs_impl.h:258`` hits MDS the same way)."""
+        if self.passive:
+            return  # the leader's tracker answers
         self.bus.publish(msg["_reply_to"], {"agents": self.agents_info()})
+
+    def activate(self) -> None:
+        """Leave passive (standby-mirror) mode: this tracker now OWNS
+        the agent lifecycle — registration acks, re-register nudges,
+        expiry/quarantine events, status replies (broker-HA takeover)."""
+        self.passive = False
 
     # -- expiry --------------------------------------------------------------
     def _expiry_loop(self):
@@ -240,6 +257,8 @@ class AgentTracker:
             for aid, until in list(self._quarantine_until.items()):
                 if until <= now:
                     del self._quarantine_until[aid]
+        if self.passive:
+            return  # mirror bookkeeping only; the leader emits events
         self.bus.publish(TOPIC_EXPIRED, {"agent_id": agent_id,
                                          "reason": reason})
         if quarantined:

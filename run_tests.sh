@@ -120,6 +120,21 @@
 #                                      failing on any mismatch vs the
 #                                      numpy reference join (see
 #                                      tools/bench_join.py).
+#   ./run_tests.sh --soak              chaos-soak gate: a fixed-seed
+#                                      32-agent / 2-broker soak driving
+#                                      faults x tenancy x concurrency x
+#                                      a leader-broker kill together
+#                                      (pixie_tpu/services/chaos.py;
+#                                      see docs/RESILIENCE.md "Broker
+#                                      HA"). Exit 0 iff zero lost
+#                                      queries, zero leaked threads, a
+#                                      failover was observed, and the
+#                                      victim tenant's p99 held its
+#                                      isolation bound. Also runs
+#                                      inside --tier1.
+#   ./run_tests.sh --soak-full         the long soak: 128 agents, 3
+#                                      brokers, 3x offered load. NOT
+#                                      part of --tier1 (wall-clock).
 case "$1" in
   --obs)
     shift
@@ -175,6 +190,18 @@ case "$1" in
     shift
     exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python tools/bench_join.py "$@"
+    ;;
+  --soak)
+    shift
+    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pixie_tpu.services.chaos \
+      --agents 32 --brokers 2 --seed 0 "$@"
+    ;;
+  --soak-full)
+    shift
+    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pixie_tpu.services.chaos \
+      --agents 128 --brokers 3 --seed 0 --full "$@"
     ;;
   --bounds)
     shift
@@ -238,6 +265,10 @@ case "$1" in
     # excluded from the 'not slow' sweep below, so run the tenancy
     # suite explicitly here.
     "$0" --tenancy || rc_analyze=1
+    # Chaos-soak gate (broker HA): fixed-seed 32-agent/2-broker soak
+    # with a leader kill — zero lost queries, zero leaked threads,
+    # isolation bound held while faults are active.
+    "$0" --soak || rc_analyze=1
     # ROADMAP.md "Tier-1 verify", verbatim:
     set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); [ $rc -eq 0 ] && rc=$rc_analyze; exit $rc
     ;;

@@ -1,0 +1,176 @@
+"""Chaos soak harness: faults x tenancy x staleness x concurrency x
+broker-kill in one run, with a machine-checkable report.
+
+The full 32-agent/2-broker configuration is the ``run_tests.sh --soak``
+gate (tier 1); here an 8-agent soak keeps the same contract checkable
+inside the normal suite, plus unit coverage for the harness pieces
+(ledger bookkeeping, the failover-retrying executor, report gating).
+"""
+
+import threading
+import time
+
+import pytest
+
+from pixie_tpu.services.chaos import (
+    _Ledger,
+    ChaosReport,
+    failover_executor,
+    run_chaos_soak,
+)
+from pixie_tpu.services.msgbus import BusTimeout
+
+
+class TestLedger:
+    def test_records_outcomes_and_lost_details(self):
+        led = _Ledger()
+        led.record("ok")
+        led.record("partial")
+        led.record("lost", "AgentLost: merge agent vanished" + "x" * 400)
+        snap = led.snapshot()
+        assert snap["submitted"] == 3
+        assert snap["outcomes"] == {"ok": 1, "partial": 1, "lost": 1}
+        assert len(snap["lost"]) == 1
+        assert len(snap["lost"][0]) <= 200  # truncated, not unbounded
+
+    def test_thread_safe_under_concurrent_records(self):
+        led = _Ledger()
+        ts = [
+            threading.Thread(
+                target=lambda: [led.record("ok") for _ in range(200)]
+            )
+            for _ in range(4)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert led.snapshot()["submitted"] == 800
+
+
+class TestFailoverExecutor:
+    class _Bus:
+        def __init__(self, script):
+            self.script = list(script)
+            self.calls = 0
+
+        def request(self, topic, msg, timeout_s=10.0):
+            self.calls += 1
+            step = self.script.pop(0)
+            if isinstance(step, Exception):
+                raise step
+            return step
+
+    def test_retries_through_failover_window(self):
+        led = _Ledger()
+        bus = self._Bus([
+            BusTimeout("no responder on 'broker.execute'"),
+            BusTimeout("no responder on 'broker.execute'"),
+            {"ok": True, "partial": False, "tables": {}},
+        ])
+        ex = failover_executor(bus, led, backoff_s=0.01)
+        res = ex("import px", 5.0)
+        assert res["ok"] and bus.calls == 3
+        snap = led.snapshot()
+        assert snap["failover_retries"] == 2
+        assert snap["outcomes"] == {"ok": 1}
+        assert snap["lost"] == []
+
+    def test_exhausted_retries_are_lost(self):
+        led = _Ledger()
+        bus = self._Bus([BusTimeout("down")] * 3)
+        ex = failover_executor(bus, led, max_attempts=3, backoff_s=0.01)
+        with pytest.raises(BusTimeout):
+            ex("import px", 5.0)
+        snap = led.snapshot()
+        assert snap["outcomes"] == {"lost": 1}
+        assert "no broker answered" in snap["lost"][0]
+
+    def test_structured_refusal_is_not_lost(self):
+        led = _Ledger()
+        bus = self._Bus([
+            {"ok": False, "error": "AdmissionError: admission-shed "
+                                   "(queue past deadline)"},
+        ])
+        ex = failover_executor(bus, led)
+        with pytest.raises(RuntimeError):
+            ex("import px", 5.0)
+        assert led.snapshot()["outcomes"] == {"refused": 1}
+
+    def test_real_error_is_lost(self):
+        led = _Ledger()
+        bus = self._Bus([
+            {"ok": False, "error": "AgentLost: kelvin-0 un-acked"},
+        ])
+        ex = failover_executor(bus, led)
+        with pytest.raises(RuntimeError):
+            ex("import px", 5.0)
+        snap = led.snapshot()
+        assert snap["outcomes"] == {"lost": 1}
+        assert "AgentLost" in snap["lost"][0]
+
+    def test_partial_counts_as_partial(self):
+        led = _Ledger()
+        bus = self._Bus([{"ok": True, "partial": True, "tables": {}}])
+        ex = failover_executor(bus, led)
+        assert ex("import px", 5.0)["partial"] is True
+        assert led.snapshot()["outcomes"] == {"partial": 1}
+
+
+class TestChaosReport:
+    def test_ok_requires_all_gates(self):
+        r = ChaosReport(leader_kills=1, failovers=1)
+        assert r.ok
+        assert ChaosReport(lost=["x"]).ok is False
+        assert ChaosReport(thread_leak=True).ok is False
+        assert ChaosReport(isolation_ok=False).ok is False
+        # A leader kill with NO observed failover means the cluster
+        # never recovered — the soak must fail even if no query died.
+        assert ChaosReport(leader_kills=1, failovers=0).ok is False
+
+    def test_to_dict_round_trips_gates(self):
+        d = ChaosReport(leader_kills=1, failovers=1, wall_s=1.234).to_dict()
+        assert d["ok"] is True and d["wall_s"] == 1.23
+        for key in ("ledger", "lost", "faults_fired", "streams",
+                    "victim_p99_ms", "victim_p99_bound_ms"):
+            assert key in d
+
+
+class TestSmallSoak:
+    def test_eight_agent_soak_holds_the_contract(self):
+        """Scaled-down soak inside the normal suite: faults + tenancy +
+        leader kill on 8 agents / 2 brokers. Same gates as --soak:
+        zero lost, zero thread leak, failover observed, isolation
+        bound held."""
+        report = run_chaos_soak(
+            n_agents=8, n_brokers=2, seed=0, rows=200,
+            per_worker=2, noisy_workers=1, timeout_s=20.0,
+        )
+        d = report.to_dict()
+        assert report.lost == [], d
+        assert not report.thread_leak, d
+        assert report.leader_kills == 1 and report.failovers >= 1, d
+        assert report.isolation_ok, d
+        assert report.agent_kills == 1 and report.partitions_healed == 1, d
+        assert report.ledger["submitted"] > 0
+        resolved = sum(report.ledger["outcomes"].values())
+        assert resolved == report.ledger["submitted"]
+        assert report.faults_fired > 0, "chaos ran but injected nothing"
+
+    def test_soak_without_leader_kill(self):
+        """kill_leader=False: the faults-only soak must also pass, and
+        must NOT claim a failover it never exercised."""
+        report = run_chaos_soak(
+            n_agents=6, n_brokers=2, seed=1, rows=200,
+            per_worker=2, noisy_workers=1, kill_leader=False,
+        )
+        assert report.leader_kills == 0
+        assert report.ok, report.to_dict()
+
+
+@pytest.mark.slow
+class TestSoakGate:
+    def test_thirty_two_agent_soak(self):
+        """The full --soak tier-1 gate configuration."""
+        report = run_chaos_soak(n_agents=32, n_brokers=2, seed=0)
+        assert report.ok, report.to_dict()

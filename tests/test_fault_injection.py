@@ -1006,3 +1006,208 @@ class TestLoadUnderFaults:
         assert strict.errors == 2
         assert strict.failure_rate == 1.0
         assert strict.errors_by_type == {"AgentLost": 2}
+
+
+class TestPartitionDeterminism:
+    """Satellite: FaultInjector.partition/heal — bidirectional peer-set
+    cuts with the same fixed-seed replay contract as every other rule."""
+
+    def _run(self, seed, prob):
+        inj = FaultInjector(seed=seed)
+        inj.partition("pem-*", "broker", prob=prob)
+        bus = MessageBus()
+        bus.fault_injector = inj
+        got = {"to_agent": [], "to_broker": [], "intra": []}
+        bus.subscribe("agent.pem-1.execute", got["to_agent"].append)
+        bus.subscribe("agent.register", got["to_broker"].append)
+        bus.subscribe("agent.pem-2.bridge", got["intra"].append)
+        for i in range(32):
+            # broker -> pem-1: crosses the cut.
+            bus.publish("agent.pem-1.execute", {"qid": f"q{i}", "i": i})
+            # pem-1 -> broker: crosses the cut (other direction).
+            bus.publish("agent.register", {"agent_id": "pem-1", "i": i})
+            # pem-1 -> pem-2: BOTH on the agent side — must always flow.
+            bus.publish(
+                "agent.pem-2.bridge", {"from_agent": "pem-1", "i": i}
+            )
+        deadline = time.time() + 3
+        while time.time() < deadline and len(got["intra"]) < 32:
+            time.sleep(0.01)
+        log = list(inj.log)
+        fired = inj.fired("partition")
+        bus.close()
+        return (
+            log, fired,
+            sorted(m["i"] for m in got["to_agent"]),
+            sorted(m["i"] for m in got["to_broker"]),
+            sorted(m["i"] for m in got["intra"]),
+        )
+
+    def test_same_seed_replays_identically(self):
+        a = self._run(SEED, prob=0.5)
+        b = self._run(SEED, prob=0.5)
+        assert a == b
+        log, fired, to_agent, to_broker, intra = a
+        # prob=0.5: some crossing messages dropped, some delivered.
+        assert 0 < fired < 64
+        assert len(to_agent) < 32 or len(to_broker) < 32
+        # Intra-set traffic is never a casualty of the cut.
+        assert intra == list(range(32))
+
+    def test_full_cut_and_heal(self):
+        inj = FaultInjector(seed=SEED)
+        inj.partition("pem-*", "broker")
+        bus = MessageBus()
+        bus.fault_injector = inj
+        got = []
+        bus.subscribe("agent.pem-0.execute", got.append)
+        bus.publish("agent.pem-0.execute", {"i": 0})
+        time.sleep(0.2)
+        assert got == []  # hard cut: nothing crosses
+        assert inj.heal() == 1
+        bus.publish("agent.pem-0.execute", {"i": 1})
+        deadline = time.time() + 3
+        while time.time() < deadline and not got:
+            time.sleep(0.01)
+        assert [m["i"] for m in got] == [1]
+        # heal() is idempotent and leaves non-partition rules alone.
+        inj.drop("agent.pem-0.execute", count=1)
+        assert inj.heal() == 0
+        bus.publish("agent.pem-0.execute", {"i": 2})
+        time.sleep(0.2)
+        assert [m["i"] for m in got] == [1]  # the drop rule survived
+        bus.close()
+
+    def test_heal_removes_both_directions_of_every_cut(self):
+        inj = FaultInjector(seed=SEED)
+        inj.partition("pem-a", "broker")
+        inj.partition("pem-b", "broker")
+        assert inj.heal() == 2
+        assert inj.heal() == 0
+
+
+class TestQuarantineCooldownRecovery:
+    """Satellite: the full flap -> quarantine -> cooldown -> re-register
+    lifecycle, end-to-end through query execution on BOTH transports —
+    the agent must land back in the dispatch set and the result cache
+    must not serve the quarantine-era (2-shard) answer."""
+
+    def _lifecycle(self, execute, bus, tracker, pems):
+        # Healthy: all 3 data shards answer, and the repeat is a hit.
+        res = execute()
+        assert set(res["agent_stats"]) == {"pem-0", "pem-1", "pem-2"}
+        want_all = _count_truth(pems, [0, 1, 2])
+        assert _total_n(res) == want_all
+        assert execute().get("cache") == "hit"
+        # Flap pem-2 past the threshold: quarantined out of planning.
+        for _ in range(2):
+            tracker.force_expire("pem-2", reason="flap")
+            bus.publish(
+                "agent.register",
+                {"agent_id": "pem-2", "processes_data": True,
+                 "schemas": pems[2]._schemas()},
+            )
+            deadline = time.time() + 5
+            while (
+                time.time() < deadline
+                and "pem-2" not in tracker.agent_ids()
+            ):
+                time.sleep(0.01)
+        assert tracker.is_quarantined("pem-2")
+        res = execute()
+        assert set(res["agent_stats"]) == {"pem-0", "pem-1"}
+        assert _total_n(res) == _count_truth(pems, [0, 1])
+        # Cooldown passes; the agent re-registers and is dispatchable.
+        deadline = time.time() + 5
+        while time.time() < deadline and tracker.is_quarantined("pem-2"):
+            time.sleep(0.02)
+        assert not tracker.is_quarantined("pem-2")
+        bus.publish(
+            "agent.register",
+            {"agent_id": "pem-2", "processes_data": True,
+             "schemas": pems[2]._schemas()},
+        )
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            "pem-2" not in [
+                a.agent_id for a in tracker.distributed_state().agents
+            ]
+        ):
+            time.sleep(0.02)
+        res = execute()
+        assert set(res["agent_stats"]) == {"pem-0", "pem-1", "pem-2"}
+        assert _total_n(res) == want_all, (
+            "stale quarantine-era cached result served after recovery"
+        )
+        assert res.get("cache") != "hit"
+
+    def _mk_flappy_cluster(self):
+        bus = MessageBus()
+        tracker = AgentTracker(
+            bus, expiry_s=60.0, check_interval_s=60.0,
+            flap_threshold=2, flap_window_s=60.0, quarantine_s=0.4,
+        )
+        pems = [
+            PEMAgent(bus, f"pem-{i}", **FAST).start() for i in range(3)
+        ]
+        kelvin = KelvinAgent(bus, "kelvin-0", **FAST).start()
+        rng = np.random.default_rng(SEED)
+        for i, pem in enumerate(pems):
+            n = 300 + 50 * i
+            pem.append_data("http_events", {
+                "time_": np.arange(n, dtype=np.int64),
+                "latency_ns": rng.integers(1000, 1_000_000, n),
+                "resp_status": rng.choice(np.array([200, 404, 500]), n),
+                "service": [f"svc-{(i + j) % 3}" for j in range(n)],
+            })
+            pem._register()
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            len(tracker.agent_ids()) < 4
+            or "http_events" not in tracker.schemas()
+        ):
+            time.sleep(0.01)
+        broker = QueryBroker(bus, tracker)
+        return bus, tracker, pems, kelvin, broker
+
+    def _teardown(self, bus, tracker, pems, kelvin, broker):
+        for a in pems + [kelvin]:
+            a.stop()
+        broker.close()
+        tracker.close()
+        bus.close()
+
+    def test_recovery_in_process(self):
+        bus, tracker, pems, kelvin, broker = self._mk_flappy_cluster()
+        try:
+            def execute():
+                return broker.execute_script(AGG_Q, timeout_s=20.0)
+
+            with override_flag("result_cache_mb", 64):
+                self._lifecycle(execute, bus, tracker, pems)
+        finally:
+            self._teardown(bus, tracker, pems, kelvin, broker)
+
+    def test_recovery_over_netbus(self):
+        from pixie_tpu.services.netbus import BusServer, RemoteBus
+
+        bus, tracker, pems, kelvin, broker = self._mk_flappy_cluster()
+        broker.serve()
+        server = BusServer(bus)
+        rb = RemoteBus("127.0.0.1", server.port)
+        try:
+            def execute():
+                res = rb.request(
+                    "broker.execute",
+                    {"query": AGG_Q, "timeout_s": 20.0},
+                    timeout_s=25.0,
+                )
+                assert res["ok"], res
+                return res
+
+            with override_flag("result_cache_mb", 64):
+                self._lifecycle(execute, bus, tracker, pems)
+        finally:
+            rb.close()
+            server.close()
+            self._teardown(bus, tracker, pems, kelvin, broker)
