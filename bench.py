@@ -66,6 +66,10 @@ SHAPE_DEFS = {
     # repeatedly over a growing replay — cold rescan vs watermark-
     # validated cache hit vs incremental materialized-view fold.
     "dashboard_repeat": ("_shape_dashboard_repeat", 2),
+    # Storage-tier shape (ISSUE 20): selective + full scans over a
+    # mostly-cold table — zone-map skipping before decode, decode-on-
+    # stage overlap, tier on/off x skip on/off A/B.
+    "cold_scan": ("_shape_cold_scan", 4),
 }
 ALL_SHAPES = tuple(SHAPE_DEFS)
 
@@ -1168,6 +1172,185 @@ px.display(out)
         "rows": 2 * n, "rows_per_sec": round(rps), "secs": round(dt, 3),
         "vs_baseline": round(rps / ((2 * n) / base_dt), 3), "checked": True,
     }), eng)
+
+
+def _shape_cold_scan(n, window):
+    """ISSUE 20 (pxtier): scans over a MOSTLY-COLD table — the hot ring
+    holds ~1/8 of the replay, the rest was demoted into the encoded cold
+    store at append time. Two scans, four A/B arms:
+
+    - selective: ``shard == k`` where shard ascends with time (each
+      window holds ONE shard value), so zone maps prove every other
+      window can't match and the scan skips it BEFORE decode. Run on
+      the tiered and an all-hot engine, with zone skipping on and off
+      (2x2); all four arms must be bit-identical, and the tiered+skip
+      arm must skip >= 90% of windows.
+    - full: group-by over every row, host-staged (device residency off
+      so every cold window really decodes — resident windows would be
+      served from HBM). The tiered wall must stay within 1.5x the
+      all-hot wall; ``decode_ms`` vs ``stall_ms`` reports how much of
+      the decode the prefetch pipeline hid.
+
+    The headline rows/s is the full tiered scan (decode included); the
+    numpy replay checks both results bit-exactly.
+    """
+    from pixie_tpu.exec.engine import Engine
+    from pixie_tpu.types.dtypes import DataType
+    from pixie_tpu.types.relation import Relation
+    from pixie_tpu.types.strings import StringDictionary
+
+    # Many windows (skip-rate resolution) and whole windows only (keeps
+    # window k <-> shard k exact).
+    window = max(min(window, n // 64), 1024)
+    n = max((n // window) * window, window)
+    n_win = n // window
+    rng = np.random.default_rng(31)
+    services = [f"svc-{i}" for i in range(16)]
+    dicts = {"service": StringDictionary(services)}
+    rel = Relation([
+        ("time_", DataType.TIME64NS),
+        ("shard", DataType.INT64),
+        ("latency_ns", DataType.INT64),
+        ("service", DataType.STRING),
+    ])
+    # shard ascends with time (live-telemetry clustering): window k
+    # holds exactly shard k.
+    shard = np.arange(n, dtype=np.int64) // window
+    lat = rng.integers(1_000, 100_000_000, n)
+    svc_codes = _codes(rng, n, len(services))
+
+    def cols(off, m):
+        s = slice(off, off + m)
+        return {
+            "time_": (np.arange(off, off + m, dtype=np.int64),),
+            "shard": (shard[s],),
+            "latency_ns": (lat[s],),
+            "service": (svc_codes[s],),
+        }
+
+    row_bytes = 8 + 8 + 8 + 4  # time + shard + latency + svc codes
+    hot_budget = max(row_bytes * n // 8, row_bytes * window + 1)
+    cold_mb = (row_bytes * n >> 20) + 64  # never evict: bit-identity
+
+    pick = n_win // 3
+    q_sel = f"""
+import px
+df = px.DataFrame(table='events')
+df = df[df.shard == {pick}]
+out = df.groupby('shard').agg(
+    n=('latency_ns', px.count), s=('latency_ns', px.sum))
+px.display(out)
+"""
+    q_full = """
+import px
+df = px.DataFrame(table='events')
+out = df.groupby('service').agg(
+    n=('latency_ns', px.count), s=('latency_ns', px.sum))
+px.display(out)
+"""
+
+    with _flag_override("cold_tier_mb", cold_mb):
+        cold_eng = Engine(window_rows=window)
+        cold_eng.create_table("events", max_bytes=hot_budget)
+        _push_encoded(cold_eng, "events", rel, cols, n, window, dicts)
+    hot_eng = Engine(window_rows=window)
+    hot_eng.create_table("events")
+    _push_encoded(hot_eng, "events", rel, cols, n, window, dicts)
+
+    st = cold_eng.tables["events"].stats()
+    cold_frac = st.cold_rows / max(st.cold_rows + st.hot_rows, 1)
+    assert cold_frac >= 0.75, f"replay not mostly cold ({cold_frac:.2f})"
+    compression = st.cold_raw_bytes / max(st.cold_bytes, 1)
+
+    def timed(eng, q, repeats=3):
+        out = eng.execute_query(q, materialize=False)  # warm/compile
+        for v in out.values():
+            if hasattr(v, "block_until_ready"):
+                v.block_until_ready()
+        best = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = eng.execute_query(q)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return out, best, eng.tracer.last().usage
+
+    # 2x2 A/B arms for the selective scan: tier x zone skipping.
+    arms, outs = {}, {}
+    for tier_label, eng in (("cold", cold_eng), ("hot", hot_eng)):
+        for skip_label, flag in (("skip", True), ("noskip", False)):
+            with _flag_override("scan_zone_skip", flag):
+                out, dt, u = timed(eng, q_sel, repeats=2)
+            outs[f"{tier_label}_{skip_label}"] = out
+            arms[f"{tier_label}_{skip_label}"] = {
+                "secs": round(dt, 4),
+                "skipped_windows": int(u.skipped_windows),
+                "decode_ms": round(u.decode_ms, 3),
+            }
+    for k in ("cold_noskip", "hot_skip", "hot_noskip"):
+        assert _host_equal(outs["cold_skip"], outs[k]), f"A/B drift: {k}"
+    skip_rate = arms["cold_skip"]["skipped_windows"] / n_win
+    assert skip_rate >= 0.9, f"skip rate {skip_rate:.2f} < 0.9"
+
+    # Full scan, host-staged: every cold window decodes for real.
+    with _flag_override("device_residency", False):
+        full_cold, cold_s, u_cold = timed(cold_eng, q_full)
+        full_hot, hot_s, _ = timed(hot_eng, q_full)
+    assert _host_equal(full_cold, full_hot), "tiered full scan drifted"
+    assert cold_s <= 1.5 * hot_s, (
+        f"cold full scan {cold_s:.3f}s > 1.5x hot {hot_s:.3f}s"
+    )
+    decode_ms = float(u_cold.decode_ms)
+    stall_ms = float(u_cold.stall_ms)
+
+    # numpy replay (bit-exact: int64 counts/sums).
+    t0 = time.perf_counter()
+    msk = shard == pick
+    ref_n, ref_s = int(msk.sum()), int(lat[msk].sum())
+    cnt = np.bincount(svc_codes, minlength=len(services))
+    sums = np.bincount(
+        svc_codes, weights=lat.astype(np.float64), minlength=len(services)
+    )
+    base_dt = time.perf_counter() - t0
+    g = outs["cold_skip"]["output"].to_pydict()
+    assert int(g["shard"][0]) == pick and len(g["shard"]) == 1
+    assert int(g["n"][0]) == ref_n and int(g["s"][0]) == ref_s
+    gf = full_cold["output"].to_pydict(decode_strings=False)
+    order = np.argsort(gf["service"])
+    present = np.nonzero(cnt)[0]
+    assert np.array_equal(np.sort(gf["service"]), present)
+    np.testing.assert_array_equal(gf["n"][order], cnt[present])
+    np.testing.assert_allclose(gf["s"][order], sums[present], rtol=1e-12)
+
+    return {
+        "rows": n, "rows_per_sec": round(n / cold_s),
+        "secs": round(cold_s, 3), "checked": True,
+        "vs_baseline": round((n / cold_s) / (n / base_dt), 3),
+        "tier": {
+            "cold_frac": round(cold_frac, 3),
+            "compression": round(compression, 2),
+            "demotions": int(st.demotions),
+            "evictions": int(st.evictions),
+        },
+        "selective": dict(arms, **{
+            "skip_rate": round(skip_rate, 3),
+            "speedup_vs_noskip": round(
+                arms["cold_noskip"]["secs"]
+                / max(arms["cold_skip"]["secs"], 1e-9), 2),
+        }),
+        "full": {
+            "cold_secs": round(cold_s, 4),
+            "hot_secs": round(hot_s, 4),
+            "cold_vs_hot": round(cold_s / max(hot_s, 1e-9), 3),
+            "decode_ms": round(decode_ms, 2),
+            "stall_ms": round(stall_ms, 2),
+            # Fraction of decode wall the prefetch pipeline hid behind
+            # compute (decode runs on the producer thread).
+            "decode_hidden_frac": round(
+                max(0.0, 1.0 - stall_ms / decode_ms), 3
+            ) if decode_ms > 0 else 1.0,
+        },
+    }
 
 
 def inner() -> int:

@@ -78,6 +78,13 @@ SHAPE_SCHEMAS = {
         "conn_l": Relation([("time_", T), ("k", I), ("b", I)]),
         "conn_r": Relation([("time_", T), ("k", I), ("c", I), ("v", I)]),
     },
+    # Storage-tier shape (ISSUE 20): selective scan whose FilterOp
+    # drives zone-map window skipping over a mostly-cold table.
+    "cold_scan": {
+        "events": Relation([
+            ("time_", T), ("shard", I), ("latency_ns", I), ("service", S),
+        ]),
+    },
 }
 
 # bench.py's inline queries, verbatim (the shapes whose queries are not
@@ -88,6 +95,15 @@ l = px.DataFrame(table='conn_l')
 r = px.DataFrame(table='conn_r')
 g = l.merge(r, how='inner', left_on=['k'], right_on=['k'], suffixes=['', '_r'])
 out = g.groupby('b').agg(n=('v', px.count), s=('v', px.sum))
+px.display(out)
+"""
+
+_COLD_SCAN_QUERY = """
+import px
+df = px.DataFrame(table='events')
+df = df[df.shard == 7]
+out = df.groupby('shard').agg(
+    n=('latency_ns', px.count), s=('latency_ns', px.sum))
 px.display(out)
 """
 
@@ -106,6 +122,8 @@ def _shape_query(shape: str) -> str:
         return _DEVICE_JOIN_QUERY
     if shape in ("device_join_skew", "device_join_select"):
         return _JOIN_BOTH_SIDES_QUERY
+    if shape == "cold_scan":
+        return _COLD_SCAN_QUERY
     from ..scripts import load_script
 
     return load_script(f"px/{shape}").pxl

@@ -59,20 +59,44 @@ def _synth_column(dtype, n: int, rng, col: str):
     return [vocab[int(i)] for i in rng.integers(0, len(vocab), n)]
 
 
-def _replay_engine(schemas, rows: int = GATE_ROWS):
+def _replay_engine(schemas, rows: int = GATE_ROWS, tiered: bool = False):
     """A fresh Engine with ``rows`` synthetic rows per table pushed
     through the REAL append path (so ingest sketches exist and pxbound
-    sees what production would)."""
-    from ..exec.engine import Engine
+    sees what production would). ``tiered=True`` replays onto
+    byte-bounded tables with the cold tier on (docs/STORAGE.md) so
+    most windows demote — the cold-heavy regime the decode bound is
+    stated against."""
+    import contextlib
 
-    engine = Engine()
-    rng = np.random.default_rng(7)
-    for table, rel in schemas.items():
-        data = {
-            name: _synth_column(dt, rows, rng, name)
-            for name, dt in rel.items()
-        }
-        engine.append_data(table, data)
+    from ..config import override_flag
+    from ..exec.engine import Engine
+    from .bounds import _row_bytes
+
+    win = 256
+    ctx = (
+        override_flag("cold_tier_mb", 64)
+        if tiered else contextlib.nullcontext()
+    )
+    with ctx:
+        engine = Engine(window_rows=win) if tiered else Engine()
+        rng = np.random.default_rng(7)
+        for table, rel in schemas.items():
+            data = {
+                name: _synth_column(dt, rows, rng, name)
+                for name, dt in rel.items()
+            }
+            if not tiered:
+                engine.append_data(table, data)
+                continue
+            # Hot budget of ~1/4 the replay: ~3/4 of windows end cold.
+            engine.create_table(
+                table, relation=rel,
+                max_bytes=max((_row_bytes(rel) or 32) * rows // 4, win),
+            )
+            for lo in range(0, rows, win):
+                engine.append_data(table, {
+                    c: v[lo:lo + win] for c, v in data.items()
+                })
     return engine
 
 
@@ -156,6 +180,33 @@ def _check_one(name, engine, query, verbose) -> tuple[int, float, float]:
     return failures, (novel, warm_compile), (hit, cold)
 
 
+def _check_cold_decode(name, engine, verbose) -> int:
+    """Cold-heavy soundness (ISSUE 20): with most replay windows
+    demoted, observed decoded bytes must hold ``<= predicted
+    cold_decode_bytes_hi`` (zone-map skipping only lowers the
+    realized value — the bound assumes every cold window decodes)."""
+    tiers = [
+        t._tier for t in engine.tables.values()
+        if getattr(t, "_tier", None) is not None
+    ]
+    cold_rows = sum(t.table.stats().cold_rows for t in tiers)
+    if not tiers or not cold_rows:
+        print(f"[bounds] {name}: FAIL — tiered replay produced no cold "
+              "windows (gate is vacuous)", file=sys.stderr)
+        return 1
+    pred = engine.last_resource_report.cold_decode_bytes_hi
+    obs = sum(t.store.decoded_bytes for t in tiers)
+    if pred is None or obs > pred:
+        print(f"[bounds] {name}: FAIL — observed decoded bytes {obs} > "
+              f"predicted cold_decode_bytes_hi {pred}", file=sys.stderr)
+        return 1
+    if verbose:
+        print(f"[bounds] {name}: cold decode ok — {obs}/{pred} bytes "
+              f"(observed/predicted, {cold_rows} cold rows)",
+              file=sys.stderr)
+    return 0
+
+
 def _check_rejection(verbose: bool) -> int:
     """The admission half: an over-budget query must fail at COMPILE
     with a structured resource-bound Diagnostic (and never execute)."""
@@ -205,8 +256,11 @@ def check_bounds(verbose: bool = True) -> int:
     failures = 0
     compile_total = warm_total = hit_total = cold_total = 0.0
     for shape, schemas in SHAPE_SCHEMAS.items():
-        engine = _replay_engine(schemas)
+        tiered = shape == "cold_scan"
+        engine = _replay_engine(schemas, tiered=tiered)
         f, c, b = _check_one(shape, engine, _shape_query(shape), verbose)
+        if tiered:
+            f += _check_cold_decode(shape, engine, verbose)
         failures += f
         compile_total += c[0]
         warm_total += c[1]

@@ -60,6 +60,7 @@ audited (the soundness gate). See docs/ANALYSIS.md.
 
 from __future__ import annotations
 
+import math
 import threading
 from dataclasses import dataclass, field
 
@@ -126,6 +127,7 @@ class NodeBound:
     groups: int | None = None  # agg: NDV-derived group bound
     join_capacity: int | None = None  # join: estimated output capacity
     wire_bytes: int | None = None  # bridge sink: payload bound
+    cold_rows: int | None = None  # source: rows resident in the cold tier
     origin: str = "none"  # 'sketch' | 'derived' | 'none'
 
 
@@ -144,6 +146,11 @@ class PlanResourceReport:
     bytes_staged_hi: int | None = None
     wire_bytes_hi: int | None = None
     peak_node_bytes_hi: int | None = None
+    #: Upper bound on raw bytes that must be DECODED from the cold
+    #: storage tier to serve the scan (docs/STORAGE.md). Zone-map
+    #: window skipping only lowers the realized value. 0 for untiered
+    #: sources; None when a tiered source's rows are unbounded.
+    cold_decode_bytes_hi: int | None = None
     agg_groups: dict = field(default_factory=dict)  # nid -> group bound
     join_capacity: dict = field(default_factory=dict)  # nid -> capacity
     diagnostics: list = field(default_factory=list)
@@ -165,6 +172,7 @@ class PlanResourceReport:
             "rows_out_hi": self.rows_out_hi,
             "wire_bytes_hi": self.wire_bytes_hi,
             "peak_node_bytes_hi": self.peak_node_bytes_hi,
+            "cold_decode_bytes_hi": self.cold_decode_bytes_hi,
             "origin": self.origin,
             "safety": self.safety,
         }
@@ -261,9 +269,26 @@ def _node_bound(plan, nid, node, in_bounds, ctx, table_stats,
     if isinstance(op, MemorySourceOp):
         st = (table_stats or {}).get(op.table)
         rows = st.get("rows") if isinstance(st, dict) else None
+        tier = st.get("tier") if isinstance(st, dict) else None
+        cold_rows = None
+        if isinstance(tier, dict):
+            # Per-tier seeding from the table's freshness envelope
+            # (docs/STORAGE.md): the OBSERVED raw bytes/row of the
+            # resident data. Taken as a max with the schema-derived
+            # width so the staged-bytes bound never narrows below
+            # either; the cold row count seeds the decode-bytes bound.
+            obs = tier.get("raw_row_bytes")
+            if obs:
+                rb = max(rb or 0, int(math.ceil(obs)))
+            cr = tier.get("cold_rows")
+            if cr is not None:
+                cold_rows = int(cr)
         if rows is not None:
-            return NodeBound(Interval(0, int(rows)), rb, origin="sketch")
-        return NodeBound(Interval(0, None), rb)
+            return NodeBound(
+                Interval(0, int(rows)), rb,
+                cold_rows=cold_rows, origin="sketch",
+            )
+        return NodeBound(Interval(0, None), rb, cold_rows=cold_rows)
 
     if isinstance(op, EmptySourceOp):
         return NodeBound(Interval(0, 0), rb, origin="derived")
@@ -457,7 +482,7 @@ def plan_bounds(plan: Plan, schemas, registry, table_stats=None, *,
     if not plan.nodes:
         report.rows_in_hi = report.rows_out_hi = 0
         report.bytes_staged_hi = report.wire_bytes_hi = 0
-        report.peak_node_bytes_hi = 0
+        report.peak_node_bytes_hi = report.cold_decode_bytes_hi = 0
         return report
 
     # Relation propagation: planner-built plans already carry per-node
@@ -491,6 +516,7 @@ def plan_bounds(plan: Plan, schemas, registry, table_stats=None, *,
     rows_out: int | None = 0
     wire: int | None = 0
     peak: int | None = 0
+    cold_decode: int | None = 0
     for nid in order:
         node = plan.nodes[nid]
         in_bounds = [
@@ -535,6 +561,16 @@ def plan_bounds(plan: Plan, schemas, registry, table_stats=None, *,
                     bytes_staged = None
                 elif bytes_staged is not None:
                     bytes_staged += side.rows.hi * side.row_bytes * m
+        # Cold-tier decode demand: each consumer's scan decodes the
+        # source's cold windows afresh (same fan-out rule as staging);
+        # zone maps can only skip BELOW this.
+        if isinstance(op, MemorySourceOp) and b.cold_rows:
+            if b.rows.hi is None or not b.row_bytes:
+                cold_decode = None
+            elif cold_decode is not None:
+                cold_decode += (
+                    min(b.cold_rows, b.rows.hi) * b.row_bytes * mult
+                )
         if b.rows.hi is None:
             rows_out = None
         elif rows_out is not None:
@@ -559,6 +595,7 @@ def plan_bounds(plan: Plan, schemas, registry, table_stats=None, *,
     report.bytes_staged_hi = scaled(bytes_staged)
     report.wire_bytes_hi = scaled(wire)
     report.peak_node_bytes_hi = scaled(peak)
+    report.cold_decode_bytes_hi = scaled(cold_decode)
     _budget_diagnostics(report, plan)
     return report
 

@@ -226,6 +226,22 @@ define_flag(
     "(reference PL_TABLE_STORE_HTTP_EVENTS_PERCENT).",
 )
 define_flag(
+    "cold_tier_mb", 0,
+    "Encoded cold-tier byte budget per table (table_store/coldstore.py). "
+    "> 0 enables tiering for byte-bounded tables: the oldest hot-ring "
+    "windows demote into dictionary/delta/run-length encoded cold "
+    "windows instead of expiring, and only cold evictions count as "
+    "expiry. 0 = cold tier off (hot ring expires directly, the "
+    "pre-tier behavior).",
+)
+define_flag(
+    "scan_zone_skip", True,
+    "Skip scan windows whose per-column zone maps cannot satisfy a "
+    "query's FilterOp predicate (exec/zoneskip.py) — checked BEFORE "
+    "stage/decode, so selective scans over cold data never decode "
+    "dead windows. Generalizes join_zone_skip to plain table scans.",
+)
+define_flag(
     "bus_secret", "",
     "Shared secret for netbus/broker bearer tokens; empty disables auth "
     "(single-trust-domain deployments).",

@@ -536,6 +536,24 @@ class Engine:
                     if s.rows and s.lo is not None
                 },
             }
+            # Storage-tier seeding (docs/STORAGE.md): pxbound reads the
+            # OBSERVED per-tier bytes/row off the freshness envelope to
+            # seed staged-bytes and cold-decode-bytes bounds — resident
+            # widths the schema walk cannot see (compression, dict
+            # codes vs raw strings).
+            if getattr(t, "_tier", None) is not None:
+                f = t.freshness()
+                hr, cr = int(f["hot_rows"]), int(f["cold_rows"])
+                out[n]["tier"] = {
+                    "hot_rows": hr,
+                    "cold_rows": cr,
+                    "hot_row_bytes": f["hot_bytes"] / hr if hr else None,
+                    "cold_row_bytes": f["cold_bytes"] / cr if cr else None,
+                    "raw_row_bytes": (
+                        (f["hot_bytes"] + f["cold_raw_bytes"]) / (hr + cr)
+                        if hr + cr else None
+                    ),
+                }
         # Telemetry feedback (arXiv:2102.02440): OBSERVED per-script
         # output cardinalities from past runs, keyed by script hash
         # under a dunder key no table name can collide with. compile_pxl
@@ -1173,18 +1191,25 @@ class Engine:
         hb: HostBatch = res
         return _Stream(hb.relation, dict(hb.dicts), [], hb)
 
-    def _windows(self, stream: _Stream):
+    def _windows(self, stream: _Stream, stats=None):
         """Slice source batches into <= window_rows chunks."""
         if isinstance(stream.source, HostBatch):
             batches = [stream.source]
         else:
+            from .zoneskip import chain_pruner
+
             sop = stream.source_op
             tables = (
                 stream.source if isinstance(stream.source, list) else [stream.source]
             )
             batches = itertools.chain.from_iterable(
                 t.scan(
-                    sop.start_time if sop else None, sop.stop_time if sop else None
+                    sop.start_time if sop else None,
+                    sop.stop_time if sop else None,
+                    prune=chain_pruner(
+                        t, stream.chain, getattr(t, "dicts", stream.dicts),
+                        stats=stats,
+                    ),
                 )
                 for t in tables
             )
@@ -1306,6 +1331,8 @@ class Engine:
 
     def _staged_windows_inner(self, stream: "_Stream", stats=None):
         from ..config import get_flag
+        from ..table_store.coldstore import take_decode_meter
+        from .zoneskip import chain_pruner
 
         use_cache = (
             self.device_residency
@@ -1324,11 +1351,23 @@ class Engine:
             for t in tables:
                 if getattr(t, "_backend", None) is None:
                     continue
+                pruner = chain_pruner(
+                    t, stream.chain, getattr(t, "dicts", stream.dicts),
+                    stats=stats,
+                )
                 for win, lo, hi in t.device_scan(
-                    start, stop, window_rows=self.window_rows
+                    start, stop, window_rows=self.window_rows, prune=pruner
                 ):
                     self._check_cancel()
+                    # Cold-tier decode ran inside device_scan's staging
+                    # (on THIS thread — the pipeline producer when
+                    # prefetching): charge it to the query via the
+                    # locked fragment stats, the only query-scoped
+                    # object reachable from the producer thread.
+                    dsec, dbytes = take_decode_meter()
                     if stats is not None:
+                        if dsec or dbytes:
+                            stats.add("decode", dsec, nbytes=dbytes)
                         stats.rows_in += hi - lo
                     # (lo, hi) scalar pair, not a mask: the fragment
                     # builds the iota mask INSIDE its program — a
@@ -1339,8 +1378,11 @@ class Engine:
                         np.int32(lo - win.row0), np.int32(hi - win.row0)
                     )
             return
-        for hb in self._windows(stream):
+        for hb in self._windows(stream, stats=stats):
             self._check_cancel()
+            dsec, dbytes = take_decode_meter()
+            if stats is not None and (dsec or dbytes):
+                stats.add("decode", dsec, nbytes=dbytes)
             with _timed(stats, "stage", rows=hb.length, nbytes=hb.nbytes):
                 cols, valid = self._stage(hb, self._window_capacity(hb.length))
                 _block_if(stats, cols)

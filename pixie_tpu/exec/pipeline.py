@@ -111,6 +111,17 @@ PXLINT_HOT_REGIONS = (
     "services/msgbus.py:MessageBus._fanout",
     "services/busstats.py:BusStats*",
     "services/telemetry.py:BusStatsCollector*",
+    # Storage tier (ISSUE 20): cold-window decode runs on the prefetch
+    # thread once per staged window, and the zone-map pruner + the
+    # tier-merged read path run per window on the scan spine — pure
+    # numpy/host arithmetic; a host sync in any of them stalls the
+    # decode-on-stage overlap exactly like one in WindowPipeline.
+    "table_store/coldstore.py:EncodedPlane.decode",
+    "table_store/coldstore.py:ColdStore._decode_window",
+    "table_store/coldstore.py:ColdStore.read",
+    "table_store/table.py:Table.read_rows",
+    "exec/zoneskip.py:make_pruner*",
+    "exec/zoneskip.py:chain_pruner*",
 )
 
 

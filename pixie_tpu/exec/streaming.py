@@ -30,6 +30,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..table_store.coldstore import take_decode_meter
 from .engine import (
     Engine,
     QueryCancelled,
@@ -175,9 +176,9 @@ class StreamingQuery:
             if be is None:
                 self._wm[id(t)] = 0
             elif start is not None:
-                self._wm[id(t)] = be.row_id_for_time(int(start), False)
+                self._wm[id(t)] = t.row_id_for_time(int(start), False)
             else:
-                self._wm[id(t)] = be.first_row_id()
+                self._wm[id(t)] = t.first_row_id()
         # Where the CURRENT agg state's fold started, per tablet: ring
         # expiry crossing this mark means folded rows are gone and the
         # persistent state must refold from the live rows (otherwise a
@@ -186,6 +187,7 @@ class StreamingQuery:
         self._fold_lo: dict = dict(self._wm)
         self._state = None
         self._frag = None
+        self._pruners: dict = {}  # id(tablet) -> zone-skip pruner | None
         # One lifecycle trace per stream (exec/trace.py): the stream
         # shows in /debug/queryz as in-flight until close()/run() ends
         # it; per-poll window work lands in its fragment stats. Begun
@@ -215,6 +217,20 @@ class StreamingQuery:
         if self.chain.is_agg and self._state is not None:
             # Rebucket path: state restarts from scratch at the new size.
             self._state = None
+        self._pruners = {}  # fragment stats changed; rebuild lazily
+
+    def _pruner_for(self, t):
+        """Zone-map window pruner for one tablet (None = no skipping).
+        Built once per compile; skips are charged to the stream's
+        current fragment stats."""
+        key = id(t)
+        if key not in self._pruners:
+            from .zoneskip import chain_pruner
+
+            self._pruners[key] = chain_pruner(
+                t, self.ops, self.dicts, stats=self._tstats
+            )
+        return self._pruners[key]
 
     def close(self, status: str = "ok", error: str = "") -> None:
         """End the stream's lifecycle trace (idempotent). ``run()`` calls
@@ -227,7 +243,9 @@ class StreamingQuery:
 
     def _new_windows(self):
         """(cols, valid, (tablet_key, row_hi)) device windows appended
-        since the last poll.
+        since the last poll. ``cols is None`` marks a zone-map-pruned
+        tail: no window survives past ``row_hi``'s predecessor, and the
+        consumer should commit the watermark without folding.
 
         Watermarks are NOT advanced here: with the prefetch pipeline this
         generator runs up to ``pipeline_depth`` windows ahead of the
@@ -240,19 +258,41 @@ class StreamingQuery:
             if be is None:
                 continue
             wm = self._wm[id(t)]
-            end = be.end_row_id()
-            # Ring expiry may have dropped rows under the watermark.
-            wm = max(wm, be.first_row_id())
+            end = t.end_row_id()
+            # TRUE expiry may have dropped rows under the watermark
+            # (tier-merged first: demotion does NOT advance it, so
+            # demoted-but-never-folded rows are still visited).
+            wm = max(wm, t.first_row_id())
             self._wm[id(t)] = wm
             if end <= wm:
                 continue
+            last_hi = wm
             for win, lo, hi in t.device_scan(
                 window_rows=self.engine.window_rows,
                 start_row=wm, stop_row=end,
+                prune=self._pruner_for(t),
             ):
+                # Cold decode ran on this (producer) thread inside the
+                # staging call — charge it via the locked fragment stats.
+                dsec, dbytes = take_decode_meter()
+                if self._tstats is not None and (dsec or dbytes):
+                    self._tstats.add("decode", dsec, nbytes=dbytes)
+                last_hi = hi
                 yield win.cols, (
                     np.int32(lo - win.row0), np.int32(hi - win.row0)
                 ), (id(t), hi)
+            if last_hi < end:
+                # Zone maps pruned the tail windows. Pruned windows in
+                # the MIDDLE of a scan are covered by the next surviving
+                # window's commit (the watermark is a scalar), but a
+                # pruned tail would otherwise leave the watermark short:
+                # the poll would emit nothing and every later poll would
+                # rescan (and re-prune) the same windows. Yield a
+                # column-less marker so the consumer commits ``end`` and
+                # still counts the poll as progress — the pruner proved
+                # the predicate matches no row in those windows, so
+                # skipping the fold is exact.
+                yield None, None, (id(t), end)
 
     def _check_cancel(self):
         if self.cancel is not None and self.cancel.is_set():
@@ -286,8 +326,8 @@ class StreamingQuery:
             be = getattr(t, "_backend", None)
             if be is None:
                 continue
-            wm = max(self._wm[id(t)], be.first_row_id())
-            if be.end_row_id() > wm:
+            wm = max(self._wm[id(t)], t.first_row_id())
+            if t.end_row_id() > wm:
                 return True
         return False
 
@@ -299,13 +339,15 @@ class StreamingQuery:
             for t in self.tablets:
                 be = getattr(t, "_backend", None)
                 if be is not None and (
-                    be.first_row_id() > self._fold_lo.get(id(t), 0)
+                    t.first_row_id() > self._fold_lo.get(id(t), 0)
                 ):
-                    # Ring expiry dropped rows ALREADY folded into the
+                    # TRUE expiry dropped rows ALREADY folded into the
                     # persistent state — refold from the live rows so
                     # the replace-mode aggregate matches what a
                     # one-shot rescan would compute (materialized-view
-                    # bit-identity across expiry churn).
+                    # bit-identity across expiry churn). Demotion alone
+                    # never triggers this: the tier-merged first row id
+                    # only moves on cold eviction.
                     self._state = None
                     break
         if self._state is None:
@@ -316,27 +358,32 @@ class StreamingQuery:
                 if be is not None:
                     start = self.chain.source.start_time
                     pos = (
-                        be.row_id_for_time(int(start), False)
+                        t.row_id_for_time(int(start), False)
                         if start is not None
-                        else be.first_row_id()
+                        else t.first_row_id()
                     )
                     self._wm[id(t)] = pos
                     # The effective fold start: expiry may already sit
                     # past a time-derived position.
-                    self._fold_lo[id(t)] = max(pos, be.first_row_id())
+                    self._fold_lo[id(t)] = max(pos, t.first_row_id())
         folded = False
         st = self._tstats
         pipe = self._pipelined_windows()
         try:
             for cols, valid, (wm_key, wm_hi) in pipe:
                 self._check_cancel()
-                with _timed(st, "compute"):
-                    self._state = frag.update(self._state, cols, valid)
-                w_rows = int(valid[1] - valid[0])
-                rows += w_rows
-                if st is not None:
-                    st.windows += 1
-                    st.rows_in += w_rows
+                if cols is not None:
+                    with _timed(st, "compute"):
+                        self._state = frag.update(self._state, cols, valid)
+                    w_rows = int(valid[1] - valid[0])
+                    rows += w_rows
+                    if st is not None:
+                        st.windows += 1
+                        st.rows_in += w_rows
+                # A column-less marker (zone-map-pruned tail) folds
+                # nothing but still counts as progress: rows WERE
+                # consumed, so the poll must emit (matching the serial
+                # executor, which emits the unchanged aggregate).
                 folded = True
                 self._wm[wm_key] = wm_hi  # commit AFTER the fold
         finally:
@@ -406,6 +453,11 @@ class StreamingQuery:
         try:
             for cols, valid, (wm_key, wm_hi) in pipe:
                 self._check_cancel()
+                if cols is None:
+                    # Zone-map-pruned tail: no row can match, so there
+                    # is nothing to emit — just advance the watermark.
+                    self._wm[wm_key] = wm_hi
+                    continue
                 with _timed(st, "compute"):
                     out_cols, out_valid = frag.update(cols, valid)
                 with _timed(st, "materialize"):
@@ -480,6 +532,11 @@ class StreamingQuery:
         try:
             for cols, valid, (wm_key, wm_hi) in pipe:
                 self._check_cancel()
+                if cols is None:
+                    # Zone-map-pruned tail (see _new_windows): commit
+                    # the watermark; no rows survive to ship.
+                    self._wm[wm_key] = wm_hi
+                    continue
                 with _timed(st, "compute"):
                     out_cols, out_valid = frag.update(cols, valid)
                 with _timed(st, "materialize"):

@@ -136,11 +136,16 @@ class QueryResourceUsage:
     skipped_windows: int = 0
     device_peak_bytes: int = 0
     freshness_lag_ms: float = 0.0
+    # Cold-tier decode wall time charged to this query (decoding runs on
+    # the prefetch producer thread — decode-on-stage — so this overlaps
+    # device compute rather than adding to it; compare against stall_ms
+    # to see whether decode ever became the bottleneck).
+    decode_ms: float = 0.0
 
     def to_dict(self) -> dict:
         d = asdict(self)
         for k in ("device_ms", "compile_ms", "stall_ms",
-                  "freshness_lag_ms"):
+                  "freshness_lag_ms", "decode_ms"):
             d[k] = round(d[k], 3)
         return d
 
@@ -153,7 +158,7 @@ class QueryResourceUsage:
             "retries", "skipped_windows",
         ):
             setattr(self, k, getattr(self, k) + int(d.get(k, 0)))
-        for k in ("device_ms", "compile_ms", "stall_ms"):
+        for k in ("device_ms", "compile_ms", "stall_ms", "decode_ms"):
             setattr(self, k, getattr(self, k) + float(d.get(k, 0.0)))
         # A watermark, not a volume: agents sharing a device would
         # double-count under addition.
@@ -452,14 +457,19 @@ class QueryTrace:
         u.windows += self.windows
         for f in self.stats.fragments:
             with f._lock:
-                stages = {k: (v.seconds, v.nbytes)
+                stages = {k: (v.seconds, v.nbytes, v.count)
                           for k, v in f.stages.items()}
-            u.bytes_staged += stages.get("stage", (0.0, 0))[1]
+            u.bytes_staged += stages.get("stage", (0.0, 0, 0))[1]
             u.device_ms += (
-                stages.get("compute", (0.0, 0))[0]
-                + stages.get("finalize", (0.0, 0))[0]
+                stages.get("compute", (0.0, 0, 0))[0]
+                + stages.get("finalize", (0.0, 0, 0))[0]
             ) * 1e3
-            u.stall_ms += stages.get("stall", (0.0, 0))[0] * 1e3
+            u.stall_ms += stages.get("stall", (0.0, 0, 0))[0] * 1e3
+            # Cold-tier stage adds: "decode" seconds ride the stage
+            # timeline (producer thread); "skip" counts windows a zone
+            # map pruned before stage/decode (one add() per window).
+            u.decode_ms += stages.get("decode", (0.0, 0, 0))[0] * 1e3
+            u.skipped_windows += stages.get("skip", (0.0, 0, 0))[2]
         compile_span = next(
             (s for s in self.spans if s.name == "compile"), None
         )

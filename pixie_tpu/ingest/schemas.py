@@ -350,7 +350,12 @@ TABLES_RELATION = Relation(
         ("rows", DataType.INT64),  # live rows
         ("bytes", DataType.INT64),  # live bytes (hot + cold)
         ("hot_bytes", DataType.INT64),
-        ("cold_bytes", DataType.INT64),
+        ("cold_bytes", DataType.INT64),  # encoded cold-store bytes
+        ("hot_rows", DataType.INT64),  # pxtier split (0s untiered)
+        ("cold_rows", DataType.INT64),
+        ("cold_raw_bytes", DataType.INT64),  # pre-encoding widths
+        ("cold_demotions_total", DataType.INT64),
+        ("cold_evictions_total", DataType.INT64),
         ("device_bytes", DataType.INT64),  # HBM-resident staged windows
         ("rows_total", DataType.INT64),  # rows ever appended
         ("bytes_total", DataType.INT64),

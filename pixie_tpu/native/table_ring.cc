@@ -191,6 +191,55 @@ int64_t pxt_table_compact(Table* t) {
   return created;
 }
 
+// Drop every row with id < row_id. This is the cold-tier demotion handoff
+// (tier.py): the caller has already copied these rows into the encoded
+// cold store, so the drop is NOT expiry — batches_expired / bytes_expired
+// do not move (they are reserved for true data loss). Row-granular: a
+// batch straddling row_id is split and its tail kept, so the invariant
+// "cold tier end == hot first_row_id" holds exactly. Returns the new
+// first row id.
+int64_t pxt_table_drop_before(Table* t, int64_t row_id) {
+  std::lock_guard<std::mutex> lock(t->mu);
+  for (std::deque<Batch>* q : {&t->cold, &t->hot}) {
+    int64_t& qbytes = (q == &t->cold) ? t->cold_bytes : t->hot_bytes;
+    while (!q->empty()) {
+      Batch& b = q->front();
+      if (b.end_row_id() <= row_id) {
+        qbytes -= b.bytes;
+        q->pop_front();
+        continue;
+      }
+      if (b.first_row_id < row_id) {
+        int64_t drop = row_id - b.first_row_id;
+        int64_t keep = b.n - drop;
+        Batch tail;
+        tail.first_row_id = row_id;
+        tail.n = keep;
+        tail.bytes = keep * t->row_bytes;
+        tail.cols.reserve(t->elem_sizes.size());
+        for (size_t c = 0; c < t->elem_sizes.size(); ++c) {
+          int32_t es = t->elem_sizes[c];
+          auto slab = std::make_unique<char[]>(keep * es);
+          std::memcpy(slab.get(), b.cols[c].get() + drop * es, keep * es);
+          tail.cols.push_back(std::move(slab));
+        }
+        if (t->has_time) {
+          const int64_t* times =
+              reinterpret_cast<const int64_t*>(tail.cols[0].get());
+          tail.min_time = *std::min_element(times, times + keep);
+          tail.max_time = *std::max_element(times, times + keep);
+        }
+        qbytes += tail.bytes - b.bytes;
+        q->front() = std::move(tail);
+      }
+      // Front batch now starts at or after row_id; later batches are
+      // strictly newer, so the sweep is complete.
+      return t->first_row_id_locked();
+    }
+  }
+  return t->first_row_id_locked();
+}
+
 int64_t pxt_table_first_row_id(Table* t) {
   std::lock_guard<std::mutex> lock(t->mu);
   return t->first_row_id_locked();

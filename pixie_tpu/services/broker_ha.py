@@ -418,10 +418,24 @@ class BrokerReplica:
 
     def _finish_failover(self, qid: str, info: dict) -> None:
         """Complete one adopted query and answer its caller's inbox in
-        the exact served-reply shape (`_run_execute`)."""
+        the exact served-reply shape (`_run_execute`).
+
+        The re-attach window is a hard DEADLINE, not an inactivity
+        watchdog: fragment results published into the takeover gap
+        (after the old leader died, before this forwarder re-
+        subscribed) are gone from the bus, so an adopted query can
+        have a claimed owner — e.g. a merge agent holding unmet bridge
+        expectations — yet never produce another report. When the
+        window lapses, whatever DID re-report returns as a structured
+        ``partial``/``broker_failover`` reply; an error here would read
+        to the caller (and the chaos soak's ledger) as a lost query."""
         fw = self.broker.forwarder
         try:
-            res = fw.wait(qid, self.reattach_timeout_s)
+            res = fw.wait(
+                qid, self.reattach_timeout_s,
+                deadline=time.monotonic() + self.reattach_timeout_s,
+                deadline_reason="broker_failover",
+            )
             payload = {
                 "ok": True,
                 "qid": qid,

@@ -575,7 +575,24 @@ def engine_collector(engine):
         from ..table_store.device_cache import total_resident_bytes
 
         g_rows = reg.gauge("pixie_table_rows", "Rows resident per table")
-        g_bytes = reg.gauge("pixie_table_bytes", "Bytes resident per table")
+        # tier label: "hot" = ring bytes, "cold" = encoded cold-store
+        # bytes (pxtier). Untiered tables report only tier="hot" (their
+        # whole ring), so sum-over-tiers is always total resident bytes.
+        g_bytes = reg.gauge(
+            "pixie_table_bytes", "Bytes resident per table and tier"
+        )
+        g_demote = reg.gauge(
+            "pixie_cold_demotions_total",
+            "Windows demoted hot->cold per table (pxtier)",
+        )
+        g_evict = reg.gauge(
+            "pixie_cold_evictions_total",
+            "Cold windows evicted (true expiry) per table",
+        )
+        g_decode = reg.gauge(
+            "pixie_cold_decode_seconds_total",
+            "Seconds spent decoding cold windows per table",
+        )
         # Storage-tier freshness (monotonic counters rendered as gauges
         # set to the counter value at scrape — the pipeline-totals
         # idiom; `table` label cardinality is bounded by the process's
@@ -601,7 +618,19 @@ def engine_collector(engine):
                 continue
             st = t.stats()
             g_rows.labels(table=name).set(st.num_rows)
-            g_bytes.labels(table=name).set(st.bytes)
+            if getattr(t, "_tier", None) is not None:
+                g_bytes.labels(table=name, tier="hot").set(st.hot_bytes)
+                g_bytes.labels(table=name, tier="cold").set(st.cold_bytes)
+                g_demote.labels(table=name).set(st.demotions)
+                g_evict.labels(table=name).set(st.evictions)
+                g_decode.labels(table=name).set(
+                    round(st.decode_seconds, 6)
+                )
+            else:
+                # Untiered: hot_bytes/cold_bytes here are the ring's
+                # INTERNAL recent/merged split — the whole ring is the
+                # hot storage tier.
+                g_bytes.labels(table=name, tier="hot").set(st.bytes)
             g_rows_t.labels(table=name).set(st.rows_added)
             g_bytes_t.labels(table=name).set(st.bytes_added)
             g_exp_t.labels(table=name).set(st.bytes_expired)

@@ -496,7 +496,8 @@ class QueryResultForwarder:
             return set(st["acked"]) if st is not None else None
 
     def wait(self, qid: str, timeout_s: float,
-             deadline: float | None = None) -> dict:
+             deadline: float | None = None,
+             deadline_reason: str = "deadline") -> dict:
         """Blocks until eos/error/timeout. Returns {table: HostBatch} plus
         per-agent exec stats and the partial-result marker; raises on
         error, merge-agent loss, require_complete violation, or watchdog
@@ -508,9 +509,11 @@ class QueryResultForwarder:
         everywhere (agents abort at their next window boundary) and
         whatever already arrived returns as a ``partial`` result with
         the unreported agents marked ``missing_reasons[...] =
-        "deadline"`` — a deadline is degradation, not failure. An
-        ``interrupt()`` (the ``cancel_query`` path) takes the same exit
-        with reason "cancelled"."""
+        deadline_reason`` — a deadline is degradation, not failure (a
+        successor broker adopting an in-flight query passes
+        "broker_failover" so the attribution names the takeover, not
+        the query). An ``interrupt()`` (the ``cancel_query`` path)
+        takes the same exit with reason "cancelled"."""
         with self._lock:
             st = self._active[qid]
         outputs: dict = {}
@@ -529,7 +532,8 @@ class QueryResultForwarder:
                 now = time.monotonic()
                 if deadline is not None and now >= deadline:
                     return self._interrupted(
-                        qid, st, outputs, stats, merge_stats, "deadline"
+                        qid, st, outputs, stats, merge_stats,
+                        deadline_reason,
                     )
                 if eos:
                     # After eos, per-agent stats may still be in flight

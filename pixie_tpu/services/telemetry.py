@@ -166,6 +166,15 @@ class TableStatsCollector:
                 "bytes": [f["bytes"] for f in rows],
                 "hot_bytes": [f["hot_bytes"] for f in rows],
                 "cold_bytes": [f["cold_bytes"] for f in rows],
+                "hot_rows": [f["hot_rows"] for f in rows],
+                "cold_rows": [f["cold_rows"] for f in rows],
+                "cold_raw_bytes": [f["cold_raw_bytes"] for f in rows],
+                "cold_demotions_total": [
+                    f["cold_demotions_total"] for f in rows
+                ],
+                "cold_evictions_total": [
+                    f["cold_evictions_total"] for f in rows
+                ],
                 "device_bytes": [f["device_bytes"] for f in rows],
                 "rows_total": [f["rows_total"] for f in rows],
                 "bytes_total": [f["bytes_total"] for f in rows],

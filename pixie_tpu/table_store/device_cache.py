@@ -169,9 +169,14 @@ def stage_window(table, window_index: int, window_rows: int) -> DeviceWindow | N
 
     from ..types.batch import bucket_capacity
 
-    be = table._backend
+    # Tier-merged read (Table.read_rows): a window straddling the
+    # demotion boundary assembles from decoded cold rows + hot ring rows
+    # transparently — the decode runs on THIS thread, which under the
+    # WindowPipeline is the prefetch producer (decode-on-stage overlap).
     lo = window_index * window_rows
-    planes, first, n = be.read(max(lo, be.first_row_id()), window_rows)
+    planes, first, n = table.read_rows(
+        max(lo, table.first_row_id()), window_rows
+    )
     hi_cap = (window_index + 1) * window_rows
     if n > 0 and first + n > hi_cap:  # clip reads that ran past the window
         n = max(0, hi_cap - first)

@@ -63,12 +63,23 @@ class TableStats:
     # maintains, and the wall-clock/EWMA fields from two attribute writes
     # per append. Defaults let bare positional constructions keep working.
     rows_added: int = 0  # rows ever appended (monotonic)
-    rows_expired: int = 0  # rows dropped by ring expiry (monotonic)
-    bytes_expired: int = 0  # bytes_added - live bytes (monotonic)
+    rows_expired: int = 0  # rows dropped by TRUE expiry (monotonic)
+    bytes_expired: int = 0  # raw bytes lost to true expiry (monotonic)
     watermark: int = -1  # max event-time ns ever appended (never regresses)
     last_append_unix_ns: int = 0  # wall time of the latest append
     ingest_rows_per_s: float = 0.0  # per-append EWMA ingest rate
     device_bytes: int = 0  # device-resident (HBM) staged window bytes
+    # -- storage tier surface (table_store/tier.py; zeros when untiered).
+    # For a tiered table hot_bytes/cold_bytes above are repurposed as the
+    # per-TIER split (whole ring = hot, encoded store = cold) rather
+    # than the ring's internal hot/compacted split.
+    hot_rows: int = 0  # live rows in the hot ring
+    cold_rows: int = 0  # live rows in the encoded cold store
+    cold_raw_bytes: int = 0  # decoded size of the cold rows (ratio base)
+    cold_windows: int = 0
+    demotions: int = 0  # windows ever demoted hot -> cold (monotonic)
+    evictions: int = 0  # cold windows ever evicted = expired (monotonic)
+    decode_seconds: float = 0.0  # lifetime cold decode wall time
 
 
 @dataclass(frozen=True)
@@ -227,6 +238,28 @@ class _PyBackend:
             ]
             return out, row_id, copied
 
+    def drop_before(self, row_id: int) -> int:
+        """Drop rows with id < row_id (cold-tier demotion handoff — NOT
+        expiry: batches_expired does not move). Row-granular: a batch
+        straddling row_id is split and its tail kept."""
+        with self.lock:
+            for q in (self.cold, self.hot):
+                while q:
+                    rid, planes, mn, mx = q[0]
+                    n = len(planes[0])
+                    if rid + n <= row_id:
+                        q.pop(0)
+                        continue
+                    if rid < row_id:
+                        drop = row_id - rid
+                        tail = [p[drop:].copy() for p in planes]
+                        if self.has_time:
+                            mn = int(tail[0].min())
+                            mx = int(tail[0].max())
+                        q[0] = [row_id, tail, mn, mx]
+                    return self._first_row_id()
+            return self._first_row_id()
+
     def stats(self) -> list:
         with self.lock:
             hot_b, cold_b = self._bytes(self.hot), self._bytes(self.cold)
@@ -306,6 +339,8 @@ class _NativeBackend:
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.pxt_table_drop_before.restype = ctypes.c_int64
+        lib.pxt_table_drop_before.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib._pxt_configured = True
 
     def __del__(self):
@@ -344,6 +379,9 @@ class _NativeBackend:
         )
         return [a[:n] for a in out], first.value, n
 
+    def drop_before(self, row_id: int) -> int:
+        return self.lib.pxt_table_drop_before(self.handle, row_id)
+
     def stats(self) -> list:
         buf = (ctypes.c_int64 * 10)()
         self.lib.pxt_table_stats(self.handle, buf)
@@ -360,25 +398,24 @@ class Cursor:
 
     def __init__(self, table: "Table", start: StartSpec, stop: StopSpec):
         self._table = table
-        be = table._backend
         if start.start_time is not None:
-            self._next_row_id = be.row_id_for_time(start.start_time, False)
+            self._next_row_id = table.row_id_for_time(start.start_time, False)
         else:
-            self._next_row_id = be.first_row_id()
+            self._next_row_id = table.first_row_id()
         self.update_stop_spec(stop)
 
     def update_stop_spec(self, stop: StopSpec) -> None:
-        be = self._table._backend
+        t = self._table
         if stop.infinite:
             self._stop_row_id = None
         elif stop.stop_time is not None:
             # Stop at the time or the current end, whichever is first
             # (reference StopAtTime semantics).
             self._stop_row_id = min(
-                be.row_id_for_time(stop.stop_time, True), be.end_row_id()
+                t.row_id_for_time(stop.stop_time, True), t.end_row_id()
             )
         else:
-            self._stop_row_id = be.end_row_id()
+            self._stop_row_id = t.end_row_id()
 
     def done(self) -> bool:
         if self._stop_row_id is None:
@@ -388,7 +425,12 @@ class Cursor:
     def next_batch_ready(self) -> bool:
         if self._stop_row_id is not None:
             return not self.done()
-        return self._next_row_id < self._table._backend.end_row_id()
+        return self._next_row_id < self._table.end_row_id()
+
+    def skip_to(self, row_id: int) -> None:
+        """Advance past rows a zone-map check proved irrelevant (the
+        scan-skip fast-forward; never moves backwards)."""
+        self._next_row_id = max(self._next_row_id, int(row_id))
 
     def next_batch(self, max_rows: int, cols: Optional[Sequence[str]] = None):
         """Read up to max_rows as a HostBatch, or None when exhausted/dry."""
@@ -396,7 +438,7 @@ class Cursor:
             return None
         if self._stop_row_id is not None:
             max_rows = min(max_rows, self._stop_row_id - self._next_row_id)
-        planes, first, n = self._table._backend.read(self._next_row_id, max_rows)
+        planes, first, n = self._table.read_rows(self._next_row_id, max_rows)
         if self._stop_row_id is not None:
             # Expiry may have skipped the read past the stop snapshot.
             n = min(n, max(0, self._stop_row_id - first))
@@ -465,6 +507,13 @@ class Table:
         self._last_append_mono = None
         self._last_append_rows = 0
         self._ingest_ewma = 0.0
+        # Cold storage tier (tier.py): set by _init_backend when the
+        # cold_tier_mb flag is on AND the table is byte-bounded. A
+        # tiered table's backend ring is created UNBOUNDED — the tier
+        # manager owns both budgets (demote past max_bytes, evict past
+        # cold_tier_mb), so ring self-expiry never races the demotion
+        # handoff.
+        self._tier = None
         if len(self.relation):
             self._init_backend()
 
@@ -487,11 +536,20 @@ class Table:
         dts = [
             np.dtype(host_dtypes(self.relation.col_type(c))[i]) for c, i in layout
         ]
+        from ..config import get_flag as _get_flag
+
+        cold_mb = int(_get_flag("cold_tier_mb"))
+        tiered = cold_mb > 0 and self.max_bytes >= 0
         lib = load_native("table_ring")
-        args = (dts, has_time, self.compacted_rows, self.max_bytes)
+        ring_max = -1 if tiered else self.max_bytes
+        args = (dts, has_time, self.compacted_rows, ring_max)
         self._backend = (
             _NativeBackend(lib, *args) if lib is not None else _PyBackend(*args)
         )
+        if tiered:
+            from .tier import MB, TierManager
+
+            self._tier = TierManager(self, self.max_bytes, cold_mb * MB)
         for cname, dt in self.relation.items():
             if dt == DataType.STRING:
                 self.dicts.setdefault(cname, StringDictionary())
@@ -557,6 +615,11 @@ class Table:
                     else (min(cur[0], lo), max(cur[1], hi))
                 )
         times = cols[TIME_COLUMN][0] if (TIME_COLUMN, 0) == self._plane_layout[0] else None
+        if self._tier is not None:
+            # Make room BEFORE the append lands: oldest windows demote
+            # (encode-then-drop handoff, not expiry) so the unbounded
+            # ring never holds more than max_bytes after this append.
+            self._tier.demote_for(sum(p.nbytes for p in planes))
         rid = self._backend.append(planes, times)
         if rid >= 0:
             self._note_append_freshness(hb.length)
@@ -614,20 +677,88 @@ class Table:
         """CompactHotToCold analog; call periodically (service loop)."""
         return self._backend.compact()
 
+    # -- tier-merged row-id space --------------------------------------------
+    # One unique monotone row-id space spans both tiers: demotion moves a
+    # row from the ring into the cold store WITHOUT changing its id, so
+    # cursors/watermarks keyed by row id never re-read or skip across a
+    # demotion. These helpers are the read-path entry points; everything
+    # below the Cursor goes through them instead of the bare backend.
+
+    def first_row_id(self) -> int:
+        """Oldest LIVE row id across both tiers. Advances only on true
+        expiry (cold eviction for tiered tables, ring expiry otherwise)."""
+        if self._tier is not None:
+            f = self._tier.store.first_row_id()
+            if f is not None:
+                return f
+        return self._backend.first_row_id()
+
+    def end_row_id(self) -> int:
+        return self._backend.end_row_id()
+
+    def row_id_for_time(self, t: int, strictly_greater: bool) -> int:
+        if self._tier is not None:
+            store = self._tier.store
+            if not store.has_time:
+                return self.first_row_id()
+            r = store.row_id_for_time(t, strictly_greater)
+            if r is not None:
+                return r
+        return self._backend.row_id_for_time(t, strictly_greater)
+
+    def read_rows(self, start_row_id: int, max_rows: int):
+        """Tier-merged mirror of the backend ``read`` ABI: (planes,
+        first_row_id, rows). Ordering is the demotion-race guard: the
+        ring is read FIRST, then the gap below the ring's answer is
+        filled from cold. Demotion encodes into cold BEFORE dropping
+        from the ring, so any row the ring no longer has is either in
+        the cold store or truly evicted — never in flight."""
+        be = self._backend
+        h_planes, h_first, h_n = be.read(start_row_id, max_rows)
+        if self._tier is None or h_first <= start_row_id:
+            return h_planes, h_first, h_n
+        want = min(h_first - start_row_id, max_rows)
+        c_planes, c_first, c_n = self._tier.store.read(start_row_id, want)
+        if c_n == 0:
+            return h_planes, h_first, h_n
+        if c_first + c_n == h_first and h_n > 0 and c_n < max_rows:
+            take_h = min(h_n, max_rows - c_n)
+            planes = [
+                np.concatenate([cp, hp[:take_h]])
+                for cp, hp in zip(c_planes, h_planes)
+            ]
+            return planes, c_first, c_n + take_h
+        return c_planes, c_first, c_n
+
     # -- read path -----------------------------------------------------------
     def cursor(
         self, start: StartSpec | None = None, stop: StopSpec | None = None
     ) -> Cursor:
         return Cursor(self, start or StartSpec(), stop or StopSpec())
 
-    def scan(self, start_time=None, stop_time=None, window_rows: int = 1 << 17):
-        """Yield HostBatch windows, time-bounded (engine source interface)."""
+    def scan(self, start_time=None, stop_time=None, window_rows: int = 1 << 17,
+             prune=None):
+        """Yield HostBatch windows, time-bounded (engine source interface).
+
+        ``prune(row_lo, row_hi) -> bool`` (exec/zoneskip.py) is consulted
+        per window BEFORE the read: True fast-forwards the cursor past
+        [row_lo, row_hi) without touching either tier — for cold windows
+        that means no decode at all.
+        """
         if self._backend is None:
             return
         start = StartSpec.at_time(int(start_time)) if start_time is not None else StartSpec()
         stop = StopSpec.at_time(int(stop_time) - 1) if stop_time is not None else StopSpec()
         cur = self.cursor(start, stop)
         while not cur.done():
+            if prune is not None:
+                lo = cur._next_row_id
+                hi = lo + window_rows
+                if cur._stop_row_id is not None:
+                    hi = min(hi, cur._stop_row_id)
+                if hi > lo and prune(lo, hi):
+                    cur.skip_to(hi)
+                    continue
             hb = cur.next_batch(window_rows)
             if hb is None:
                 break
@@ -642,15 +773,17 @@ class Table:
         w = int(window_rows or self.device_window_rows)
         if self._device_cache is None:
             self._device_cache = DeviceWindowCache()
-        be = self._backend
-        self._device_cache.evict_before(be.first_row_id())
-        end = be.end_row_id()
+        # Evict by the tier-merged first LIVE row: demoted-but-live rows
+        # keep their staged device windows (repeat scans stay resident
+        # and never re-decode), only true expiry reclaims them.
+        self._device_cache.evict_before(self.first_row_id())
+        end = self.end_row_id()
         self._staged_through = max(
-            self._staged_through, (be.first_row_id() // w) * w
+            self._staged_through, (self.first_row_id() // w) * w
         )
         while self._staged_through + w <= end:
             k = self._staged_through // w
-            first = max(k * w, be.first_row_id())
+            first = max(k * w, self.first_row_id())
             n = min((k + 1) * w, end) - first
             if n > 0 and self._device_cache.get((w, k, first, n)) is None:
                 win = stage_window(self, k, w)
@@ -660,7 +793,7 @@ class Table:
 
     def device_scan(self, start_time=None, stop_time=None,
                     window_rows: int | None = None, start_row=None,
-                    stop_row=None):
+                    stop_row=None, prune=None):
         """Yield (DeviceWindow, lo_row, hi_row) covering the time range.
 
         Windows come from the device-resident cache when staged (zero
@@ -668,17 +801,18 @@ class Table:
         demand and are cached keyed by their length, so a grown tail
         re-stages while full windows stay immutable. ``start_row`` /
         ``stop_row`` clamp by absolute row id — the streaming
-        (live-query) cursor's watermark interface.
+        (live-query) cursor's watermark interface. ``prune(lo, hi)``
+        (exec/zoneskip.py) runs BEFORE the cache probe/stage, so a
+        skipped window is never decoded or transferred.
         """
         from .device_cache import DeviceWindowCache, stage_window
 
         if self._backend is None:
             return
         w = int(window_rows or self.device_window_rows)
-        be = self._backend
         if self._device_cache is None:
             self._device_cache = DeviceWindowCache()
-        self._device_cache.evict_before(be.first_row_id())
+        self._device_cache.evict_before(self.first_row_id())
         if w != self.device_window_rows:
             # Adopt the consumer's window size: future appends stage at w
             # (last consumer wins; differently-sized stagings are dead
@@ -687,28 +821,34 @@ class Table:
             self._staged_through = 0
         self._device_cache.evict_other_window_sizes(w)
         if start_time is not None:
-            row0 = be.row_id_for_time(int(start_time), False)
+            row0 = self.row_id_for_time(int(start_time), False)
         else:
-            row0 = be.first_row_id()
+            row0 = self.first_row_id()
         if start_row is not None:
             row0 = max(row0, int(start_row))
         start_row = row0
         if stop_time is not None:
             row1 = min(
-                be.row_id_for_time(int(stop_time) - 1, True), be.end_row_id()
+                self.row_id_for_time(int(stop_time) - 1, True),
+                self.end_row_id(),
             )
         else:
-            row1 = be.end_row_id()
+            row1 = self.end_row_id()
         if stop_row is not None:
             row1 = min(row1, int(stop_row))
         stop_row = row1
         if stop_row <= start_row:
             return
         for k in range(start_row // w, (stop_row + w - 1) // w):
-            first = max(k * w, be.first_row_id())
-            n = min((k + 1) * w, be.end_row_id()) - first
+            first = max(k * w, self.first_row_id())
+            n = min((k + 1) * w, self.end_row_id()) - first
             if n <= 0:
                 continue
+            if prune is not None:
+                plo = max(start_row, first)
+                phi = min(stop_row, first + n)
+                if phi > plo and prune(plo, phi):
+                    continue
             win = self._device_cache.get((w, k, first, n))
             if win is None:
                 win = stage_window(self, k, w)
@@ -742,7 +882,7 @@ class Table:
 
             return _empty_host_batch(self.relation, self.dicts)
         n = max(1, self.num_rows)
-        planes, _, got = self._backend.read(self._backend.first_row_id(), n)
+        planes, _, got = self.read_rows(self.first_row_id(), n)
         return self._batch_from_planes([p[:got] for p in planes])
 
     # -- introspection -------------------------------------------------------
@@ -769,8 +909,34 @@ class Table:
         be = self._backend
         st = TableStats(*be.stats())
         st.rows_added = be.end_row_id()
-        st.rows_expired = be.first_row_id()
-        st.bytes_expired = st.bytes_added - st.bytes
+        if self._tier is not None:
+            # Tiered view: the whole ring is the hot tier, the encoded
+            # store is the cold tier. Only cold EVICTION is expiry —
+            # demotion moved rows, it didn't lose them — so the expiry
+            # counters come from the cold store's eviction ledger (at
+            # raw row widths, matching the ring's accounting).
+            cs = self._tier.store
+            st.hot_bytes = st.bytes
+            st.cold_bytes = cs.nbytes
+            st.bytes = st.hot_bytes + cs.nbytes
+            st.hot_rows = st.num_rows
+            st.cold_rows = cs.num_rows()
+            st.num_rows = be.end_row_id() - self.first_row_id()
+            st.num_batches += len(cs.windows)
+            cold_min_t = cs.min_time()
+            if cold_min_t is not None:
+                st.min_time = cold_min_t
+            st.rows_expired = cs.rows_evicted
+            st.bytes_expired = cs.bytes_evicted_raw
+            st.cold_raw_bytes = cs.raw_nbytes
+            st.cold_windows = len(cs.windows)
+            st.demotions = cs.demotions
+            st.evictions = cs.evictions
+            st.decode_seconds = cs.decode_seconds
+        else:
+            st.hot_rows = st.num_rows
+            st.rows_expired = be.first_row_id()
+            st.bytes_expired = st.bytes_added - st.bytes
         wm = self.watermark_ns
         st.watermark = wm if wm is not None else -1
         st.last_append_unix_ns = self._last_append_unix_ns
@@ -814,6 +980,13 @@ class Table:
             "min_time": st.min_time,
             "last_append": st.last_append_unix_ns,
             "ingest_rows_per_s": round(st.ingest_rows_per_s, 3),
+            # storage-tier split (zeros for untiered tables)
+            "hot_rows": st.hot_rows,
+            "cold_rows": st.cold_rows,
+            "cold_raw_bytes": st.cold_raw_bytes,
+            "cold_demotions_total": st.demotions,
+            "cold_evictions_total": st.evictions,
+            "cold_decode_seconds_total": round(st.decode_seconds, 6),
         }
 
 

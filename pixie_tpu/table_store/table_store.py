@@ -196,6 +196,10 @@ _FRESHNESS_SUM_KEYS = (
     "rows", "bytes", "hot_bytes", "cold_bytes", "device_bytes",
     "rows_total", "bytes_total", "expired_rows_total",
     "expired_bytes_total", "ingest_rows_per_s",
+    # storage-tier split (coldstore.py; zeros for untiered tablets)
+    "hot_rows", "cold_rows", "cold_raw_bytes",
+    "cold_demotions_total", "cold_evictions_total",
+    "cold_decode_seconds_total",
 )
 
 

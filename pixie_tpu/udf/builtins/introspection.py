@@ -94,6 +94,11 @@ def _get_debug_table_info(engine):
             "bytes",
             "hot_bytes",
             "cold_bytes",
+            "hot_rows",
+            "cold_rows",
+            "cold_raw_bytes",
+            "cold_demotions",
+            "cold_evictions",
             "num_batches",
             "batches_expired",
             "compacted_batches",
@@ -108,6 +113,11 @@ def _get_debug_table_info(engine):
         out["bytes"].append(st.bytes)
         out["hot_bytes"].append(st.hot_bytes)
         out["cold_bytes"].append(st.cold_bytes)
+        out["hot_rows"].append(st.hot_rows)
+        out["cold_rows"].append(st.cold_rows)
+        out["cold_raw_bytes"].append(st.cold_raw_bytes)
+        out["cold_demotions"].append(st.demotions)
+        out["cold_evictions"].append(st.evictions)
         out["num_batches"].append(st.num_batches)
         out["batches_expired"].append(st.batches_expired)
         out["compacted_batches"].append(st.compacted_batches)
@@ -162,6 +172,11 @@ def register_introspection(reg) -> None:
             ("bytes", I),
             ("hot_bytes", I),
             ("cold_bytes", I),
+            ("hot_rows", I),
+            ("cold_rows", I),
+            ("cold_raw_bytes", I),
+            ("cold_demotions", I),
+            ("cold_evictions", I),
             ("num_batches", I),
             ("batches_expired", I),
             ("compacted_batches", I),

@@ -111,6 +111,16 @@
 #                                      (tests/test_result_cache.py; see
 #                                      docs/CACHING.md). The file also
 #                                      runs inside the --tier1 sweep.
+#   ./run_tests.sh --storage           storage-tier gate: the cold-tier
+#                                      suite (tests/test_storage_tier.py
+#                                      — encoding round-trips,
+#                                      hot-vs-cold bit-identity,
+#                                      demote->evict monotonicity on
+#                                      both ring backends, zone-map
+#                                      skipping, decode-error
+#                                      propagation; see
+#                                      docs/STORAGE.md). The file also
+#                                      runs inside the --tier1 sweep.
 #   ./run_tests.sh --bench-join        quick join gate: a small
 #                                      selectivity/skew sweep (uniform
 #                                      vs zipf keys, low/high match
@@ -185,6 +195,11 @@ case "$1" in
     shift
     exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python -m pytest -q tests/test_result_cache.py "$@"
+    ;;
+  --storage)
+    shift
+    exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest -q tests/test_storage_tier.py "$@"
     ;;
   --bench-join)
     shift

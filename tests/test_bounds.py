@@ -653,3 +653,78 @@ class TestBlockingCallUnderLockRule:
         assert report.findings == [], "\n".join(
             f.render() for f in report.findings
         )
+
+
+class TestTierSeeding:
+    """Per-tier bytes/row seeding from the freshness envelope
+    (docs/STORAGE.md): tiered sources widen the staged-bytes bound to
+    the observed raw width and bound the cold decode demand."""
+
+    TIER_STATS = {
+        "t": {
+            **STATS["t"],
+            "tier": {
+                "hot_rows": 2_000,
+                "cold_rows": 8_000,
+                "hot_row_bytes": 28.0,
+                "cold_row_bytes": 7.0,
+                "raw_row_bytes": 40.0,  # wider than the schema's 28
+            },
+        },
+    }
+
+    Q = """
+import px
+df = px.DataFrame(table='t')
+df = df[df.k == 3]
+out = df.groupby('svc').agg(n=('v', px.count))
+px.display(out)
+"""
+
+    def test_observed_width_widens_staged_bound(self):
+        compiled, _ = _compile(self.Q, self.TIER_STATS)
+        report = compiled.plan.resource_report
+        src = _node_of(compiled.plan, MemorySourceOp)
+        b = report.nodes[src.id]
+        assert b.row_bytes == 40  # ceil(observed), not the schema's 28
+        assert b.cold_rows == 8_000
+        base, _ = _compile(self.Q, STATS)
+        assert report.bytes_staged_hi > \
+            base.plan.resource_report.bytes_staged_hi
+
+    def test_cold_decode_bound(self):
+        compiled, _ = _compile(self.Q, self.TIER_STATS)
+        report = compiled.plan.resource_report
+        s = report.safety
+        assert report.cold_decode_bytes_hi == int(8_000 * 40 * s)
+        assert report.cost()["cold_decode_bytes_hi"] == \
+            report.cold_decode_bytes_hi
+        # Untiered stats: a known-zero decode bound, never None.
+        base, _ = _compile(self.Q, STATS)
+        assert base.plan.resource_report.cold_decode_bytes_hi == 0
+
+    def test_engine_emits_tier_envelope(self):
+        from pixie_tpu.config import override_flag
+        from pixie_tpu.exec.engine import Engine
+        from pixie_tpu.types.relation import Relation
+
+        n = 512
+        rel = Relation([("time_", T), ("k", I), ("v", I)])
+        with override_flag("cold_tier_mb", 64):
+            eng = Engine(window_rows=n)
+            eng.create_table("t", relation=rel, max_bytes=4 * n * 24)
+            for i in range(12):
+                eng.append_data("t", {
+                    "time_": np.arange(i * n, (i + 1) * n, dtype=np.int64),
+                    "k": np.full(n, i, dtype=np.int64),
+                    "v": np.arange(n, dtype=np.int64),
+                })
+        ts = eng._compile_table_stats()
+        tier = ts["t"]["tier"]
+        assert tier["cold_rows"] > 0 and tier["hot_rows"] > 0
+        assert tier["raw_row_bytes"] == pytest.approx(24.0)
+        assert tier["cold_row_bytes"] < tier["raw_row_bytes"]
+        eng.execute_query(self.Q.replace("'svc'", "'k'"))
+        cost = eng.last_resource_report.cost()
+        assert cost["cold_decode_bytes_hi"] is not None
+        assert cost["cold_decode_bytes_hi"] > 0

@@ -394,6 +394,32 @@ class TestAbandon:
         bus.close()
 
 
+class TestReattachDeadline:
+    def test_reattach_lapse_resolves_partial_broker_failover(self):
+        """An adopted query whose fragment reports were published into
+        the takeover gap (no forwarder subscribed — the bus drops them)
+        can have a claimed owner yet never report again. The successor's
+        re-attach wait must resolve it at the DEADLINE as a structured
+        partial/broker_failover reply, never raise QueryTimeout (which
+        the caller's ledger would count as a lost query)."""
+        bus = MessageBus()
+        fwd = QueryResultForwarder(bus)
+        fwd.register_query("q-gap", ["pem-0", "pem-1"], merge_agent="m")
+        t0 = time.monotonic()
+        res = fwd.wait(
+            "q-gap", 5.0,
+            deadline=time.monotonic() + 0.4,
+            deadline_reason="broker_failover",
+        )
+        assert time.monotonic() - t0 < 2.0, "rode the watchdog"
+        assert res["partial"] is True
+        assert res["interrupted"] == "broker_failover"
+        assert set(res["missing_reasons"].values()) == {"broker_failover"}
+        assert sorted(res["missing_agents"]) == ["pem-0", "pem-1"]
+        assert fwd.active_qids() == []
+        bus.close()
+
+
 class TestClientRetry:
     """Satellite: api.Client retries idempotent control-plane reads
     through a failover window; execute_script is NEVER blind-retried —

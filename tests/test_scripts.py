@@ -418,6 +418,11 @@ def _seed_all_tables(eng, n=3000, seed=11):
         "bytes": [64_000 * (r[2] + 1) for r in rows],
         "hot_bytes": [32_000 * (r[2] + 1) for r in rows],
         "cold_bytes": [32_000 * (r[2] + 1) for r in rows],
+        "hot_rows": [500 * (r[2] + 1) for r in rows],
+        "cold_rows": [500 * (r[2] + 1) for r in rows],
+        "cold_raw_bytes": [96_000 * (r[2] + 1) for r in rows],
+        "cold_demotions_total": [4 * (r[2] + 1) for r in rows],
+        "cold_evictions_total": [r[2] for r in rows],
         "device_bytes": [16_000 * r[2] for r in rows],
         "rows_total": [2000 * (r[2] + 1) for r in rows],
         "bytes_total": [128_000 * (r[2] + 1) for r in rows],

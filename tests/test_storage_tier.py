@@ -1,0 +1,547 @@
+"""pxtier tests (ISSUE 20): compressed cold tier + zone-map skipping.
+
+Covers the acceptance list: hot-vs-cold bit-identity across every
+dtype (dictionary string ids included), demote->evict counter and
+watermark monotonicity on BOTH ring backends, decode-error propagation
+through the staging pipeline, result-cache validity across demotion,
+the mid-scan demotion race, and zone-skip correctness (unknown-string
+prune-all, flag-off A/B).
+"""
+
+import numpy as np
+import pytest
+
+from pixie_tpu.config import override_flag
+from pixie_tpu.table_store import StartSpec, StopSpec, Table
+from pixie_tpu.table_store.coldstore import (
+    ColdStore,
+    ColdStoreError,
+    EncodedPlane,
+    encode_plane,
+)
+from pixie_tpu.table_store.table import _PyBackend
+from pixie_tpu.types.dtypes import DataType
+from pixie_tpu.types.relation import Relation
+
+REL = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("latency", DataType.INT64),
+        ("service", DataType.STRING),
+    ]
+)
+
+#: Raw bytes/row of REL (8 time + 8 latency + 4 string codes).
+ROW_BYTES = 20
+
+ALL_REL = Relation(
+    [
+        ("time_", DataType.TIME64NS),
+        ("flag", DataType.BOOLEAN),
+        ("i", DataType.INT64),
+        ("u", DataType.UINT128),
+        ("f", DataType.FLOAT64),
+        ("s", DataType.STRING),
+    ]
+)
+
+
+def _batch(t0, n, svc="a"):
+    return {
+        "time_": np.arange(t0, t0 + n, dtype=np.int64),
+        "latency": np.arange(n, dtype=np.int64),
+        "service": [svc] * n,
+    }
+
+
+def _tiered(max_bytes, cold_mb=64, rel=REL, **kw):
+    """A tiered Table: the cold_tier_mb flag is read at init."""
+    with override_flag("cold_tier_mb", cold_mb):
+        return Table("t", rel, max_bytes=max_bytes, **kw)
+
+
+@pytest.fixture(params=["native", "py"])
+def backend(request, monkeypatch):
+    """Run the test on both ring backends."""
+    if request.param == "py":
+        import pixie_tpu.table_store.table as tbl
+
+        monkeypatch.setattr(tbl, "load_native", lambda name: None)
+    return request.param
+
+
+# ---------------------------------------------------------------------------
+# Plane encodings: lossless by construction.
+# ---------------------------------------------------------------------------
+
+
+class TestEncodings:
+    def test_delta_monotonic_int64(self):
+        p = np.cumsum(np.random.default_rng(0).integers(0, 200, 4096))
+        e = encode_plane(p.astype(np.int64))
+        assert e.kind == "delta" and e.nbytes < p.nbytes
+        assert np.array_equal(e.decode(), p)
+        assert e.decode().dtype == np.int64
+
+    def test_delta_rejects_wrapped_diffs(self):
+        # uint64 step past int64 max: the wrapped diff is negative and a
+        # narrow downcast would lose bits — must NOT pick delta.
+        p = np.array([0, 2**63 + 17, 2**64 - 1], dtype=np.uint64)
+        e = encode_plane(p)
+        assert np.array_equal(e.decode(), p)
+        assert e.decode().dtype == np.uint64
+
+    def test_delta_uint64_wrapped_domain(self):
+        # Monotonic uint64 above int64 max with small steps: delta in the
+        # wrapped domain is exact mod 2^64.
+        p = (np.uint64(2**63) + np.arange(1000, dtype=np.uint64) * 3)
+        e = encode_plane(p)
+        assert e.kind == "delta"
+        assert np.array_equal(e.decode(), p)
+
+    def test_rle_low_ndv(self):
+        p = np.repeat(
+            np.array([5, 900, 5, 7], dtype=np.int64), [4000, 100, 3000, 900]
+        )
+        e = encode_plane(p)
+        assert e.kind == "rle" and e.nbytes * 2 <= p.nbytes
+        assert np.array_equal(e.decode(), p)
+
+    def test_dict_rebase_narrow_range(self):
+        rng = np.random.default_rng(1)
+        p = rng.integers(10**12, 10**12 + 200, 4096).astype(np.int64)
+        rng.shuffle(p)  # not monotonic: delta must not claim it
+        e = encode_plane(p)
+        assert e.kind == "dict"
+        assert e.decode().dtype == np.int64
+        assert np.array_equal(e.decode(), p)
+
+    def test_raw_fallback_random_floats(self):
+        p = np.random.default_rng(2).random(1024)
+        e = encode_plane(p)
+        assert e.kind == "raw"
+        assert np.array_equal(e.decode(), p)
+
+    def test_uint64_rebase_overflow_guard(self):
+        p = np.array([2**64 - 2, 5, 2**64 - 1], dtype=np.uint64)
+        e = encode_plane(p)
+        assert e.kind == "raw"  # rebase through int64 would overflow
+        assert np.array_equal(e.decode(), p)
+
+    def test_decode_error_wraps(self):
+        store = ColdStore(has_time=True)
+        store.append_window(
+            0, [np.arange(64, dtype=np.int64)], 0, 63, [True]
+        )
+        good = store.windows[0]
+        bad = EncodedPlane("rle", np.dtype(np.int64), 64,
+                           (np.array([1]), np.array([63])))  # wrong length
+        object.__setattr__(good, "planes", (bad,))
+        with pytest.raises(ColdStoreError, match="decoded to"):
+            store.read(0, 64)
+
+    def test_non_contiguous_demotion_rejected(self):
+        store = ColdStore(has_time=True)
+        store.append_window(0, [np.arange(8, dtype=np.int64)], 0, 7, [True])
+        with pytest.raises(ColdStoreError, match="non-contiguous"):
+            store.append_window(
+                16, [np.arange(8, dtype=np.int64)], 16, 23, [True]
+            )
+
+
+# ---------------------------------------------------------------------------
+# Tiered table: bit-identity, counters, watermark.
+# ---------------------------------------------------------------------------
+
+
+class TestTieredTable:
+    def test_bit_identity_all_dtypes(self, backend):
+        """Demoted-and-read-back rows are bit-identical to an untiered
+        table over the same appends — every dtype, string ids included."""
+        rng = np.random.default_rng(3)
+        n, rounds = 512, 12
+        svcs = [f"s{i}" for i in range(5)]
+
+        def batch(r):
+            hi = rng.integers(0, 2**63, n, dtype=np.int64).astype(np.uint64)
+            lo = rng.integers(0, 2**63, n, dtype=np.int64).astype(np.uint64)
+            return {
+                "time_": np.arange(r * n, (r + 1) * n, dtype=np.int64),
+                "flag": rng.integers(0, 2, n).astype(bool),
+                "i": rng.integers(-(2**62), 2**62, n),
+                "u": np.stack([hi, lo], axis=1),
+                "f": rng.random(n),
+                "s": [svcs[j % len(svcs)] for j in range(n)],
+            }
+
+        batches = [batch(r) for r in range(rounds)]
+        hot = Table("t", ALL_REL, max_bytes=-1)
+        cold = _tiered(max_bytes=4 * 1024, rel=ALL_REL)
+        for b in batches:
+            hot.append(b)
+            cold.append(b)
+        st = cold.stats()
+        assert st.cold_rows > 0 and st.demotions > 0
+        assert st.evictions == 0  # budget big enough: no expiry
+        dh, dc = hot.read_all().to_pydict(), cold.read_all().to_pydict()
+        assert set(dh) == set(dc)
+        for c in dh:
+            assert np.array_equal(dh[c], dc[c]), c
+
+    def test_demotion_is_not_expiry(self, backend):
+        t = _tiered(max_bytes=40 * ROW_BYTES)
+        for i in range(10):
+            t.append(_batch(i * 40, 40))
+        st = t.stats()
+        assert st.demotions > 0
+        assert st.rows_expired == 0 and st.bytes_expired == 0
+        assert st.num_rows == st.rows_added == 400
+        assert t.read_all().length == 400
+
+    def test_demote_then_evict_monotonic(self, backend):
+        """Tiny cold budget: demotion flows into true eviction. Expiry
+        counters and the watermark must move monotonically, and live
+        rows must always reconcile with the row-id ledger."""
+        t = _tiered(max_bytes=64 * ROW_BYTES, cold_mb=1)
+        rng = np.random.default_rng(4)
+        prev = dict(rows_expired=0, bytes_expired=0, wm=-1, rows_added=0)
+        n = 1024
+        for i in range(180):
+            # incompressible latencies so cold bytes really grow
+            t.append({
+                "time_": np.arange(i * n, (i + 1) * n, dtype=np.int64),
+                "latency": rng.integers(0, 2**62, n),
+                "service": ["x"] * n,
+            })
+            st = t.stats()
+            wm = t.watermark_ns or -1
+            assert st.rows_expired >= prev["rows_expired"]
+            assert st.bytes_expired >= prev["bytes_expired"]
+            assert st.rows_added >= prev["rows_added"]
+            assert wm >= prev["wm"]
+            assert st.num_rows == st.rows_added - st.rows_expired
+            assert t.first_row_id() == st.rows_expired
+            prev = dict(rows_expired=st.rows_expired,
+                        bytes_expired=st.bytes_expired,
+                        wm=wm, rows_added=st.rows_added)
+        st = t.stats()
+        assert st.evictions > 0 and st.rows_expired > 0
+        assert st.cold_bytes <= 1 << 20  # the budget held
+
+    def test_backend_parity_tiered(self, monkeypatch):
+        """Native and py rings produce identical tiered end states."""
+        import pixie_tpu.table_store.table as tbl
+
+        results = {}
+        for name in ("native", "py"):
+            if name == "py":
+                monkeypatch.setattr(tbl, "load_native", lambda name: None)
+            t = _tiered(max_bytes=64 * ROW_BYTES, cold_mb=1)
+            rng = np.random.default_rng(5)
+            n = 256
+            for i in range(80):
+                t.append({
+                    "time_": np.arange(i * n, (i + 1) * n, dtype=np.int64),
+                    "latency": rng.integers(0, 2**62, n),
+                    "service": ["x"] * n,
+                })
+            st = t.stats()
+            results[name] = (
+                st.num_rows, st.rows_added, st.rows_expired,
+                st.bytes_expired, st.cold_rows, st.demotions, st.evictions,
+                tuple(t.read_all().to_pydict()["latency"][:64]),
+            )
+        assert results["native"] == results["py"]
+
+    def test_time_scan_across_tier_boundary(self, backend):
+        t = _tiered(max_bytes=50 * ROW_BYTES)
+        for i in range(8):
+            t.append(_batch(i * 50, 50))
+        st = t.stats()
+        assert st.cold_rows > 0 and st.hot_rows > 0
+        lo = st.cold_rows - 20  # starts cold, ends hot
+        got = list(t.scan(start_time=lo, stop_time=lo + 60))
+        times = np.concatenate([b.cols["time_"][0] for b in got])
+        assert np.array_equal(times, np.arange(lo, lo + 60))
+
+    def test_mid_scan_demotion_race(self, backend):
+        """Rows the cursor has not read yet demote under it; every live
+        row is still delivered exactly once, bit-exactly."""
+        t = _tiered(max_bytes=1 << 20)  # big: nothing demotes on append
+        for i in range(8):
+            t.append(_batch(i * 64, 64))
+        cur = t.cursor(StartSpec(), StopSpec.current_end())
+        first = cur.next_batch(100)
+        assert first.length == 100
+        # Demote everything still ahead of the cursor into the cold tier.
+        t._tier.demote_rows(512)
+        st = t.stats()
+        assert st.cold_rows >= 400 and st.rows_expired == 0
+        rest = []
+        while not cur.done():
+            b = cur.next_batch(100)
+            if b is None:
+                break
+            rest.append(b)
+        times = np.concatenate(
+            [first.cols["time_"][0]] + [b.cols["time_"][0] for b in rest]
+        )
+        assert np.array_equal(times, np.arange(512))
+
+    def test_freshness_exports_tier_split(self, backend):
+        t = _tiered(max_bytes=40 * ROW_BYTES)
+        for i in range(10):
+            t.append(_batch(i * 40, 40))
+        f = t.freshness()
+        assert f["cold_rows"] > 0 and f["hot_rows"] > 0
+        assert f["rows"] == f["cold_rows"] + f["hot_rows"] == 400
+        assert f["cold_demotions_total"] > 0
+        assert f["cold_raw_bytes"] >= f["cold_bytes"] > 0
+        assert f["cold_evictions_total"] == 0
+
+    def test_untiered_unchanged(self, backend):
+        """cold_tier_mb unset: max_bytes keeps its ring-expiry meaning."""
+        t = Table("t", REL, max_bytes=100 * ROW_BYTES)
+        t.append(_batch(0, 60))
+        t.append(_batch(60, 60))
+        st = t.stats()
+        assert t._tier is None
+        assert st.rows_expired == 60 and st.cold_rows == 0
+        assert t.read_all().length == 60
+
+
+# ---------------------------------------------------------------------------
+# Zone-map window skipping.
+# ---------------------------------------------------------------------------
+
+
+class TestZoneSkip:
+    def test_predicate_ranges(self):
+        from pixie_tpu.exec.plan import (
+            ColumnRef,
+            FilterOp,
+            FuncCall,
+            Literal,
+            MapOp,
+        )
+        from pixie_tpu.exec.zoneskip import EMPTY, predicate_ranges
+
+        I = DataType.INT64
+        col, lit = ColumnRef, lambda v: Literal(v, I)
+        f = FilterOp(FuncCall("logicalAnd", (
+            FuncCall("greaterThanEqual", (col("a"), lit(10))),
+            FuncCall("lessThan", (lit(20), col("a"))),  # flipped: a > 20
+        )))
+        assert predicate_ranges([f], {}) == {"a": (21, None)}
+        # equality intersected with an upper bound
+        f2 = FilterOp(FuncCall("logicalAnd", (
+            FuncCall("equal", (col("b"), lit(7))),
+            FuncCall("lessThanEqual", (col("b"), lit(9))),
+        )))
+        assert predicate_ranges([f2], {}) == {"b": (7, 7)}
+        # contradictory bounds: unsatisfiable
+        f3 = FilterOp(FuncCall("logicalAnd", (
+            FuncCall("equal", (col("c"), lit(1))),
+            FuncCall("equal", (col("c"), lit(2))),
+        )))
+        assert predicate_ranges([f3], {}) is EMPTY
+        # rename survives provenance; computed column kills it
+        m_ren = MapOp((("x", col("a")), ("time_", col("time_"))))
+        assert predicate_ranges(
+            [m_ren, FilterOp(FuncCall("equal", (col("x"), lit(3))))], {}
+        ) == {"a": (3, 3)}
+        m_comp = MapOp((("x", FuncCall("add", (col("a"), lit(1)))),))
+        assert predicate_ranges(
+            [m_comp, FilterOp(FuncCall("equal", (col("x"), lit(3))))], {}
+        ) is None
+
+    def test_unknown_string_is_empty(self):
+        from pixie_tpu.exec.plan import ColumnRef, FilterOp, FuncCall, Literal
+        from pixie_tpu.exec.zoneskip import EMPTY, predicate_ranges
+        from pixie_tpu.types.strings import StringDictionary
+
+        d = StringDictionary(["alpha", "beta"])
+        pred = FilterOp(FuncCall("equal", (
+            ColumnRef("s"), Literal("nope", DataType.STRING),
+        )))
+        assert predicate_ranges([pred], {"s": d}) is EMPTY
+        known = FilterOp(FuncCall("equal", (
+            ColumnRef("s"), Literal("beta", DataType.STRING),
+        )))
+        sid = d.lookup("beta")
+        assert predicate_ranges([known], {"s": d}) == {"s": (sid, sid)}
+
+    def test_engine_skips_cold_windows(self):
+        """Clustered predicate over a mostly-cold engine table: zone maps
+        prune windows before decode; flag-off A/B is bit-identical."""
+        from pixie_tpu.exec.engine import Engine
+
+        n, wins = 1 << 10, 24
+        with override_flag("cold_tier_mb", 128):
+            eng = Engine(window_rows=n)
+            eng.create_table(
+                "events",
+                relation=Relation([
+                    ("time_", DataType.TIME64NS),
+                    ("shard", DataType.INT64),
+                    ("v", DataType.INT64),
+                ]),
+                max_bytes=4 * n * 24 // 8,
+            )
+            for i in range(wins):
+                eng.append_data("events", {
+                    "time_": np.arange(i * n, (i + 1) * n, dtype=np.int64),
+                    "shard": np.full(n, i, dtype=np.int64),
+                    "v": np.arange(n, dtype=np.int64),
+                })
+        assert eng.tables["events"].stats().cold_rows > 0
+        q = (
+            "import px\n"
+            "df = px.DataFrame(table='events')\n"
+            "df = df[df.shard == 7]\n"
+            "out = df.groupby('shard').agg(n=('v', px.count),"
+            " s=('v', px.sum))\n"
+            "px.display(out)\n"
+        )
+        r1 = eng.execute_query(q)
+        u = eng.tracer.recent()[0]["usage"]
+        assert u["skipped_windows"] >= wins - 2
+        with override_flag("scan_zone_skip", False):
+            r2 = eng.execute_query(q)
+            u2 = eng.tracer.recent()[0]["usage"]
+        assert u2["skipped_windows"] == 0
+        d1, d2 = r1["output"].to_pydict(), r2["output"].to_pydict()
+        assert d1["n"][0] == d2["n"][0] == n
+        assert d1["s"][0] == d2["s"][0]
+
+    def test_unknown_string_prunes_every_window(self):
+        from pixie_tpu.exec.engine import Engine
+
+        n = 512
+        eng = Engine(window_rows=n)
+        eng.create_table("svc", relation=Relation([
+            ("time_", DataType.TIME64NS),
+            ("service", DataType.STRING),
+            ("v", DataType.INT64),
+        ]))
+        for i in range(6):
+            eng.append_data("svc", {
+                "time_": np.arange(i * n, (i + 1) * n, dtype=np.int64),
+                "service": [f"s{i % 3}"] * n,
+                "v": np.ones(n, dtype=np.int64),
+            })
+        q = (
+            "import px\n"
+            "df = px.DataFrame(table='svc')\n"
+            "df = df[df.service == 'never-seen']\n"
+            "out = df.groupby('service').agg(n=('v', px.count))\n"
+            "px.display(out)\n"
+        )
+        res = eng.execute_query(q)
+        assert res["output"].length == 0
+        u = eng.tracer.recent()[0]["usage"]
+        assert u["skipped_windows"] >= 6
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: decode errors, result cache, device cache.
+# ---------------------------------------------------------------------------
+
+
+class TestEngineIntegration:
+    def _tiered_engine(self, n=512, wins=12):
+        from pixie_tpu.exec.engine import Engine
+
+        with override_flag("cold_tier_mb", 64):
+            eng = Engine(window_rows=n)
+            eng.create_table("t", relation=REL, max_bytes=4 * n * ROW_BYTES)
+            for i in range(wins):
+                eng.append_data("t", _batch(i * n, n))
+        t = eng.tables["t"]
+        assert t.stats().cold_rows > 0
+        return eng, t
+
+    def test_decode_error_propagates_through_query(self):
+        """A corrupted cold window fails the query loudly (through the
+        window-prefetch pipeline staging path), not silently."""
+        eng, t = self._tiered_engine()
+        store = t._tier.store
+        w = store.windows[0]
+        bad = EncodedPlane("rle", np.dtype(np.int64), w.n,
+                           (np.array([1], dtype=np.int64),
+                            np.array([w.n - 7], dtype=np.int32)))
+        object.__setattr__(w, "planes", (bad,) + w.planes[1:])
+        # Host read path
+        with pytest.raises(ColdStoreError):
+            t.read_all()
+        # Full query path (device residency may serve windows staged at
+        # append time from HBM, so force re-staging from the table).
+        for dc in (t._device_cache,):
+            if dc is not None:
+                dc.clear()
+        with override_flag("device_residency", False):
+            with pytest.raises(Exception) as ei:
+                eng.execute_query(
+                    "import px\n"
+                    "df = px.DataFrame(table='t')\n"
+                    "out = df.groupby('service').agg("
+                    "n=('latency', px.count))\n"
+                    "px.display(out)\n"
+                )
+        assert "cold window" in str(ei.value)
+
+    def test_result_cache_validity_across_demotion(self):
+        """A cached result keyed on the watermark stays correct when the
+        rows it covered demote: new appends invalidate, and the refreshed
+        result over the (now mostly cold) table is exact."""
+        eng, t = self._tiered_engine(wins=8)
+        q = (
+            "import px\n"
+            "df = px.DataFrame(table='t')\n"
+            "out = df.groupby('service').agg(n=('latency', px.count),"
+            " s=('latency', px.sum))\n"
+            "px.display(out)\n"
+        )
+        with override_flag("result_cache_mb", 64):
+            r1 = eng.execute_query(q)
+            r2 = eng.execute_query(q)
+            assert eng.tracer.last().cache == "hit"
+            d1, d2 = r1["output"].to_pydict(), r2["output"].to_pydict()
+            assert np.array_equal(d1["n"], d2["n"])
+            # New appends demote older rows under the cache entry.
+            n = 512
+            for i in range(8, 12):
+                eng.append_data("t", _batch(i * n, n))
+            r3 = eng.execute_query(q)
+            assert eng.tracer.last().cache != "hit"
+            d3 = r3["output"].to_pydict()
+            assert int(d3["n"][0]) == 12 * n
+            assert int(d3["s"][0]) == 12 * sum(range(n))
+
+    def test_device_cache_keeps_demoted_windows(self):
+        """Demotion must not evict still-live staged device windows:
+        evict_before uses the tier-merged first_row_id."""
+        eng, t = self._tiered_engine()
+        dc = t._device_cache
+        if dc is None:
+            pytest.skip("device residency off")
+        staged = len(dc)
+        assert staged > 0
+        # All windows still live (nothing expired), so none were evicted
+        # by the demotions that happened during ingest.
+        assert t.first_row_id() == 0
+
+    def test_decode_ms_accounted(self):
+        eng, t = self._tiered_engine()
+        if t._device_cache is not None:
+            t._device_cache.clear()
+        with override_flag("device_residency", False):
+            eng.execute_query(
+                "import px\n"
+                "df = px.DataFrame(table='t')\n"
+                "out = df.groupby('service').agg(n=('latency', px.count))\n"
+                "px.display(out)\n"
+            )
+        u = eng.tracer.recent()[0]["usage"]
+        assert u["decode_ms"] > 0
+        assert t.stats().decode_seconds > 0
